@@ -1,0 +1,91 @@
+"""bluefog_tpu: a TPU-native decentralized training framework.
+
+A from-scratch JAX/XLA re-design of BlueFog's capability set (neighbor
+averaging and gossip over static/dynamic virtual topologies, hierarchical
+machine-level graphs, one-sided async windows, push-sum) with topologies
+compiled to ``lax.ppermute`` / ``psum`` schedules over TPU mesh axes instead of
+an MPI/NCCL background thread with rank-0 negotiation.
+
+Public surface mirrors ``import bluefog.torch as bf`` (reference
+``bluefog/torch/__init__.py:39-77``):
+
+>>> import bluefog_tpu as bf
+>>> bf.init()
+>>> y = bf.neighbor_allreduce(x)
+"""
+
+from bluefog_tpu import topology  # noqa: F401
+from bluefog_tpu import topology as topology_util  # parity alias  # noqa: F401
+
+from bluefog_tpu.version import __version__  # noqa: F401
+
+# Module-level context API (init/rank/size/ops) — imported lazily to keep
+# `import bluefog_tpu` cheap and jax-initialization-free until first use.
+from bluefog_tpu.basics import (  # noqa: F401
+    init,
+    shutdown,
+    initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    machine_size,
+    machine_rank,
+    is_homogeneous,
+    mesh,
+    set_topology,
+    set_machine_topology,
+    load_topology,
+    load_machine_topology,
+    in_neighbor_ranks,
+    out_neighbor_ranks,
+    allreduce,
+    allreduce_nonblocking,
+    allgather,
+    allgather_nonblocking,
+    broadcast,
+    broadcast_nonblocking,
+    neighbor_allgather,
+    neighbor_allgather_nonblocking,
+    neighbor_allreduce,
+    neighbor_allreduce_nonblocking,
+    dynamic_neighbor_allreduce,
+    dynamic_neighbor_allreduce_nonblocking,
+    hierarchical_neighbor_allreduce,
+    hierarchical_neighbor_allreduce_nonblocking,
+    pair_gossip,
+    pair_gossip_nonblocking,
+    poll,
+    wait,
+    synchronize,
+    barrier,
+    broadcast_parameters,
+    allreduce_parameters,
+)
+
+from bluefog_tpu.ops.window import (  # noqa: F401
+    win_create,
+    win_free,
+    win_put,
+    win_put_nonblocking,
+    win_get,
+    win_get_nonblocking,
+    win_accumulate,
+    win_accumulate_nonblocking,
+    win_update,
+    win_update_then_collect,
+    win_wait,
+    win_poll,
+    win_mutex,
+    get_win_version,
+    get_current_created_window_names,
+    win_associated_p,
+    turn_on_win_ops_with_associated_p,
+    turn_off_win_ops_with_associated_p,
+)
+
+from bluefog_tpu.utils.timeline import (  # noqa: F401
+    timeline_start_activity,
+    timeline_end_activity,
+    timeline_context,
+)

@@ -1,0 +1,595 @@
+"""Module-level context API: the ``import bluefog_tpu as bf`` surface.
+
+Parity target: ``BlueFogBasics`` (reference ``bluefog/common/basics.py``) plus
+the blocking/nonblocking op wrappers of ``bluefog/torch/mpi_ops.py``.  The
+architectural translation (SURVEY §7): there is no ctypes library, no
+background thread and no negotiation — "ranks" are the devices of a
+``jax.sharding.Mesh`` and every op is a cached ``jit(shard_map(...))`` call.
+
+Data model
+----------
+The eager API is *globally single-controller*: rank ``i``'s tensor is row ``i``
+of a rank-major array of shape ``(size, ...)`` sharded over the mesh, so each
+device holds exactly its own rank's slice and collectives ride ICI.  (The
+reference is multi-controller — each MPI process owns one tensor — which is
+why its API has per-rank weight dicts; here full weight matrices are natural
+and per-rank dicts are accepted as a convenience.)
+
+Nonblocking semantics: JAX dispatch is already asynchronous, so
+``*_nonblocking`` returns the not-yet-materialized ``jax.Array`` as the handle
+— ``poll`` maps to ``Array.is_ready()``, ``synchronize``/``wait`` to
+``block_until_ready`` (replacing the reference's HandleManager,
+``torch/handle_manager.cc:24-54``).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import networkx as nx
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu import topology as topology_util
+from bluefog_tpu.ops import collective as C
+from bluefog_tpu.ops import schedule as S
+
+RANK_AXIS = "bf_rank"
+MACHINE_AXIS = "bf_machine"
+LOCAL_AXIS = "bf_local"
+
+
+class _Context:
+    """Process-global framework state (replaces BluefogGlobalState,
+    reference ``common/global_state.h:31-99`` — minus the background thread,
+    tensor queue and coordinator tables that SPMD makes unnecessary)."""
+
+    def __init__(self):
+        self.initialized = False
+        self.devices: list = []
+        self.mesh: Optional[Mesh] = None            # 1-D (rank,)
+        self.hier_mesh: Optional[Mesh] = None       # 2-D (machine, local)
+        self.local_size: int = 1
+        self.topology: Optional[nx.DiGraph] = None
+        self.machine_topology: Optional[nx.DiGraph] = None
+        self.is_topo_weighted: bool = False
+        self.is_machine_topo_weighted: bool = False
+        self._static_scheds: Dict = {}
+        self._lock = threading.RLock()
+
+    # -- schedule caches ---------------------------------------------------
+    MAX_CACHED_SCHEDULES = 128
+
+    def static_schedule(self, key, build):
+        with self._lock:
+            if key not in self._static_scheds:
+                if len(self._static_scheds) >= self.MAX_CACHED_SCHEDULES:
+                    # FIFO eviction: per-step varying weight matrices must not
+                    # grow host memory without bound.  (For genuinely
+                    # time-varying weights prefer the dynamic-schedule path,
+                    # which switches phases without re-compiling.)
+                    evicted_key = next(iter(self._static_scheds))
+                    evicted = self._static_scheds.pop(evicted_key)
+                    cache = self.__dict__.get("_jit_cache", {})
+                    for k in [k for k in cache if id(evicted) in str(k)]:
+                        cache.pop(k, None)
+                self._static_scheds[key] = build()
+            return self._static_scheds[key]
+
+    def invalidate_schedules(self):
+        with self._lock:
+            self._static_scheds.clear()
+            self.__dict__.setdefault("_jit_cache", {}).clear()
+
+
+_ctx = _Context()
+
+
+def _reset_for_tests():
+    global _ctx
+    _ctx = _Context()
+
+
+def _require_init() -> _Context:
+    if not _ctx.initialized:
+        raise RuntimeError("bluefog_tpu is not initialized; call bf.init() first")
+    return _ctx
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle / identity (parity: basics.py:49-142)
+# ---------------------------------------------------------------------------
+
+def init(topology_fn=None, is_weighted: bool = False, *,
+         devices=None, local_size: Optional[int] = None) -> None:
+    """Initialize the context over the available devices.
+
+    ``topology_fn``: zero-arg callable returning the virtual topology (default
+    ``ExponentialGraph(size)``, matching reference ``basics.py:60-66``).
+    ``is_weighted``: use the topology's edge weights instead of uniform
+    ``1/(indeg+1)`` averaging.
+    ``local_size``: ranks per machine for hierarchical ops; defaults to
+    ``jax.local_device_count()`` when the world spans processes, else world
+    size (single virtual machine).
+    """
+    global _ctx
+    if _ctx.initialized:
+        shutdown()  # re-init tears down stale meshes, schedules, jit caches
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    _ctx.devices = devs
+    _ctx.mesh = Mesh(np.asarray(devs), (RANK_AXIS,))
+    if local_size is None:
+        local_size = jax.local_device_count() if jax.process_count() > 1 else n
+    assert n % local_size == 0, "world size must be divisible by local_size"
+    _ctx.local_size = local_size
+    _ctx.hier_mesh = Mesh(
+        np.asarray(devs).reshape(n // local_size, local_size),
+        (MACHINE_AXIS, LOCAL_AXIS))
+    _ctx.initialized = True
+    topo = topology_fn() if topology_fn is not None \
+        else topology_util.ExponentialGraph(n)
+    set_topology(topo, is_weighted=is_weighted)
+    if n // local_size > 1:
+        set_machine_topology(
+            topology_util.ExponentialGraph(n // local_size), is_weighted=False)
+
+
+def shutdown() -> None:
+    from bluefog_tpu.ops import window as _window
+    _window._free_all_windows()
+    _reset_for_tests()
+
+
+def initialized() -> bool:
+    return _ctx.initialized
+
+
+def size() -> int:
+    return len(_require_init().devices)
+
+
+def rank() -> int:
+    """Lowest global rank owned by this process (multi-controller parity)."""
+    ctx = _require_init()
+    for i, d in enumerate(ctx.devices):
+        if d.process_index == jax.process_index():
+            return i
+    return 0
+
+
+def local_size() -> int:
+    return _require_init().local_size
+
+
+def local_rank() -> int:
+    return rank() % _require_init().local_size
+
+
+def machine_size() -> int:
+    ctx = _require_init()
+    return len(ctx.devices) // ctx.local_size
+
+
+def machine_rank() -> int:
+    return rank() // _require_init().local_size
+
+
+def is_homogeneous() -> bool:
+    ctx = _require_init()
+    return len(ctx.devices) % ctx.local_size == 0
+
+
+def mesh() -> Mesh:
+    """The 1-D rank mesh; use for custom ``shard_map`` programs."""
+    return _require_init().mesh
+
+
+def hierarchical_mesh() -> Mesh:
+    """The 2-D (machine, local) mesh backing hierarchical ops."""
+    return _require_init().hier_mesh
+
+
+# ---------------------------------------------------------------------------
+# Topology management (parity: basics.py:216-378)
+# ---------------------------------------------------------------------------
+
+def set_topology(topology: Optional[nx.DiGraph] = None,
+                 is_weighted: bool = False) -> bool:
+    """Install a new virtual topology.
+
+    Unlike the reference — which stops the world to rebuild the MPI graph
+    communicator (``operations.cc:1279-1308``) — this just swaps the schedule
+    cache; the next op compiles against the new permutation set.
+    """
+    ctx = _require_init()
+    from bluefog_tpu.ops import window as _window
+    if _window._any_window_exists():
+        raise RuntimeError(
+            "Cannot change topology while windows exist; call win_free() first "
+            "(matches reference basics.py set_topology restriction)")
+    if topology is None:
+        topology = topology_util.ExponentialGraph(size())
+    if topology.number_of_nodes() != size():
+        raise ValueError(
+            f"topology has {topology.number_of_nodes()} nodes, world size is {size()}")
+    ctx.topology = topology
+    ctx.is_topo_weighted = is_weighted
+    ctx.invalidate_schedules()
+    return True
+
+
+def set_machine_topology(topology: nx.DiGraph, is_weighted: bool = False) -> bool:
+    """Install the machine-level topology used by hierarchical ops
+    (parity: ``basics.py:259-293``)."""
+    ctx = _require_init()
+    if topology.number_of_nodes() != machine_size():
+        raise ValueError(
+            f"machine topology has {topology.number_of_nodes()} nodes, "
+            f"machine count is {machine_size()}")
+    ctx.machine_topology = topology
+    ctx.is_machine_topo_weighted = is_weighted
+    ctx.invalidate_schedules()
+    return True
+
+
+def load_topology() -> nx.DiGraph:
+    return _require_init().topology
+
+
+def load_machine_topology() -> nx.DiGraph:
+    return _require_init().machine_topology
+
+
+def is_topo_weighted() -> bool:
+    return _require_init().is_topo_weighted
+
+
+def in_neighbor_ranks(rank_: Optional[int] = None) -> List[int]:
+    r = rank() if rank_ is None else rank_
+    return topology_util.in_neighbor_ranks(load_topology(), r)
+
+
+def out_neighbor_ranks(rank_: Optional[int] = None) -> List[int]:
+    r = rank() if rank_ is None else rank_
+    return topology_util.out_neighbor_ranks(load_topology(), r)
+
+
+def in_neighbor_machine_ranks(rank_: Optional[int] = None) -> List[int]:
+    r = machine_rank() if rank_ is None else rank_
+    return topology_util.in_neighbor_ranks(load_machine_topology(), r)
+
+
+def out_neighbor_machine_ranks(rank_: Optional[int] = None) -> List[int]:
+    r = machine_rank() if rank_ is None else rank_
+    return topology_util.out_neighbor_ranks(load_machine_topology(), r)
+
+
+# ---------------------------------------------------------------------------
+# SPMD plumbing
+# ---------------------------------------------------------------------------
+
+def _rank_sharding() -> NamedSharding:
+    return NamedSharding(_require_init().mesh, P(RANK_AXIS))
+
+
+def _place(x: jnp.ndarray) -> jnp.ndarray:
+    """Shard a rank-major array (leading dim == size) over the rank axis."""
+    n = size()
+    x = jnp.asarray(x)
+    if x.ndim == 0 or x.shape[0] != n:
+        raise ValueError(
+            f"eager ops take rank-major arrays with leading dim {n}, got {x.shape}")
+    return jax.device_put(x, _rank_sharding())
+
+
+def _jitted(key, build):
+    """Per-context cache of jitted shard_map programs.
+
+    Eager ops construct fresh closures every call; caching on a logical key
+    keeps XLA's compile cache hot (one compile per op x schedule x shape)."""
+    ctx = _require_init()
+    with ctx._lock:
+        cache = ctx.__dict__.setdefault("_jit_cache", {})
+        if key not in cache:
+            cache[key] = build()
+        return cache[key]
+
+
+def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
+    ctx = _require_init()
+    def build():
+        def run(b, *e):
+            return fn(b[0], *e)[None]
+        n_extra = len(extra)
+        return jax.jit(jax.shard_map(
+            run, mesh=ctx.mesh,
+            in_specs=(P(RANK_AXIS),) + (P(),) * n_extra,
+            out_specs=P(RANK_AXIS)))
+    return _jitted(("flat", key, len(extra)), build)(_place(x), *extra)
+
+
+def _dispatch_hier(key, fn, x) -> jnp.ndarray:
+    ctx = _require_init()
+    def build():
+        return jax.jit(jax.shard_map(
+            lambda b: fn(b[0])[None], mesh=ctx.hier_mesh,
+            in_specs=P((MACHINE_AXIS, LOCAL_AXIS)),
+            out_specs=P((MACHINE_AXIS, LOCAL_AXIS))))
+    return _jitted(("hier", key), build)(_place(x))
+
+
+def _weight_override_matrix(
+        self_weight: Optional[float],
+        src_weights: Optional[Union[np.ndarray, Dict[int, float]]],
+        dst_weights: Optional[Union[np.ndarray, Dict[int, float]]],
+) -> Optional[np.ndarray]:
+    """Build a full (n, n) override matrix from eager-API weight arguments.
+
+    Accepts a full matrix via ``src_weights``; dict forms are interpreted
+    globally (``{src: w}`` feeds every receiver, ``{dst: w}`` scales every
+    sender's edge to ``dst``) — the single-controller analogue of the
+    reference's per-process dicts (``torch/mpi_ops.py:433-489``).
+    """
+    if src_weights is None and dst_weights is None and self_weight is None:
+        return None
+    if self_weight is not None and src_weights is None and dst_weights is None:
+        raise ValueError(
+            "self_weight and src_weights/dst_weights have to be presented at "
+            "the same time (matches reference torch/mpi_ops.py:532-534)")
+    n = size()
+    topo = load_topology()
+    base = topology_util.weight_matrix(topo)
+    if not is_topo_weighted():
+        base = S.uniform_weights(base)
+    src_is_matrix = src_weights is not None and not isinstance(src_weights, dict)
+    dst_is_matrix = dst_weights is not None and not isinstance(dst_weights, dict)
+    if src_is_matrix and dst_is_matrix:
+        raise ValueError("pass a single full weight matrix, not both "
+                         "src_weights and dst_weights matrices")
+    if src_is_matrix or dst_is_matrix:
+        w = np.asarray(src_weights if src_is_matrix else dst_weights, dtype=float)
+        if w.shape != (n, n):
+            raise ValueError(f"weight matrix must be ({n}, {n}), got {w.shape}")
+    else:
+        w = base.copy()
+        if isinstance(src_weights, dict):
+            sources = {s for s, d in topo.edges() if s != d}
+            missing = sources - set(src_weights)
+            if missing:
+                raise ValueError(
+                    "src_weights dict must cover every in-neighbor source; "
+                    f"missing ranks {sorted(missing)} (reference raises too, "
+                    "torch/mpi_ops.py:433-489)")
+            off = np.zeros((n, n))
+            for src, wt in src_weights.items():
+                for dst in range(n):
+                    if topo.has_edge(src, dst) and src != dst:
+                        off[src, dst] = wt
+            diag = np.diag(w).copy()
+            w = off
+            np.fill_diagonal(w, diag)
+        if isinstance(dst_weights, dict):
+            for dst, wt in dst_weights.items():
+                for src in range(n):
+                    if src != dst and topo.has_edge(src, dst):
+                        w[src, dst] = wt
+    if self_weight is not None:
+        np.fill_diagonal(w, self_weight)
+    return w
+
+
+# ---------------------------------------------------------------------------
+# Collective ops (blocking + nonblocking)
+# ---------------------------------------------------------------------------
+
+Handle = jnp.ndarray  # async jax array: dispatch already happened
+
+
+def allreduce_nonblocking(x, *, average: bool = True, name: Optional[str] = None) -> Handle:
+    return _dispatch_flat(
+        ("allreduce", average),
+        partial(C.allreduce, axis_name=RANK_AXIS, average=average), x)
+
+
+def allreduce(x, *, average: bool = True, name: Optional[str] = None) -> jnp.ndarray:
+    return synchronize(allreduce_nonblocking(x, average=average, name=name))
+
+
+def broadcast_nonblocking(x, root_rank: int, name: Optional[str] = None) -> Handle:
+    return _dispatch_flat(
+        ("broadcast", root_rank),
+        partial(C.broadcast, root_rank=root_rank, axis_name=RANK_AXIS), x)
+
+
+def broadcast(x, root_rank: int, name: Optional[str] = None) -> jnp.ndarray:
+    return synchronize(broadcast_nonblocking(x, root_rank, name))
+
+
+def allgather_nonblocking(x, name: Optional[str] = None) -> Handle:
+    return _dispatch_flat(("allgather",),
+                          partial(C.allgather, axis_name=RANK_AXIS), x)
+
+
+def allgather(x, name: Optional[str] = None) -> jnp.ndarray:
+    """Every rank receives the concatenation of all ranks' tensors along the
+    leading (per-rank) axis; output shape ``(size, size*d0, ...)``."""
+    return synchronize(allgather_nonblocking(x, name))
+
+
+def _nbr_schedule(weights: Optional[np.ndarray]) -> S.StaticSchedule:
+    ctx = _require_init()
+    if weights is not None:
+        key = ("static_override", weights.tobytes())
+        return ctx.static_schedule(
+            key, lambda: S.compile_static(load_topology(), src_weights=weights))
+    key = ("static", id(ctx.topology), ctx.is_topo_weighted)
+    return ctx.static_schedule(
+        key, lambda: S.compile_static(load_topology(),
+                                      use_topo_weights=ctx.is_topo_weighted))
+
+
+def neighbor_allreduce_nonblocking(x, *, self_weight=None, src_weights=None,
+                                   dst_weights=None,
+                                   name: Optional[str] = None) -> Handle:
+    w = _weight_override_matrix(self_weight, src_weights, dst_weights)
+    sched = _nbr_schedule(w)
+    return _dispatch_flat(
+        ("neighbor_allreduce", id(sched)),
+        partial(C.neighbor_allreduce, sched=sched, axis_name=RANK_AXIS), x)
+
+
+def neighbor_allreduce(x, *, self_weight=None, src_weights=None,
+                       dst_weights=None, name: Optional[str] = None) -> jnp.ndarray:
+    """Weighted neighbor averaging over the active topology (the flagship op,
+    reference ``torch/mpi_ops.py:433-595``)."""
+    return synchronize(neighbor_allreduce_nonblocking(
+        x, self_weight=self_weight, src_weights=src_weights,
+        dst_weights=dst_weights, name=name))
+
+
+def dynamic_neighbor_allreduce_nonblocking(x, step: int, *,
+                                           phases=None) -> Handle:
+    """Neighbor averaging with the one-peer dynamic walk at ``step``.
+
+    ``phases`` defaults to the phase table of the active topology."""
+    ctx = _require_init()
+    key = ("dynamic", id(ctx.topology)) if phases is None else (
+        "dynphases", tuple(ph.send_to for ph in phases))
+    if phases is None:
+        sched = ctx.static_schedule(
+            key, lambda: S.compile_dynamic(
+                topology_util.dynamic_phase_table(load_topology()), size()))
+    else:
+        sched = ctx.static_schedule(
+            key, lambda: S.compile_dynamic(phases, size()))
+    step_arr = jnp.asarray(step, dtype=jnp.int32)
+    fn = partial(C.dynamic_neighbor_allreduce, sched=sched, axis_name=RANK_AXIS)
+    return _dispatch_flat(("dynamic_neighbor_allreduce", id(sched)),
+                          fn, x, step_arr)
+
+
+def dynamic_neighbor_allreduce(x, step: int, *, phases=None) -> jnp.ndarray:
+    return synchronize(dynamic_neighbor_allreduce_nonblocking(
+        x, step, phases=phases))
+
+
+def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> Handle:
+    sched = _nbr_schedule(None)
+    return _dispatch_flat(
+        ("neighbor_allgather", id(sched)),
+        partial(C.neighbor_allgather, sched=sched, axis_name=RANK_AXIS), x)
+
+
+def neighbor_allgather(x, name: Optional[str] = None) -> jnp.ndarray:
+    """Gather in-neighbor tensors: output ``(size, max_indegree, ...)`` in
+    ascending-src order with zero padding for irregular indegree."""
+    return synchronize(neighbor_allgather_nonblocking(x, name))
+
+
+def hierarchical_neighbor_allreduce_nonblocking(
+        x, *, self_weight=None, src_machine_weights=None,
+        name: Optional[str] = None) -> Handle:
+    ctx = _require_init()
+    if ctx.machine_topology is None:
+        raise RuntimeError("set_machine_topology() required for hierarchical ops")
+    key = ("hier", id(ctx.machine_topology), ctx.is_machine_topo_weighted,
+           self_weight,
+           None if src_machine_weights is None
+           else np.asarray(src_machine_weights, dtype=float).tobytes())
+    def build():
+        return S.compile_static(
+            ctx.machine_topology,
+            use_topo_weights=ctx.is_machine_topo_weighted,
+            self_weight=self_weight,
+            src_weights=src_machine_weights)
+    sched = ctx.static_schedule(key, build)
+    return _dispatch_hier(
+        ("hierarchical_neighbor_allreduce", id(sched)),
+        partial(C.hierarchical_neighbor_allreduce, sched=sched,
+                local_axis=LOCAL_AXIS, machine_axis=MACHINE_AXIS), x)
+
+
+def hierarchical_neighbor_allreduce(x, *, self_weight=None,
+                                    src_machine_weights=None,
+                                    name: Optional[str] = None) -> jnp.ndarray:
+    """Machine-level neighbor averaging: reduce-scatter over the local (ICI)
+    axis, neighbor exchange of shards over the machine (DCN) axis, all-gather
+    back (reference semantics ``mpi_controller.cc:455-515`` at 1/local_size of
+    the reference's DCN traffic)."""
+    return synchronize(hierarchical_neighbor_allreduce_nonblocking(
+        x, self_weight=self_weight, src_machine_weights=src_machine_weights,
+        name=name))
+
+
+def pair_gossip_nonblocking(x, target_ranks: Union[Dict[int, int], List[int]],
+                            *, self_weight: float = 0.5,
+                            target_weight: float = 0.5) -> Handle:
+    """Pairwise exchange-and-average.  ``target_ranks``: list (or dict) mapping
+    each rank to its partner, -1 / missing to sit out; must be mutual."""
+    n = size()
+    if isinstance(target_ranks, dict):
+        tgt = [-1] * n
+        for r, t in target_ranks.items():
+            tgt[r] = t
+    else:
+        tgt = list(target_ranks)
+    ctx = _require_init()
+    key = ("gossip", tuple(tgt), self_weight, target_weight)
+    sched = ctx.static_schedule(
+        key, lambda: S.compile_pair_gossip(
+            tgt, n, self_weight=self_weight, target_weight=target_weight))
+    return _dispatch_flat(
+        ("pair_gossip", id(sched)),
+        partial(C.pair_gossip, sched=sched, axis_name=RANK_AXIS), x)
+
+
+def pair_gossip(x, target_ranks, *, self_weight: float = 0.5,
+                target_weight: float = 0.5) -> jnp.ndarray:
+    return synchronize(pair_gossip_nonblocking(
+        x, target_ranks, self_weight=self_weight, target_weight=target_weight))
+
+
+# ---------------------------------------------------------------------------
+# Handle surface (parity: mpi_ops.py:850-911)
+# ---------------------------------------------------------------------------
+
+def poll(handle: Handle) -> bool:
+    """True iff the async result has materialized."""
+    try:
+        return handle.is_ready()
+    except AttributeError:
+        return True
+
+
+def wait(handle: Handle) -> jnp.ndarray:
+    return synchronize(handle)
+
+
+def synchronize(handle: Handle) -> jnp.ndarray:
+    return jax.block_until_ready(handle)
+
+
+def barrier() -> None:
+    """Block until all dispatched device work completes."""
+    jax.effects_barrier()
+    tok = jnp.zeros((size(),), jnp.float32)
+    jax.block_until_ready(allreduce_nonblocking(tok, average=False))
+
+
+# ---------------------------------------------------------------------------
+# Parameter utilities (parity: torch/utility.py:22-212)
+# ---------------------------------------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast a pytree of rank-major arrays from ``root_rank`` to all."""
+    return jax.tree.map(lambda p: broadcast(p, root_rank), params)
+
+
+def allreduce_parameters(params, *, average: bool = True):
+    """Allreduce (average) a pytree of rank-major arrays."""
+    return jax.tree.map(lambda p: allreduce(p, average=average), params)

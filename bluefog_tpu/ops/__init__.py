@@ -1,0 +1,10 @@
+from bluefog_tpu.ops.schedule import (  # noqa: F401
+    CommRound,
+    StaticSchedule,
+    DynamicSchedule,
+    PairGossipSchedule,
+    compile_static,
+    compile_dynamic,
+    compile_pair_gossip,
+)
+from bluefog_tpu.ops import collective  # noqa: F401

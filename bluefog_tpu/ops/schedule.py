@@ -1,0 +1,248 @@
+"""Topology -> collective-permute schedule compiler.
+
+This is the TPU-native replacement for the reference's entire coordination
+machinery: the rank-0 negotiation protocol (BlueFog ``operations.cc:825-1093``),
+the graph communicator (``mpi_context.cc:373-395``) and the per-vendor
+neighbor-exchange implementations (``mpi_controller.cc:369-525``,
+``nccl_controller.cc:643-745``).  Because SPMD programs are statically matched
+across devices, none of that run-time matching is needed — a topology compiles
+*once* into a list of ``lax.ppermute`` rounds plus weight vectors, and the
+jitted step function replays it every iteration at ICI speed.
+
+Decomposition: the edge set of any digraph over ranks ``0..n-1`` is partitioned
+by cyclic shift distance ``d = (dst - src) mod n``.  All edges of one distance
+form a partial permutation (every src and every dst appears at most once), i.e.
+exactly one valid ``ppermute``.  Shift-structured topologies (ring, Exp2,
+fully-connected) decompose into full permutations with zero waste; irregular
+ones (star, mesh) yield partial rounds where non-participating ranks receive
+zeros, which the weight vectors mask out.
+
+Weights are applied *source-side*: round ``r`` communicates
+``ppermute(x * send_scale_r[rank])`` and the receiver accumulates unscaled.
+This one convention implements receiver-chosen ``src_weights``, sender-chosen
+``dst_weights`` (partial send) and push-sum column-stochastic scaling alike,
+since schedule weights are compile-time constants known on every device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import networkx as nx
+import numpy as np
+
+from bluefog_tpu import topology as topo_mod
+
+__all__ = [
+    "CommRound",
+    "StaticSchedule",
+    "DynamicSchedule",
+    "PairGossipSchedule",
+    "compile_static",
+    "compile_dynamic",
+    "compile_pair_gossip",
+    "uniform_weights",
+]
+
+
+@dataclass(frozen=True, eq=False)
+class CommRound:
+    """One ``ppermute`` worth of communication.
+
+    ``pairs``      — static (src, dst) list handed to ``lax.ppermute``.
+    ``send_scale`` — (n,) array; src multiplies its payload by
+                     ``send_scale[src]`` before the permute.  Zero for ranks
+                     that do not send this round.
+    ``recv_mask``  — (n,) 0/1 array; 1 iff the rank receives this round
+                     (ppermute already yields zeros for silent ranks, the mask
+                     exists for ops that need explicit participation info,
+                     e.g. neighbor_allgather padding).
+    ``src_of``     — (n,) int array; src rank feeding each dst this round,
+                     -1 when silent.  Consumed by ordered-concat ops.
+    """
+    pairs: Tuple[Tuple[int, int], ...]
+    send_scale: np.ndarray
+    recv_mask: np.ndarray
+    src_of: np.ndarray
+
+
+@dataclass(frozen=True, eq=False)
+class StaticSchedule:
+    """Compiled static topology: ``out = self_scale[i] * x_i + sum_r recv_r``."""
+    n: int
+    rounds: Tuple[CommRound, ...]
+    self_scale: np.ndarray       # (n,)
+    indegree: np.ndarray         # (n,) int, self-loop excluded
+    outdegree: np.ndarray        # (n,) int, self-loop excluded
+
+    @property
+    def max_indegree(self) -> int:
+        return int(self.indegree.max(initial=0))
+
+    @property
+    def is_regular(self) -> bool:
+        return bool((self.indegree == self.indegree[0]).all()
+                    and (self.outdegree == self.outdegree[0]).all())
+
+
+@dataclass(frozen=True, eq=False)
+class DynamicSchedule:
+    """Periodic dynamic topology: step ``t`` runs ``phases[t % len(phases)]``."""
+    n: int
+    phases: Tuple[StaticSchedule, ...]
+
+    @property
+    def period(self) -> int:
+        return len(self.phases)
+
+
+@dataclass(frozen=True, eq=False)
+class PairGossipSchedule:
+    """Single-round symmetric exchange for ``pair_gossip``."""
+    n: int
+    round: CommRound
+    self_scale: np.ndarray
+
+
+def _rounds_from_matrix(w: np.ndarray) -> Tuple[CommRound, ...]:
+    """Partition off-diagonal edges of ``w`` by shift distance into rounds."""
+    n = w.shape[0]
+    by_dist: Dict[int, List[Tuple[int, int]]] = {}
+    srcs, dsts = np.nonzero(w)
+    for s, d in zip(srcs.tolist(), dsts.tolist()):
+        if s == d:
+            continue
+        by_dist.setdefault((d - s) % n, []).append((s, d))
+    rounds = []
+    for dist in sorted(by_dist):
+        pairs = tuple(sorted(by_dist[dist]))
+        send_scale = np.zeros(n)
+        recv_mask = np.zeros(n)
+        src_of = np.full(n, -1, dtype=np.int32)
+        for s, d in pairs:
+            send_scale[s] = w[s, d]
+            recv_mask[d] = 1.0
+            src_of[d] = s
+        rounds.append(CommRound(pairs, send_scale, recv_mask, src_of))
+    return tuple(rounds)
+
+
+def uniform_weights(w_adj: np.ndarray) -> np.ndarray:
+    """Replace a 0/1-ish adjacency with uniform ``1/(indeg+1)`` averaging
+    weights — the reference's default when topology weights are disabled
+    (``torch/mpi_ops.py:433-489``)."""
+    n = w_adj.shape[0]
+    w = np.zeros_like(w_adj, dtype=float)
+    mask = (w_adj != 0)
+    np.fill_diagonal(mask, False)
+    indeg = mask.sum(axis=0)
+    for dst in range(n):
+        share = 1.0 / (indeg[dst] + 1.0)
+        w[mask[:, dst], dst] = share
+        w[dst, dst] = share
+    return w
+
+
+def compile_static(topo: nx.DiGraph, *,
+                   use_topo_weights: bool = True,
+                   self_weight: Optional[float] = None,
+                   src_weights: Optional[np.ndarray] = None) -> StaticSchedule:
+    """Compile a static topology into a ppermute schedule.
+
+    ``use_topo_weights=False`` applies uniform ``1/(indeg+1)`` weights (the
+    reference's ``bf.init(is_weighted=False)`` default).  ``src_weights`` may
+    override the full (n, n) weight matrix; ``self_weight`` overrides the
+    diagonal (broadcast to all ranks).
+    """
+    w = topo_mod.weight_matrix(topo)
+    if src_weights is not None:
+        w = np.asarray(src_weights, dtype=float)
+    elif not use_topo_weights:
+        w = uniform_weights(w)
+    if self_weight is not None:
+        w = w.copy()
+        np.fill_diagonal(w, self_weight)
+    n = w.shape[0]
+    off_diag = w.copy()
+    np.fill_diagonal(off_diag, 0.0)
+    return StaticSchedule(
+        n=n,
+        rounds=_rounds_from_matrix(w),
+        self_scale=np.diag(w).copy(),
+        indegree=(off_diag != 0).sum(axis=0).astype(np.int32),
+        outdegree=(off_diag != 0).sum(axis=1).astype(np.int32),
+    )
+
+
+def _phase_matrix(phase: topo_mod.DynamicPhase, n: int,
+                  weights: Optional[np.ndarray] = None) -> np.ndarray:
+    """Weight matrix of one dynamic phase: default ``1/(indeg+1)`` averaging."""
+    w = np.zeros((n, n))
+    if weights is not None:
+        for s, d in phase.pairs:
+            w[s, d] = weights[s, d]
+        np.fill_diagonal(w, np.diag(weights))
+        return w
+    indeg = np.zeros(n, dtype=np.int64)
+    for _s, d in phase.pairs:
+        indeg[d] += 1
+    for s, d in phase.pairs:
+        w[s, d] = 1.0 / (indeg[d] + 1.0)
+    for r in range(n):
+        w[r, r] = 1.0 / (indeg[r] + 1.0)
+    return w
+
+
+def compile_dynamic(phases: Sequence[topo_mod.DynamicPhase], n: int, *,
+                    weights: Optional[np.ndarray] = None) -> DynamicSchedule:
+    """Compile a periodic phase table (see ``topology.dynamic_phase_table`` /
+    ``one_peer_exp2_phases``) into per-phase static schedules.
+
+    Under ``jit`` the phase is selected with ``lax.switch(t % period)`` over
+    branches that each contain their own static ``ppermute`` — dynamic
+    topologies never retrace (SURVEY §7 "dynamic topology under jit").
+    """
+    compiled = []
+    for ph in phases:
+        w = _phase_matrix(ph, n, weights)
+        off = w.copy()
+        np.fill_diagonal(off, 0.0)
+        compiled.append(StaticSchedule(
+            n=n,
+            rounds=_rounds_from_matrix(w),
+            self_scale=np.diag(w).copy(),
+            indegree=(off != 0).sum(axis=0).astype(np.int32),
+            outdegree=(off != 0).sum(axis=1).astype(np.int32),
+        ))
+    return DynamicSchedule(n=n, phases=tuple(compiled))
+
+
+def compile_pair_gossip(target_of: Sequence[int], n: int, *,
+                        self_weight: float = 0.5,
+                        target_weight: float = 0.5) -> PairGossipSchedule:
+    """Compile a pairwise exchange: ``target_of[i]`` is rank ``i``'s partner
+    (must be mutual, ``target_of[target_of[i]] == i``), or -1 to sit out.
+
+    Matches ``bf.pair_gossip`` semantics (reference ``mpi_controller.cc:748-774``
+    = ``MPI_Sendrecv`` + average).
+    """
+    pairs = []
+    send_scale = np.zeros(n)
+    recv_mask = np.zeros(n)
+    src_of = np.full(n, -1, dtype=np.int32)
+    self_scale = np.ones(n)
+    for i, t in enumerate(target_of):
+        if t < 0:
+            continue
+        assert target_of[t] == i, f"pair_gossip targets must be mutual ({i}<->{t})"
+        pairs.append((i, t))
+        send_scale[i] = target_weight
+        recv_mask[t] = 1.0
+        src_of[t] = i
+        self_scale[i] = self_weight
+    return PairGossipSchedule(
+        n=n,
+        round=CommRound(tuple(sorted(pairs)), send_scale, recv_mask, src_of),
+        self_scale=self_scale,
+    )

@@ -1,0 +1,157 @@
+"""Timeline: named-activity tracing to chrome://tracing JSON + jax.profiler.
+
+Replaces the reference's C++ Timeline (``common/timeline.{h,cc}``: dedicated
+writer thread fed by a lock-free queue, one JSON file per rank, enabled by
+``BLUEFOG_TIMELINE=<prefix>``).  Here user-level named activities are recorded
+through the same env-var contract and additionally forwarded to
+``jax.profiler.TraceAnnotation`` so they show up inside TPU profiler traces
+alongside XLA ops — something the reference cannot do.
+
+Device-side op timelines come for free from ``jax.profiler.trace()``; this
+module covers the *host-side* named-activity API
+(``bf.timeline_start_activity/timeline_end_activity/timeline_context``,
+reference ``basics.py:415-495``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+import jax.profiler
+
+__all__ = [
+    "timeline_enabled",
+    "timeline_start_activity",
+    "timeline_end_activity",
+    "timeline_context",
+    "start_timeline",
+    "stop_timeline",
+]
+
+_TRACE_EVENT_SENTINEL = None
+
+
+class _TimelineWriter:
+    """Background JSON writer: events go through a queue so the training
+    thread never blocks on file IO (same design as timeline.h:46-76)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.q: "queue.Queue" = queue.Queue(maxsize=1 << 16)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="bf-timeline")
+        self._thread.start()
+
+    def _run(self):
+        with open(self.path, "w") as f:
+            f.write("[\n")
+            first = True
+            while True:
+                ev = self.q.get()
+                if ev is _TRACE_EVENT_SENTINEL:
+                    break
+                if not first:
+                    f.write(",\n")
+                f.write(json.dumps(ev))
+                first = False
+                f.flush()
+            f.write("\n]\n")
+
+    def emit(self, ev: dict):
+        try:
+            self.q.put_nowait(ev)
+        except queue.Full:
+            pass  # drop rather than stall training
+
+    def close(self):
+        self.q.put(_TRACE_EVENT_SENTINEL)
+        self._thread.join(timeout=5)
+
+
+_writer: Optional[_TimelineWriter] = None
+_active: Dict[str, object] = {}
+_lock = threading.Lock()
+
+
+def _maybe_autostart():
+    global _writer
+    if _writer is None:
+        prefix = os.environ.get("BLUEFOG_TIMELINE")
+        if prefix:
+            start_timeline(f"{prefix}0.json")
+
+
+def timeline_enabled() -> bool:
+    _maybe_autostart()
+    return _writer is not None
+
+
+def start_timeline(path: str) -> bool:
+    """Begin writing a chrome-tracing file (parity: ``bf.timeline_start``)."""
+    global _writer
+    with _lock:
+        if _writer is not None:
+            return False
+        _writer = _TimelineWriter(path)
+    return True
+
+
+def stop_timeline() -> bool:
+    global _writer
+    with _lock:
+        if _writer is None:
+            return False
+        _writer.close()
+        _writer = None
+    return True
+
+
+def timeline_start_activity(tensor_name: str, activity_name: str = "USER") -> bool:
+    """Open a named activity span (parity: ``basics.py:415-451``)."""
+    _maybe_autostart()
+    if _writer is None:
+        return False
+    key = f"{tensor_name}:{activity_name}"
+    ann = jax.profiler.TraceAnnotation(key)
+    ann.__enter__()
+    with _lock:
+        prior = _active.pop(key, None)
+        _active[key] = ann
+    if prior is not None:
+        # A same-key span was still open (retry loop / double start): close it
+        # so the profiler's thread-local annotation stack stays balanced.
+        prior.__exit__(None, None, None)
+    _writer.emit({"name": activity_name, "cat": tensor_name, "ph": "B",
+                  "ts": time.monotonic_ns() // 1000, "pid": os.getpid(),
+                  "tid": threading.get_ident()})
+    return True
+
+
+def timeline_end_activity(tensor_name: str, activity_name: str = "USER") -> bool:
+    if _writer is None:
+        return False
+    key = f"{tensor_name}:{activity_name}"
+    with _lock:
+        ann = _active.pop(key, None)
+    if ann is not None:
+        ann.__exit__(None, None, None)
+    _writer.emit({"name": activity_name, "cat": tensor_name, "ph": "E",
+                  "ts": time.monotonic_ns() // 1000, "pid": os.getpid(),
+                  "tid": threading.get_ident()})
+    return True
+
+
+@contextmanager
+def timeline_context(tensor_name: str, activity_name: str = "USER"):
+    """``with bf.timeline_context("grad_sync"):`` span recorder."""
+    timeline_start_activity(tensor_name, activity_name)
+    try:
+        yield
+    finally:
+        timeline_end_activity(tensor_name, activity_name)

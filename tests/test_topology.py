@@ -1,0 +1,205 @@
+"""Topology library tests.
+
+Case inventory mirrors the reference's ``test/torch_basics_test.py:95-126``
+(static graph suite over Expo2/Ring/Star/MeshGrid) plus closed-form checks on
+weights and the dynamic schedules.
+"""
+
+import math
+
+import networkx as nx
+import numpy as np
+import pytest
+
+from bluefog_tpu import topology as topo
+
+
+STATIC_SIZES = [1, 2, 3, 4, 7, 8, 12, 16]
+
+
+def _check_stochastic(G):
+    w = topo.weight_matrix(G)
+    np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+
+
+@pytest.mark.parametrize("size", STATIC_SIZES)
+def test_exponential_two_graph(size):
+    G = topo.ExponentialTwoGraph(size)
+    _check_stochastic(G)
+    # out-neighbors of rank 0 are exactly the powers of two < size
+    expected = sorted({2 ** k for k in range(size.bit_length()) if 2 ** k < size})
+    assert topo.out_neighbor_ranks(G, 0) == expected
+    # circulant: every rank has the same degree
+    assert topo.IsRegularGraph(G)
+
+
+def test_exponential_graph_base3():
+    G = topo.ExponentialGraph(10, base=3)
+    assert topo.out_neighbor_ranks(G, 0) == [1, 3, 9]
+    _check_stochastic(G)
+
+
+def test_symmetric_exponential_graph():
+    G = topo.SymmetricExponentialGraph(12, base=4)
+    # offsets d where min(d, 12-d) is a power of 4: 1, 4, 8(=12-4), 11(=12-1)
+    assert topo.out_neighbor_ranks(G, 0) == [1, 4, 8, 11]
+    _check_stochastic(G)
+
+
+@pytest.mark.parametrize("size,shape", [(4, (2, 2)), (6, (2, 3)), (12, None), (5, None)])
+def test_meshgrid2d(size, shape):
+    G = topo.MeshGrid2DGraph(size, shape)
+    _check_stochastic(G)
+    w = topo.weight_matrix(G)
+    # symmetric weights => doubly stochastic => mean-preserving averaging
+    np.testing.assert_allclose(w, w.T, atol=1e-12)
+    np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-12)
+
+
+def test_meshgrid2d_structure():
+    G = topo.MeshGrid2DGraph(6, (2, 3))
+    # corner rank 0 in a 2x3 grid: neighbors 1 (right) and 3 (below)
+    assert topo.out_neighbor_ranks(G, 0) == [1, 3]
+    # middle of top row, rank 1: neighbors 0, 2, 4
+    assert topo.out_neighbor_ranks(G, 1) == [0, 2, 4]
+
+
+@pytest.mark.parametrize("size", [2, 4, 8])
+def test_star_graph(size):
+    G = topo.StarGraph(size)
+    _check_stochastic(G)
+    w = topo.weight_matrix(G)
+    np.testing.assert_allclose(w[0], 1.0 / size)   # center row uniform
+    np.testing.assert_allclose(w[:, 0], 1.0 / size)
+    for i in range(1, size):
+        assert w[i, i] == pytest.approx(1 - 1 / size)
+    assert topo.out_neighbor_ranks(G, size - 1) == [0]
+
+
+def test_ring_graph_styles():
+    n = 8
+    bi = topo.RingGraph(n, 0)
+    left = topo.RingGraph(n, 1)
+    right = topo.RingGraph(n, 2)
+    assert topo.out_neighbor_ranks(bi, 3) == [2, 4]
+    assert topo.out_neighbor_ranks(left, 3) == [2]
+    assert topo.out_neighbor_ranks(right, 3) == [4]
+    for G in (bi, left, right):
+        _check_stochastic(G)
+    w = topo.weight_matrix(bi)
+    assert w[3, 2] == pytest.approx(1 / 3)
+
+
+def test_ring_tiny():
+    assert topo.weight_matrix(topo.RingGraph(1)).tolist() == [[1.0]]
+    np.testing.assert_allclose(topo.weight_matrix(topo.RingGraph(2)), 0.5)
+
+
+def test_fully_connected():
+    G = topo.FullyConnectedGraph(5)
+    np.testing.assert_allclose(topo.weight_matrix(G), 0.2)
+
+
+def test_equivalence():
+    assert topo.IsTopologyEquivalent(topo.RingGraph(8), topo.RingGraph(8))
+    assert not topo.IsTopologyEquivalent(topo.RingGraph(8), topo.RingGraph(9))
+    assert not topo.IsTopologyEquivalent(topo.RingGraph(8), topo.StarGraph(8))
+    assert not topo.IsTopologyEquivalent(None, topo.RingGraph(8))
+
+
+def test_recv_send_weights():
+    G = topo.RingGraph(6, 0)
+    self_w, nbr_w = topo.GetRecvWeights(G, 2)
+    assert self_w == pytest.approx(1 / 3)
+    assert set(nbr_w) == {1, 3}
+    assert all(v == pytest.approx(1 / 3) for v in nbr_w.values())
+    self_w_s, nbr_w_s = topo.GetSendWeights(G, 2)
+    assert self_w_s == pytest.approx(1 / 3)
+    assert set(nbr_w_s) == {1, 3}
+
+
+# --------------------------- dynamic schedules ---------------------------
+
+
+def test_dynamic_one_peer_matches_phase_table():
+    G = topo.ExponentialTwoGraph(8)
+    phases = topo.dynamic_phase_table(G)
+    gens = [topo.GetDynamicOnePeerSendRecvRanks(G, r) for r in range(8)]
+    for step in range(10):
+        ph = phases[step % len(phases)]
+        for r in range(8):
+            send, recv = next(gens[r])
+            assert send == [ph.send_to[r]]
+            assert sorted(recv) == sorted(ph.recv_from(r))
+
+
+def test_one_peer_exp2_phases_are_shifts():
+    phases = topo.one_peer_exp2_phases(8)
+    assert len(phases) == 3  # offsets 1, 2, 4
+    for k, ph in enumerate(phases):
+        d = 2 ** k
+        assert ph.send_to == tuple((i + d) % 8 for i in range(8))
+        # every phase is a full permutation: everyone sends, everyone receives
+        assert sorted(ph.send_to) == list(range(8))
+
+
+def test_dynamic_one_peer_exp2_equals_dedicated_table():
+    """On Exp2 graphs, the generic walk reduces to pure shifts."""
+    G = topo.ExponentialTwoGraph(8)
+    generic = topo.dynamic_phase_table(G)
+    shifts = topo.one_peer_exp2_phases(8)
+    assert [p.send_to for p in generic] == [p.send_to for p in shifts]
+
+
+def test_exp2_machine_ranks():
+    gen = topo.GetExp2DynamicSendRecvMachineRanks(
+        world_size=16, local_size=4, self_rank=4, local_rank=0)
+    (s0, r0), (s1, r1) = next(gen), next(gen)
+    # machine 1 of 4: distances cycle 1, 2
+    assert s0 == [2] and r0 == [0]
+    assert s1 == [3] and r1 == [3]
+
+
+def test_inner_outer_ring_consistency():
+    world, local = 12, 4
+    gens = [topo.GetInnerOuterRingDynamicSendRecvRanks(world, local, r)
+            for r in range(world)]
+    for _ in range(8):
+        sends = {}
+        recvs = {}
+        for r in range(world):
+            s, v = next(gens[r])
+            sends[r] = s[0]
+            recvs[r] = v[0]
+        # send/recv tables must be mutually consistent permutations
+        assert sorted(sends.values()) == list(range(world))
+        for r in range(world):
+            assert recvs[sends[r]] == r
+
+
+def test_inner_outer_expo2_consistency():
+    world, local = 32, 8
+    gens = [topo.GetInnerOuterExpo2DynamicSendRecvRanks(world, local, r)
+            for r in range(world)]
+    for _ in range(16):
+        sends = {}
+        recvs = {}
+        for r in range(world):
+            s, v = next(gens[r])
+            sends[r] = s[0]
+            recvs[r] = v[0]
+        assert sorted(sends.values()) == list(range(world))
+        for r in range(world):
+            assert recvs[sends[r]] == r
+
+
+def test_phase_table_period_lcm():
+    # ring: everyone degree 2 -> period 2; star: center degree n-1, leaves 1
+    assert len(topo.dynamic_phase_table(topo.RingGraph(6, 0))) == 2
+    assert len(topo.dynamic_phase_table(topo.StarGraph(5))) == 4
+
+
+def test_weight_matrix_roundtrip():
+    w = topo.weight_matrix(topo.MeshGrid2DGraph(6))
+    G2 = topo.from_weight_matrix(w)
+    assert topo.IsTopologyEquivalent(topo.MeshGrid2DGraph(6), G2)
