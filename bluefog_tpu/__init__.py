@@ -84,6 +84,8 @@ from bluefog_tpu.ops.window import (  # noqa: F401
     turn_off_win_ops_with_associated_p,
 )
 
+from bluefog_tpu import optim  # noqa: F401  (Distributed*Optimizer family)
+
 from bluefog_tpu.utils.timeline import (  # noqa: F401
     timeline_start_activity,
     timeline_end_activity,

@@ -37,6 +37,7 @@ __all__ = [
     "allgather",
     "neighbor_allgather",
     "neighbor_allreduce",
+    "neighbor_allreduce_matrix",
     "dynamic_neighbor_allreduce",
     "pair_gossip",
     "hierarchical_neighbor_allreduce",
@@ -107,6 +108,32 @@ def neighbor_allreduce(x: jnp.ndarray, sched: StaticSchedule,
     (Exp2 over n ranks: log2(n) permutes, all riding ICI concurrently).
     """
     return _apply_rounds(x, sched, axis_name, _axis_index(axis_name))
+
+
+def neighbor_allreduce_matrix(x: jnp.ndarray, w: jnp.ndarray,
+                              sched: StaticSchedule,
+                              axis_name: str) -> jnp.ndarray:
+    """Neighbor averaging with a *traced* (n, n) weight matrix ``w``.
+
+    The permutation structure (which edges exist) is static and comes from
+    ``sched``; the weights are a runtime argument, so per-step weight mutation
+    — the reference's ``opt.self_weight / opt.neighbor_weights`` dynamic knobs
+    (README.rst:110-127) — changes no compiled code.  ``w[s, d]`` scales the
+    ``s -> d`` edge; ``w[i, i]`` is the self weight.
+    """
+    idx = _axis_index(axis_name)
+    dt = x.dtype
+    out = x * w[idx, idx].astype(dt)
+    for rnd in sched.rounds:
+        # Static per-round dst of each src (-1 = silent); silent ranks get a
+        # zero scale so the value they permute is masked out.
+        dst_of = np.full(sched.n, -1, dtype=np.int32)
+        for s, d in rnd.pairs:
+            dst_of[s] = d
+        dst = _const(dst_of, jnp.int32)[idx]
+        scale = jnp.where(dst >= 0, w[idx, jnp.maximum(dst, 0)], 0.0).astype(dt)
+        out = out + lax.ppermute(x * scale, axis_name, rnd.pairs)
+    return out
 
 
 def dynamic_neighbor_allreduce(x: jnp.ndarray, step: jnp.ndarray,

@@ -1,0 +1,35 @@
+"""Distributed optimizers over optax (reference layer L6,
+``bluefog/torch/optimizers.py``).
+
+Two levels:
+  * ``bluefog_tpu.optim.functional`` — pure per-rank step functions for use
+    inside your own ``shard_map``/``pjit`` training step (the TPU-idiomatic
+    path; zero host round-trips).
+  * The ``Distributed*Optimizer`` classes below — eager parity surface over
+    rank-major pytrees, matching the reference's eight factories.
+"""
+
+from bluefog_tpu.optim.functional import (  # noqa: F401
+    CommunicationType,
+    DistOptState,
+    awc_step,
+    atc_step,
+    gradient_allreduce_step,
+    dist_init,
+    make_combiner,
+    step_fn,
+)
+from bluefog_tpu.optim.optimizers import (  # noqa: F401
+    DistributedOptimizer,
+    DistributedGradientAllreduceOptimizer,
+    DistributedAllreduceOptimizer,
+    DistributedNeighborAllreduceOptimizer,
+    DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedAdaptWithCombineOptimizer,
+    DistributedAdaptThenCombineOptimizer,
+)
+from bluefog_tpu.optim.window_optimizers import (  # noqa: F401
+    DistributedWinPutOptimizer,
+    DistributedPullGetOptimizer,
+    DistributedPushSumOptimizer,
+)

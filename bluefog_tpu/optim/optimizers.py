@@ -1,0 +1,257 @@
+"""Distributed optimizer classes — the ``bf.Distributed*Optimizer`` surface.
+
+Parity target: the eight factory functions of reference
+``torch/optimizers.py:1180-1554``.  Where the reference wraps a
+``torch.optim.Optimizer`` instance and splices communication in via autograd
+hooks, these wrap an ``optax.GradientTransformation`` and compile the whole
+step — communication included — into one jitted ``shard_map`` program over the
+rank mesh.
+
+Data model: parameters/gradients are pytrees of *rank-major* arrays (leading
+dim == ``bf.size()``), the same single-controller convention as the eager op
+API in ``bluefog_tpu.basics``.  ``init`` returns optimizer state whose leaves
+are rank-major too (each rank carries its own moments), so the entire training
+loop stays device-resident.
+
+Usage::
+
+    opt = bf.optim.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    state = opt.init(params)
+    params, state = opt.step(params, grads, state)
+
+Dynamic topology (one-peer Exp2 etc.)::
+
+    opt = bf.optim.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1), use_dynamic_topology=True)
+    # phase auto-advances with state.step; no recompilation per step.
+
+Per-step weight mutation (reference README.rst:110-127 mutates
+``opt.self_weight``/``opt.neighbor_weights``): pass ``self_weight=...,
+src_weights=...`` kwargs to ``step`` — they become *traced* inputs, so
+changing them every iteration never recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from bluefog_tpu import basics
+from bluefog_tpu import topology as topology_util
+from bluefog_tpu.basics import LOCAL_AXIS, MACHINE_AXIS, RANK_AXIS
+from bluefog_tpu.ops import schedule as S
+from bluefog_tpu.optim import functional as F
+from bluefog_tpu.optim.functional import CommunicationType, DistOptState
+
+__all__ = [
+    "CommunicationType",
+    "DistributedOptimizer",
+    "DistributedGradientAllreduceOptimizer",
+    "DistributedAllreduceOptimizer",
+    "DistributedNeighborAllreduceOptimizer",
+    "DistributedHierarchicalNeighborAllreduceOptimizer",
+    "DistributedAdaptWithCombineOptimizer",
+    "DistributedAdaptThenCombineOptimizer",
+]
+
+
+class DistributedOptimizer:
+    """Generic decentralized optimizer wrapper (see module docstring).
+
+    Parameters
+    ----------
+    base : optax.GradientTransformation
+    communication_type : CommunicationType
+    order : "awc" | "atc" | "gradient_allreduce"
+    num_steps_per_communication : communicate every J-th step (local
+        aggregation, reference ``torch/optimizers.py:348-350``).
+    use_dynamic_topology : cycle the one-peer phase table of the active
+        topology (or ``phases`` if given) by step index.
+    phases : explicit list of ``topology.DynamicPhase`` for dynamic mode.
+    """
+
+    def __init__(self, base: optax.GradientTransformation,
+                 communication_type: CommunicationType =
+                 CommunicationType.neighbor_allreduce,
+                 *, order: str = "awc",
+                 num_steps_per_communication: int = 1,
+                 use_dynamic_topology: bool = False,
+                 phases=None):
+        if isinstance(communication_type, str):
+            communication_type = CommunicationType(communication_type)
+        self.base = base
+        self.communication_type = communication_type
+        self.order = order
+        self.num_steps_per_communication = int(num_steps_per_communication)
+        self.use_dynamic_topology = use_dynamic_topology
+        self.phases = phases
+        self._jitted = {}
+
+    # -- schedule resolution ------------------------------------------------
+    def _schedules(self):
+        ctx = basics._require_init()
+        hier = (self.communication_type ==
+                CommunicationType.hierarchical_neighbor_allreduce)
+        topo = ctx.machine_topology if hier else ctx.topology
+        weighted = ctx.is_machine_topo_weighted if hier else ctx.is_topo_weighted
+        if topo is None:
+            raise RuntimeError("no (machine) topology installed; call bf.init()")
+        n = topo.number_of_nodes()
+        if self.use_dynamic_topology:
+            key = ("opt_dyn", id(topo),
+                   None if self.phases is None
+                   else tuple(ph.pairs for ph in self.phases))
+            phases = self.phases
+            return None, ctx.static_schedule(key, lambda: S.compile_dynamic(
+                phases if phases is not None
+                else topology_util.dynamic_phase_table(topo), n))
+        key = ("opt_static", id(topo), weighted)
+        return ctx.static_schedule(
+            key, lambda: S.compile_static(topo, use_topo_weights=weighted)), None
+
+    def _build_step(self, with_weights: bool):
+        ctx = basics._require_init()
+        hier = (self.communication_type ==
+                CommunicationType.hierarchical_neighbor_allreduce)
+        sched, dyn = (None, None)
+        if self.communication_type in (
+                CommunicationType.neighbor_allreduce,
+                CommunicationType.hierarchical_neighbor_allreduce):
+            sched, dyn = self._schedules()
+        combine = F.make_combiner(
+            self.communication_type,
+            axis_name=RANK_AXIS if not hier else MACHINE_AXIS,
+            sched=sched, dyn_sched=dyn,
+            local_axis=LOCAL_AXIS if hier else None,
+            machine_axis=MACHINE_AXIS if hier else None)
+        inner = F.step_fn(self.order, self.base, combine,
+                          axis_name=RANK_AXIS,
+                          steps_per_comm=self.num_steps_per_communication)
+        mesh = ctx.hier_mesh if hier else ctx.mesh
+        spec = P((MACHINE_AXIS, LOCAL_AXIS)) if hier else P(RANK_AXIS)
+
+        def run(params, grads, state, *maybe_w):
+            local = jax.tree.map(lambda x: x[0], (params, grads, state))
+            p, g, s = local
+            s = DistOptState(s.base, s.step)
+            kw = {"weights": maybe_w[0]} if maybe_w else {}
+            new_p, new_s = inner(p, g, s, **kw)
+            return jax.tree.map(lambda x: x[None], (new_p, new_s))
+
+        n_w = 1 if with_weights else 0
+        return jax.jit(jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(spec, spec, spec) + (P(),) * n_w,
+            out_specs=(spec, spec)))
+
+    def _step_callable(self, with_weights: bool):
+        ctx = basics._require_init()
+        key = (id(ctx.topology), id(ctx.machine_topology), with_weights)
+        if self._jitted.get("key") != key:
+            self._jitted = {"key": key,
+                            "fn": self._build_step(with_weights)}
+        return self._jitted["fn"]
+
+    # -- public surface -----------------------------------------------------
+    def init(self, params) -> DistOptState:
+        """Build rank-major optimizer state for rank-major ``params``."""
+        ctx = basics._require_init()
+        hier = (self.communication_type ==
+                CommunicationType.hierarchical_neighbor_allreduce)
+        mesh = ctx.hier_mesh if hier else ctx.mesh
+        spec = P((MACHINE_AXIS, LOCAL_AXIS)) if hier else P(RANK_AXIS)
+
+        def run(params):
+            local = jax.tree.map(lambda x: x[0], params)
+            st = F.dist_init(self.base, local)
+            return jax.tree.map(lambda x: x[None], st)
+        placed = jax.tree.map(basics._place, params)
+        return jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=(spec,), out_specs=spec))(placed)
+
+    def step(self, params, grads, state: DistOptState, *,
+             self_weight: Optional[float] = None,
+             src_weights=None, dst_weights=None):
+        """One optimizer step; returns ``(new_params, new_state)``.
+
+        Weight kwargs override the schedule's weights for this step only
+        (traced — no recompilation when they change every iteration).
+        """
+        w = basics._weight_override_matrix(self_weight, src_weights, dst_weights)
+        placed = jax.tree.map(basics._place, (params, grads))
+        params, grads = placed
+        fn = self._step_callable(with_weights=w is not None)
+        if w is None:
+            return fn(params, grads, state)
+        return fn(params, grads, state, jnp.asarray(w, jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Parity factories (reference torch/optimizers.py:1180-1554)
+# ---------------------------------------------------------------------------
+
+def DistributedGradientAllreduceOptimizer(
+        base, *, num_steps_per_communication: int = 1) -> DistributedOptimizer:
+    """Horovod-equivalent synchronous gradient averaging
+    (reference ``:1376``)."""
+    return DistributedOptimizer(
+        base, CommunicationType.allreduce, order="gradient_allreduce",
+        num_steps_per_communication=num_steps_per_communication)
+
+
+def DistributedAllreduceOptimizer(
+        base, *, num_steps_per_communication: int = 1) -> DistributedOptimizer:
+    """Synchronous parameter consensus via global averaging
+    (reference ``:1301``)."""
+    return DistributedOptimizer(
+        base, CommunicationType.allreduce, order="awc",
+        num_steps_per_communication=num_steps_per_communication)
+
+
+def DistributedNeighborAllreduceOptimizer(
+        base, *, num_steps_per_communication: int = 1,
+        use_dynamic_topology: bool = False, phases=None) -> DistributedOptimizer:
+    """The flagship: AWC neighbor averaging over the active topology
+    (reference ``:1326``)."""
+    return DistributedOptimizer(
+        base, CommunicationType.neighbor_allreduce, order="awc",
+        num_steps_per_communication=num_steps_per_communication,
+        use_dynamic_topology=use_dynamic_topology, phases=phases)
+
+
+def DistributedHierarchicalNeighborAllreduceOptimizer(
+        base, *, num_steps_per_communication: int = 1,
+        use_dynamic_topology: bool = False, phases=None) -> DistributedOptimizer:
+    """Machine-level neighbor averaging: local ICI allreduce fused with
+    machine-graph exchange (reference ``:1352``)."""
+    return DistributedOptimizer(
+        base, CommunicationType.hierarchical_neighbor_allreduce, order="awc",
+        num_steps_per_communication=num_steps_per_communication,
+        use_dynamic_topology=use_dynamic_topology, phases=phases)
+
+
+def DistributedAdaptWithCombineOptimizer(
+        base, communication_type=CommunicationType.neighbor_allreduce,
+        *, num_steps_per_communication: int = 1,
+        use_dynamic_topology: bool = False, phases=None) -> DistributedOptimizer:
+    """AWC with a chosen communication type (reference ``:1497``)."""
+    return DistributedOptimizer(
+        base, communication_type, order="awc",
+        num_steps_per_communication=num_steps_per_communication,
+        use_dynamic_topology=use_dynamic_topology, phases=phases)
+
+
+def DistributedAdaptThenCombineOptimizer(
+        base, communication_type=CommunicationType.neighbor_allreduce,
+        *, num_steps_per_communication: int = 1,
+        use_dynamic_topology: bool = False, phases=None) -> DistributedOptimizer:
+    """ATC with a chosen communication type (reference ``:1426``)."""
+    return DistributedOptimizer(
+        base, communication_type, order="atc",
+        num_steps_per_communication=num_steps_per_communication,
+        use_dynamic_topology=use_dynamic_topology, phases=phases)
