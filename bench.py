@@ -1,0 +1,143 @@
+"""Headline benchmark: ResNet-50 decentralized training throughput.
+
+Mirrors the reference's protocol (``examples/pytorch_benchmark.py:38-44,
+228-256``): synthetic ImageNet data, N warmup batches, I iterations of B
+batches each, report mean images/sec.  The reference's headline number is
+4310.6 img/s on 16 V100s == ~269 img/s/GPU at batch 64 (BASELINE.md); here we
+measure img/s per TPU chip with the same per-device batch size, running the
+FULL decentralized training step (forward, backward, SGD+momentum update, and
+the dynamic one-peer Exp-2 neighbor averaging) over all available devices.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": "img/s/chip", "vs_baseline": ...}
+``vs_baseline`` is per-chip throughput over the reference's 269 img/s/GPU.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_PER_GPU = 4310.6 / 16  # img/s per V100, reference docs/performance.rst
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bluefog_tpu import topology
+    from bluefog_tpu.models import ResNet50
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu.optim import functional as F
+
+    devs = jax.devices()
+    n = len(devs)
+    on_tpu = jax.default_backend() == "tpu"
+    # Reference protocol scale on accelerators; tiny smoke scale on CPU.
+    batch = 64 if on_tpu else 2
+    image = 224 if on_tpu else 64
+    warmup, iters, batches_per_iter = (10, 10, 10) if on_tpu else (1, 2, 2)
+
+    mesh = Mesh(np.asarray(devs), ("dp",))
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+
+    images = jnp.zeros((n * batch, image, image, 3), jnp.bfloat16)
+    labels = jnp.zeros((n * batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images[:2])
+    params0, batch_stats0 = variables["params"], variables["batch_stats"]
+    rank_major = lambda t: jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t)
+    params, batch_stats = rank_major(params0), rank_major(batch_stats0)
+
+    base = optax.sgd(0.0125 * n, momentum=0.9)
+    dyn = S.compile_dynamic(topology.one_peer_exp2_phases(n), n) if n > 1 else None
+    combine = F.make_combiner(
+        F.CommunicationType.neighbor_allreduce if n > 1
+        else F.CommunicationType.empty, axis_name="dp", dyn_sched=dyn)
+
+    def train_step(params, batch_stats, state, images, labels):
+        p, bs, st = jax.tree.map(lambda x: x[0], (params, batch_stats, state))
+
+        def loss_fn(p):
+            logits, new_model_state = model.apply(
+                {"params": p, "batch_stats": bs}, images, train=True,
+                mutable=["batch_stats"])
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.take_along_axis(logp, labels[:, None], -1).mean()
+            return loss, new_model_state["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        new_p, new_st = F.atc_step(base, combine, p, grads, st)
+        return (jax.tree.map(lambda x: x[None], new_p),
+                jax.tree.map(lambda x: x[None], new_bs),
+                jax.tree.map(lambda x: x[None], new_st),
+                lax.pmean(loss, "dp"))
+
+    def init_state(params):
+        st = F.dist_init(base, jax.tree.map(lambda x: x[0], params))
+        return jax.tree.map(lambda x: x[None], st)
+
+    state = jax.jit(jax.shard_map(
+        init_state, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp")))(params)
+
+    step = jax.jit(
+        jax.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
+            out_specs=(P("dp"), P("dp"), P("dp"), P()),
+            check_vma=False),
+        donate_argnums=(0, 1, 2))
+
+    data_sharding = NamedSharding(mesh, P("dp"))
+    images = jax.device_put(images, data_sharding)
+    labels = jax.device_put(labels, data_sharding)
+
+    # Sync by fetching a scalar that depends on the UPDATED params: on some
+    # remote-tunnel platforms block_until_ready returns before the device
+    # finishes, so only a host read-back is a true barrier.
+    probe = jax.jit(lambda p, l: jnp.sum(
+        jax.tree_util.tree_leaves(p)[0].astype(jnp.float32)) * 0 + l)
+
+    def sync():
+        return float(probe(params, loss))
+
+    for _ in range(warmup):
+        params, batch_stats, state, loss = step(
+            params, batch_stats, state, images, labels)
+    sync()
+
+    rates = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(batches_per_iter):
+            params, batch_stats, state, loss = step(
+                params, batch_stats, state, images, labels)
+        sync()
+        dt = time.perf_counter() - t0
+        rates.append(n * batch * batches_per_iter / dt)
+
+    total = float(np.mean(rates))
+    per_chip = total / n
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_PER_GPU, 3),
+        "detail": {
+            "total_imgs_per_sec": round(total, 1),
+            "n_devices": n,
+            "per_device_batch": batch,
+            "image_size": image,
+            "backend": jax.default_backend(),
+            "stddev_pct": round(100 * float(np.std(rates)) / max(total, 1e-9), 2),
+            "optimizer": "ATC neighbor_allreduce (dynamic one-peer Exp2)"
+            if n > 1 else "local SGD (single chip)",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

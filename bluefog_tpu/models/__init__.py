@@ -1,0 +1,18 @@
+"""Model zoo (flax): benchmark and example workloads.
+
+Covers the reference's benchmark/example model needs
+(``examples/pytorch_benchmark.py`` uses torchvision resnet/vgg etc.;
+``examples/pytorch_mnist.py`` LeNet-ish CNN; optimization examples use
+linear/logistic models) with TPU-idiomatic flax implementations, plus a
+Transformer LM as the long-context workload consumer.
+"""
+
+from bluefog_tpu.models.resnet import (  # noqa: F401
+    ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
+)
+from bluefog_tpu.models.simple import (  # noqa: F401
+    LeNet5, MLP, LogisticRegression, LinearModel,
+)
+from bluefog_tpu.models.transformer import (  # noqa: F401
+    TransformerLM, TransformerConfig, local_attention,
+)

@@ -1,0 +1,67 @@
+"""Small models: LeNet-5, MLP, logistic regression.
+
+Parity: the reference's example/test workloads — LeNet for MNIST
+(``examples/pytorch_mnist.py``), logistic regression and linear problems for
+the optimization examples (``examples/pytorch_optimization.py``) and the
+optimizer test harness (``test/torch_optimizer_test.py:100-153``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["LeNet5", "MLP", "LogisticRegression", "LinearModel"]
+
+
+class LeNet5(nn.Module):
+    """Classic LeNet-5 for 28x28x1 inputs (MNIST)."""
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.asarray(x, self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype)(x))
+        return jnp.asarray(nn.Dense(self.num_classes, dtype=self.dtype)(x),
+                           jnp.float32)
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (256, 256)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.asarray(x.reshape((x.shape[0], -1)), self.dtype)
+        for f in self.features:
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return jnp.asarray(nn.Dense(self.num_classes, dtype=self.dtype)(x),
+                           jnp.float32)
+
+
+class LogisticRegression(nn.Module):
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        return nn.Dense(self.num_classes)(x.reshape((x.shape[0], -1)))
+
+
+class LinearModel(nn.Module):
+    out_features: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        return nn.Dense(self.out_features)(x)

@@ -1,0 +1,102 @@
+"""Decoder-only Transformer LM with pluggable attention.
+
+The reference predates LLM workloads (SURVEY §5.7: no sequence parallelism
+anywhere in its tree); this model exists so the framework's long-context
+machinery (``bluefog_tpu.parallel.ring_attention`` /
+``bluefog_tpu.parallel.ulysses``) has a first-class consumer: the
+``attn_impl`` hook receives ``(q, k, v, causal)`` per head-batch and may be a
+local attention, a ring attention over a mesh axis, or an all-to-all
+(Ulysses) head-parallel attention.
+
+MXU-friendly choices: bfloat16 activations, fused QKV projection, RMSNorm,
+static shapes throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TransformerLM", "TransformerConfig", "local_attention"]
+
+
+def local_attention(q, k, v, *, causal: bool = True):
+    """Plain single-device attention: ``(B, S, H, D)`` inputs."""
+    dt = q.dtype
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(dt)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class TransformerConfig:
+    def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
+                 embed_dim=512, mlp_ratio=4, max_seq_len=2048,
+                 dtype=jnp.bfloat16):
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.embed_dim = embed_dim
+        self.mlp_ratio = mlp_ratio
+        self.max_seq_len = max_seq_len
+        self.dtype = dtype
+
+
+class Block(nn.Module):
+    cfg: Any
+    attn_impl: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = cfg.num_heads
+        d = cfg.embed_dim // h
+        y = nn.RMSNorm(dtype=cfg.dtype)(x)
+        qkv = nn.Dense(3 * cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                       name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, S = q.shape[0], q.shape[1]
+        q, k, v = (t.reshape(B, S, h, d) for t in (q, k, v))
+        attn = self.attn_impl(q, k, v, causal=True)
+        attn = attn.reshape(B, S, cfg.embed_dim)
+        x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                         name="proj")(attn)
+        y = nn.RMSNorm(dtype=cfg.dtype)(x)
+        y = nn.Dense(cfg.mlp_ratio * cfg.embed_dim, use_bias=False,
+                     dtype=cfg.dtype, name="up")(y)
+        y = nn.gelu(y)
+        x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                         name="down")(y)
+        return x
+
+
+class TransformerLM(nn.Module):
+    cfg: Any
+    attn_impl: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True, positions=None):
+        """``positions``: optional (B, S) global position ids — required when
+        the sequence axis is sharded (each shard must embed its own offset)."""
+        cfg = self.cfg
+        attn = self.attn_impl or local_attention
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                     dtype=cfg.dtype, name="wte")(tokens)
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
+                       dtype=cfg.dtype, name="wpe")(positions)
+        x = x + pos
+        for i in range(cfg.num_layers):
+            x = Block(cfg, attn, name=f"block_{i}")(x)
+        x = nn.RMSNorm(dtype=cfg.dtype)(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                          name="lm_head")(x)
+        return logits

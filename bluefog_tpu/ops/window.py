@@ -207,7 +207,7 @@ def get_current_created_window_names() -> List[str]:
 # ---------------------------------------------------------------------------
 
 def _do_put(name: str, tensor: np.ndarray, dst_weights, require_mutex: bool,
-            accumulate: bool) -> None:
+            accumulate: bool, self_weight=None) -> None:
     try:
         win = _store.get(name)
     except KeyError:
@@ -235,25 +235,34 @@ def _do_put(name: str, tensor: np.ndarray, dst_weights, require_mutex: bool,
         finally:
             if mutex:
                 mutex.release()
+    if self_weight is not None:
+        # Self-scaling happens AFTER the edge sends so outgoing payloads carry
+        # the PRE-scaled associated-P mass (column-stochastic conservation:
+        # self_weight + sum of dst weights == 1 must hold on p_old).
+        sw = np.asarray(self_weight, dtype=float)
+        with win.lock:
+            shape = (-1,) + (1,) * len(win.shape)
+            win.main[:] = (tensor * sw.reshape(shape)).astype(win.dtype) \
+                if sw.ndim else tensor * win.dtype.type(float(sw))
+            if _store.associated_p_enabled:
+                win.p_main *= sw if sw.ndim else float(sw)
 
 
-def win_put_nonblocking(tensor, name: str, *, self_weight: float = None,
+def win_put_nonblocking(tensor, name: str, *, self_weight=None,
                         dst_weights=None, require_mutex: bool = False) -> int:
     """Scaled overwrite of each destination's buffer-for-me (async).
 
+    ``self_weight`` — scalar or per-rank (n,) vector — rescales my exposed
+    memory to ``self_weight * tensor`` (applied after the sends dispatch).
     With associated-P enabled, push-sum column-stochastic scaling applies: the
-    caller should pass ``dst_weights``/``self_weight`` summing to 1; self
-    memory is scaled by ``self_weight`` in place (reference
-    ``_DistributedPushSumOptimizer``, ``torch/optimizers.py:1026-1178``)."""
+    caller should pass ``dst_weights``/``self_weight`` summing to 1 per source
+    (reference ``_DistributedPushSumOptimizer``,
+    ``torch/optimizers.py:1026-1178``)."""
     t = _to_numpy(tensor)
-    win = _store.get(name)
-    if self_weight is not None:
-        with win.lock:
-            win.main[:] = t * win.dtype.type(self_weight)
-            if _store.associated_p_enabled:
-                win.p_main *= self_weight
+    _store.get(name)  # raise early on unknown window
     return _store.submit(
-        lambda: _do_put(name, t, dst_weights, require_mutex, accumulate=False))
+        lambda: _do_put(name, t, dst_weights, require_mutex,
+                        accumulate=False, self_weight=self_weight))
 
 
 def win_put(tensor, name: str, *, self_weight: float = None, dst_weights=None,
@@ -264,22 +273,21 @@ def win_put(tensor, name: str, *, self_weight: float = None, dst_weights=None,
     return True
 
 
-def win_accumulate_nonblocking(tensor, name: str, *, self_weight: float = None,
+def win_accumulate_nonblocking(tensor, name: str, *, self_weight=None,
                                dst_weights=None,
                                require_mutex: bool = False) -> int:
-    """Scaled add into each destination's buffer-for-me (async)."""
+    """Scaled add into each destination's buffer-for-me (async).
+
+    ``self_weight`` semantics as in ``win_put_nonblocking`` (scalar or (n,)
+    vector, applied after the sends so P mass is conserved)."""
     t = _to_numpy(tensor)
-    win = _store.get(name)
-    if self_weight is not None:
-        with win.lock:
-            win.main[:] = t * win.dtype.type(self_weight)
-            if _store.associated_p_enabled:
-                win.p_main *= self_weight
+    _store.get(name)  # raise early on unknown window
     return _store.submit(
-        lambda: _do_put(name, t, dst_weights, require_mutex, accumulate=True))
+        lambda: _do_put(name, t, dst_weights, require_mutex,
+                        accumulate=True, self_weight=self_weight))
 
 
-def win_accumulate(tensor, name: str, *, self_weight: float = None,
+def win_accumulate(tensor, name: str, *, self_weight=None,
                    dst_weights=None, require_mutex: bool = False) -> bool:
     win_wait(win_accumulate_nonblocking(
         tensor, name, self_weight=self_weight, dst_weights=dst_weights,

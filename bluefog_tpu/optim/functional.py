@@ -58,6 +58,7 @@ class CommunicationType(enum.Enum):
 class DistOptState(NamedTuple):
     base: optax.OptState
     step: jnp.ndarray            # int32 scalar, counts optimizer steps
+    acc: Optional[object] = None  # grad accumulator (gradient_allreduce, J>1)
 
 
 Combiner = Callable[..., jnp.ndarray]  # (x, *, step, weights) -> x
@@ -79,15 +80,37 @@ def make_combiner(
     ``weights`` is an optional traced (n, n) matrix overriding the static
     schedule's weights (None => baked-in weights).
     """
+    def _no_weights(weights, what):
+        if weights is not None:
+            raise ValueError(
+                f"per-step weight overrides are not supported for {what}; "
+                "they apply to (dynamic) neighbor_allreduce only")
+
     if comm == CommunicationType.empty:
-        return lambda x, step=None, weights=None: x
+        def _empty(x, step=None, weights=None):
+            _no_weights(weights, "CommunicationType.empty")
+            return x
+        return _empty
     if comm == CommunicationType.allreduce:
-        return lambda x, step=None, weights=None: C.allreduce(
-            x, axis_name, average=True)
+        def _ar(x, step=None, weights=None):
+            _no_weights(weights, "CommunicationType.allreduce")
+            return C.allreduce(x, axis_name, average=True)
+        return _ar
     if comm == CommunicationType.neighbor_allreduce:
         if dyn_sched is not None:
-            return lambda x, step, weights=None: C.dynamic_neighbor_allreduce(
-                x, step, dyn_sched, axis_name)
+            def _dyn(x, step, weights=None):
+                if weights is None:
+                    return C.dynamic_neighbor_allreduce(
+                        x, step, dyn_sched, axis_name)
+                # Weight override on a dynamic topology: same phase switching,
+                # weights looked up from the traced matrix per active edge.
+                branches = [
+                    partial(lambda ph, args: C.neighbor_allreduce_matrix(
+                        args[0], args[1], ph, axis_name), ph)
+                    for ph in dyn_sched.phases]
+                return lax.switch(step % dyn_sched.period, branches,
+                                  (x, weights))
+            return _dyn
         assert sched is not None, "static neighbor_allreduce needs a schedule"
 
         def _nbr(x, step=None, weights=None):
@@ -99,12 +122,18 @@ def make_combiner(
         assert local_axis and machine_axis, \
             "hierarchical combine needs local/machine axis names"
         if dyn_sched is not None:
-            return lambda x, step, weights=None: \
-                C.dynamic_hierarchical_neighbor_allreduce(
+            def _hdyn(x, step, weights=None):
+                _no_weights(weights, "hierarchical_neighbor_allreduce")
+                return C.dynamic_hierarchical_neighbor_allreduce(
                     x, step, dyn_sched, local_axis, machine_axis)
+            return _hdyn
         assert sched is not None
-        return lambda x, step=None, weights=None: \
-            C.hierarchical_neighbor_allreduce(x, sched, local_axis, machine_axis)
+
+        def _hier(x, step=None, weights=None):
+            _no_weights(weights, "hierarchical_neighbor_allreduce")
+            return C.hierarchical_neighbor_allreduce(
+                x, sched, local_axis, machine_axis)
+        return _hier
     raise ValueError(f"unknown communication type {comm}")
 
 
@@ -157,20 +186,37 @@ def gradient_allreduce_step(base: optax.GradientTransformation,
     """Horovod-style synchronous gradient averaging
     (reference ``_DistributedOptimizer``, ``torch/optimizers.py:166-295``).
 
-    With ``steps_per_comm > 1`` gradients are applied locally on silent steps
-    (matching the reference's delayed-allreduce local-aggregation counters).
+    With ``steps_per_comm > 1`` gradients accumulate locally on silent steps
+    and the J-step aggregate is averaged and applied on communicating steps
+    only — every rank always applies the identical update, preserving the
+    replica-identical invariant (the reference's delayed-allreduce counters,
+    ``torch/optimizers.py:348-383``).
     """
     def comm(g):
         return jax.tree.map(
             lambda x: C.allreduce(x, axis_name, average=True), g)
     if steps_per_comm == 1:
         avg = comm(grads)
-    else:
-        avg = lax.cond(state.step % steps_per_comm == 0,
-                       comm, lambda g: g, grads)
-    updates, base_state = base.update(avg, state.base, params)
-    new_params = optax.apply_updates(params, updates)
-    return new_params, DistOptState(base_state, state.step + 1)
+        updates, base_state = base.update(avg, state.base, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, DistOptState(base_state, state.step + 1)
+
+    acc = state.acc if state.acc is not None else \
+        jax.tree.map(jnp.zeros_like, grads)
+    acc = jax.tree.map(lambda a, g: a + g, acc, grads)
+
+    def communicate(_):
+        avg = comm(acc)
+        updates, base_state = base.update(avg, state.base, params)
+        return (optax.apply_updates(params, updates), base_state,
+                jax.tree.map(jnp.zeros_like, acc))
+
+    def silent(_):
+        return params, state.base, acc
+
+    new_params, base_state, new_acc = lax.cond(
+        (state.step + 1) % steps_per_comm == 0, communicate, silent, None)
+    return new_params, DistOptState(base_state, state.step + 1, new_acc)
 
 
 def dist_init(base: optax.GradientTransformation, params) -> DistOptState:

@@ -105,7 +105,7 @@ class DistributedOptimizer:
         if self.use_dynamic_topology:
             key = ("opt_dyn", id(topo),
                    None if self.phases is None
-                   else tuple(ph.pairs for ph in self.phases))
+                   else tuple(tuple(ph.pairs) for ph in self.phases))
             phases = self.phases
             return None, ctx.static_schedule(key, lambda: S.compile_dynamic(
                 phases if phases is not None
@@ -138,7 +138,6 @@ class DistributedOptimizer:
         def run(params, grads, state, *maybe_w):
             local = jax.tree.map(lambda x: x[0], (params, grads, state))
             p, g, s = local
-            s = DistOptState(s.base, s.step)
             kw = {"weights": maybe_w[0]} if maybe_w else {}
             new_p, new_s = inner(p, g, s, **kw)
             return jax.tree.map(lambda x: x[None], (new_p, new_s))
@@ -152,10 +151,9 @@ class DistributedOptimizer:
     def _step_callable(self, with_weights: bool):
         ctx = basics._require_init()
         key = (id(ctx.topology), id(ctx.machine_topology), with_weights)
-        if self._jitted.get("key") != key:
-            self._jitted = {"key": key,
-                            "fn": self._build_step(with_weights)}
-        return self._jitted["fn"]
+        if key not in self._jitted:
+            self._jitted[key] = self._build_step(with_weights)
+        return self._jitted[key]
 
     # -- public surface -----------------------------------------------------
     def init(self, params) -> DistOptState:

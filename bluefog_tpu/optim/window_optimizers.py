@@ -133,10 +133,12 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
         if (t + 1) % self.num_steps_per_communication == 0:
             # Publish my new parameters as the window's exposed memory (the
             # dst_weights={} put touches no edges — it only refreshes main).
-            for name, leaf in zip(self._names,
-                                  jax.tree_util.tree_leaves(new_params)):
-                W.win_put_nonblocking(np.asarray(leaf), name,
-                                      self_weight=1.0, dst_weights={})
+            publish = [W.win_put_nonblocking(np.asarray(leaf), name,
+                                             self_weight=1.0, dst_weights={})
+                       for name, leaf in zip(
+                           self._names, jax.tree_util.tree_leaves(new_params))]
+            for h in publish:
+                W.win_wait(h)
             handles = [W.win_get_nonblocking(name, src_weights=src_weights,
                                              require_mutex=require_mutex)
                        for name in self._names]
@@ -200,21 +202,14 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         collected = []
         for name, leaf in zip(self._names,
                               jax.tree_util.tree_leaves(new_params)):
-            t = np.asarray(leaf)
-            win = W._store.get(name)
-            # Accumulate FIRST so out-edges carry w * p_old (column-stochastic
-            # mass conservation: self_share + sum_out w == 1 must hold on the
-            # PRE-scaled p), then self-scale main/p, then collect.
+            # win_accumulate applies self_weight AFTER the edge sends, so the
+            # out-edges carry w * p_old and per-source mass
+            # (self_share + sum_out w == 1) is conserved — the push-sum
+            # column-stochastic invariant.
             h = W.win_accumulate_nonblocking(
-                t, name, dst_weights=dst_weights, require_mutex=require_mutex)
+                np.asarray(leaf), name, self_weight=self_share,
+                dst_weights=dst_weights, require_mutex=require_mutex)
             W.win_wait(h)
-            # Column-stochastic self-scaling: main <- self_share * x, with the
-            # per-rank share vector (win_put's scalar self_weight broadcast is
-            # not enough for irregular graphs).
-            with win.lock:
-                shape = (-1,) + (1,) * (t.ndim - 1)
-                win.main[:] = t * self_share.reshape(shape).astype(win.dtype)
-                win.p_main *= self_share
             collected.append(W.win_update_then_collect(
                 name, require_mutex=require_mutex))
         treedef = jax.tree_util.tree_structure(params)

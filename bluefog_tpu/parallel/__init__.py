@@ -1,0 +1,16 @@
+"""Parallelism strategies beyond decentralized DP.
+
+The reference is data-parallel-only (SURVEY §2.3); this package carries the
+framework's first-class long-context / distributed-scale machinery:
+
+  * ``ring_attention`` — exact attention over sequence-sharded K/V rotating on
+    a ``ppermute`` ring (memory O(S/n) per device).
+  * ``ulysses_attention`` — all-to-all head-parallel sequence parallelism.
+"""
+
+from bluefog_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_impl,
+)
+from bluefog_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention, ulysses_attention_impl,
+)

@@ -1,0 +1,95 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+Long-context is absent from the reference (SURVEY §5.7) but first-class here:
+the ring schedule is the same one-peer ``ppermute`` primitive as the
+decentralized gossip ops (``mpi_controller.cc:418-454`` is the reference's
+structural cousin), applied to K/V blocks instead of parameters.
+
+Algorithm (blockwise online softmax, a la Ring Attention / FlashAttention
+accumulation): each device owns a sequence chunk of Q, K, V.  For ``n`` steps,
+compute the partial attention of the local Q block against the currently-held
+K/V block while accumulating a numerically-stable running (max, sum, output)
+triple, then rotate K/V one hop around the ring.  Communication rides ICI
+concurrently with the block matmuls; memory is O(S/n) per device, so sequence
+length scales linearly with the mesh axis.
+
+All inputs/outputs are per-device blocks ``(B, S_local, H, D)`` — call inside
+``shard_map`` with the sequence axis sharded over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ring_attention_impl"]
+
+_NEG_INF = -1e30
+
+
+def _block_step(q, k_blk, v_blk, o, m, l, q_pos, k_pos, *, causal, scale):
+    """One blockwise-attention accumulation step (all float32 accumulators).
+
+    q: (B, Sq, H, D); k_blk/v_blk: (B, Sk, H, D); o: (B, Sq, H, D) f32;
+    m, l: (B, Sq, H) f32 running max / normalizer.
+    """
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k_blk).astype(jnp.float32) * scale
+    if causal:
+        mask = (k_pos[None, None, None, :] <= q_pos[None, :, None, None])
+        s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # Guard fully-masked rows: keep them finite (l stays 0 there).
+    m_new = jnp.maximum(m_new, _NEG_INF / 2)
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bqhk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk).astype(jnp.float32)
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
+    """Exact attention with K/V rotating around the ``axis_name`` ring.
+
+    Per-device blocks ``(B, S_local, H, D)``; the global sequence is the
+    concatenation of blocks in axis-index order.  Returns the local output
+    block, bit-for-bit a blockwise-stable evaluation of full attention.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = me * S + jnp.arange(S)
+    # Accumulators enter the loop carry device-varying (they mix with
+    # ppermuted data inside), so mark the fresh constants as varying too.
+    o = lax.pvary(jnp.zeros((B, S, H, D), jnp.float32), (axis_name,))
+    m = lax.pvary(jnp.full((B, S, H), _NEG_INF, jnp.float32), (axis_name,))
+    l = lax.pvary(jnp.zeros((B, S, H), jnp.float32), (axis_name,))
+
+    def body(t, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (me - t) % n                      # who produced this K/V block
+        k_pos = src * S + jnp.arange(S)
+        o, m, l = _block_step(q, k_blk, v_blk, o, m, l, q_pos, k_pos,
+                              causal=causal, scale=scale)
+        # Rotate AFTER consuming; skip the final (wasted) hop.
+        k_blk, v_blk = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
+        return o, m, l, k_blk, v_blk
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o, m, l, k, v))
+    l = jnp.maximum(l, 1e-20)  # fully-masked rows (none if causal & aligned)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_impl(axis_name: str):
+    """An ``attn_impl`` for ``models.TransformerLM``: same signature as
+    ``models.local_attention`` but sequence-parallel over ``axis_name``."""
+    return partial(ring_attention, axis_name=axis_name)

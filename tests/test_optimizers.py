@@ -175,6 +175,45 @@ def test_step_weight_mutation_no_recompile():
     assert global_mse(params["w"], A, y) < 0.05
 
 
+def test_explicit_phases_dynamic_optimizer():
+    """phases= path: pass a custom phase table (regression: unhashable key)."""
+    bf.init(lambda: topo.ExponentialGraph(N))
+    A, y, _ = make_problem()
+    phases = topo.one_peer_exp2_phases(N)
+    opt = bf.optim.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), use_dynamic_topology=True, phases=phases)
+    params, _ = run_training(opt, A, y, steps=150)
+    assert global_mse(params["w"], A, y) < 0.05
+
+
+def test_gradient_allreduce_local_aggregation_keeps_replicas_identical():
+    """J>1 gradient averaging: accumulate locally, apply the identical
+    averaged aggregate on every rank (regression: replica drift)."""
+    bf.init(lambda: topo.ExponentialGraph(N))
+    A, y, _ = make_problem()
+    opt = bf.optim.DistributedGradientAllreduceOptimizer(
+        optax.sgd(0.05), num_steps_per_communication=3)
+    params, _ = run_training(opt, A, y, steps=150, broadcast_init=True)
+    w = np.asarray(params["w"])
+    spread = np.abs(w - w[0]).max()
+    assert spread < 1e-5, f"replicas drifted: {spread}"
+    assert global_mse(params["w"], A, y) < 0.05
+
+
+def test_weight_override_rejected_for_allreduce():
+    """Weight kwargs only make sense for neighbor averaging (regression:
+    silently discarded)."""
+    bf.init(lambda: topo.ExponentialGraph(N))
+    A, y, _ = make_problem()
+    opt = bf.optim.DistributedAllreduceOptimizer(optax.sgd(0.05))
+    params = {"w": jnp.zeros((N, DIM, 1))}
+    state = opt.init(params)
+    grads = {"w": jnp.zeros((N, DIM, 1))}
+    w_mat = np.eye(N)
+    with pytest.raises(ValueError, match="not supported"):
+        opt.step(params, grads, state, src_weights=w_mat)
+
+
 def test_win_put_optimizer_converges():
     bf.init(lambda: topo.ExponentialGraph(N))
     A, y, _ = make_problem()

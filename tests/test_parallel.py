@@ -1,0 +1,99 @@
+"""Sequence-parallel attention tests: ring / Ulysses vs dense reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bluefog_tpu.models.transformer import local_attention
+from bluefog_tpu.parallel import ring_attention, ulysses_attention
+
+B, S, H, D = 2, 32, 8, 16
+NDEV = 8
+
+
+@pytest.fixture
+def qkv():
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def seq_sharded(fn, devices):
+    mesh = Mesh(np.asarray(devices), ("sp",))
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp")))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(devices, qkv, causal):
+    q, k, v = qkv
+    ref = local_attention(q, k, v, causal=causal)
+    out = seq_sharded(
+        lambda a, b, c: ring_attention(a, b, c, axis_name="sp", causal=causal),
+        devices)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_dense(devices, qkv, causal):
+    q, k, v = qkv
+    ref = local_attention(q, k, v, causal=causal)
+    out = seq_sharded(
+        lambda a, b, c: ulysses_attention(a, b, c, axis_name="sp",
+                                          causal=causal),
+        devices)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_grad_matches_dense(devices, qkv):
+    """Differentiability: ring attention must backprop like dense."""
+    q, k, v = qkv
+
+    def loss_dense(q, k, v):
+        return jnp.sum(local_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ring(q, k, v):
+        mesh = Mesh(np.asarray(devices), ("sp",))
+        out = jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, axis_name="sp"),
+            mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"))(q, k, v)
+        return jnp.sum(out ** 2)
+
+    g_ref = jax.grad(loss_dense)(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring))(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_transformer_with_ring_attention(devices):
+    """End-to-end: TransformerLM forward with sequence-parallel attention
+    equals the single-device model."""
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+    from bluefog_tpu.parallel import ring_attention_impl
+
+    cfg = TransformerConfig(vocab_size=128, num_layers=2, num_heads=4,
+                            embed_dim=64, max_seq_len=64, dtype=jnp.float32)
+    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 128, (2, 64)))
+    model_ref = TransformerLM(cfg)
+    params = model_ref.init(jax.random.PRNGKey(0), tokens)
+    ref = model_ref.apply(params, tokens)
+
+    mesh = Mesh(np.asarray(devices), ("sp",))
+    model_sp = TransformerLM(cfg, attn_impl=ring_attention_impl("sp"))
+    positions = jnp.arange(64)[None, :].repeat(2, axis=0)
+
+    def fwd(tokens, positions):
+        return model_sp.apply(params, tokens, positions=positions)
+
+    out = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"), check_vma=False))(tokens, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
