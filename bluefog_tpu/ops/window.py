@@ -206,13 +206,25 @@ def get_current_created_window_names() -> List[str]:
 # One-sided ops
 # ---------------------------------------------------------------------------
 
-def _do_put(name: str, tensor: np.ndarray, dst_weights, require_mutex: bool,
-            accumulate: bool, self_weight=None) -> None:
+def _validate_edges(edges: Dict[tuple, float], nbrs_of: List[List[int]],
+                    *, peer_is_src: bool, op: str) -> None:
+    """Reject edges absent from the window's topology — a put/get naming a
+    non-neighbor is a caller bug (the reference's MPI graph communicator
+    errors likewise), not something to drop silently."""
+    for (r, peer) in edges:
+        if peer not in nbrs_of[r]:
+            kind = "in-neighbor" if peer_is_src else "out-neighbor"
+            raise ValueError(
+                f"{op}: rank {peer} is not an {kind} of rank {r} in the "
+                "window's topology")
+
+
+def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
+            require_mutex: bool, accumulate: bool, self_weight=None) -> None:
     try:
         win = _store.get(name)
     except KeyError:
         return  # window freed after dispatch; put becomes a no-op
-    edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0)
     for (src, dst), w in edges.items():
         payload = tensor[src] * win.dtype.type(w)
         mutex = win.mutexes[dst] if require_mutex else None
@@ -259,9 +271,11 @@ def win_put_nonblocking(tensor, name: str, *, self_weight=None,
     (reference ``_DistributedPushSumOptimizer``,
     ``torch/optimizers.py:1026-1178``)."""
     t = _to_numpy(tensor)
-    _store.get(name)  # raise early on unknown window
+    win = _store.get(name)  # raise early on unknown window
+    edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0)
+    _validate_edges(edges, win.out_nbrs, peer_is_src=False, op="win_put")
     return _store.submit(
-        lambda: _do_put(name, t, dst_weights, require_mutex,
+        lambda: _do_put(name, t, edges, require_mutex,
                         accumulate=False, self_weight=self_weight))
 
 
@@ -281,9 +295,12 @@ def win_accumulate_nonblocking(tensor, name: str, *, self_weight=None,
     ``self_weight`` semantics as in ``win_put_nonblocking`` (scalar or (n,)
     vector, applied after the sends so P mass is conserved)."""
     t = _to_numpy(tensor)
-    _store.get(name)  # raise early on unknown window
+    win = _store.get(name)  # raise early on unknown window
+    edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0)
+    _validate_edges(edges, win.out_nbrs, peer_is_src=False,
+                    op="win_accumulate")
     return _store.submit(
-        lambda: _do_put(name, t, dst_weights, require_mutex,
+        lambda: _do_put(name, t, edges, require_mutex,
                         accumulate=True, self_weight=self_weight))
 
 
@@ -295,13 +312,11 @@ def win_accumulate(tensor, name: str, *, self_weight=None,
     return True
 
 
-def _do_get(name: str, src_weights, require_mutex: bool) -> None:
+def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
     try:
         win = _store.get(name)
     except KeyError:
         return  # window freed after dispatch; get becomes a no-op
-    edges = _resolve_edge_weights(src_weights, win.in_nbrs, 1.0,
-                                  peer_is_src=True)
     for (dst, src), w in edges.items():
         mutex = win.mutexes[src] if require_mutex else None
         if mutex:
@@ -322,7 +337,11 @@ def _do_get(name: str, src_weights, require_mutex: bool) -> None:
 def win_get_nonblocking(name: str, *, src_weights=None,
                         require_mutex: bool = False) -> int:
     """Pull ``w * main[src]`` from each in-neighbor into my staging (async)."""
-    return _store.submit(lambda: _do_get(name, src_weights, require_mutex))
+    win = _store.get(name)
+    edges = _resolve_edge_weights(src_weights, win.in_nbrs, 1.0,
+                                  peer_is_src=True)
+    _validate_edges(edges, win.in_nbrs, peer_is_src=True, op="win_get")
+    return _store.submit(lambda: _do_get(name, edges, require_mutex))
 
 
 def win_get(name: str, *, src_weights=None, require_mutex: bool = False) -> bool:

@@ -1,0 +1,188 @@
+"""One-sided window op tests.
+
+Case inventory mirrors reference ``test/torch_win_ops_test.py``: create/sync/
+free (:64), update with weights (:141-244), put/get/accumulate with given
+destinations (:245-704), versions (:286,575), mutex semantics (:705-779), and
+the randomized associated-P push-sum invariant (:780-863).
+"""
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as topo
+
+N = 8
+
+
+def setup_ring():
+    bf.init(lambda: topo.RingGraph(N))  # bidirectional ring: indeg 2
+
+
+def rank_major(seed=0, shape=(N, 5)):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def test_win_create_update_free():
+    setup_ring()
+    x = rank_major()
+    assert bf.win_create(x, "w")
+    assert not bf.win_create(x, "w")  # duplicate name
+    assert bf.get_current_created_window_names() == ["w"]
+    out = np.asarray(bf.win_update("w"))
+    # Fresh window, no puts: staging holds neighbors' initial values, so
+    # update = uniform neighbor average of the initial tensors.
+    expect = np.stack([
+        (x[r] + x[(r - 1) % N] + x[(r + 1) % N]) / 3.0 for r in range(N)])
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    assert bf.win_free("w")
+    assert bf.get_current_created_window_names() == []
+    assert not bf.win_free("w")
+
+
+def test_set_topology_fails_with_windows():
+    """Reference: basics.py set_topology refuses while windows exist
+    (``torch_basics_test.py:63-93``)."""
+    setup_ring()
+    bf.win_create(rank_major(), "w")
+    with pytest.raises(RuntimeError, match="windows exist"):
+        bf.set_topology(topo.ExponentialGraph(N))
+    bf.win_free("w")
+    assert bf.set_topology(topo.ExponentialGraph(N))
+
+
+def test_win_put_then_update():
+    setup_ring()
+    x = rank_major(1)
+    bf.win_create(x, "w", zero_init=True)
+    two = 2.0 * x
+    bf.win_put(two, "w")  # every rank pushes 2x to its out-neighbors
+    out = np.asarray(bf.win_update("w", self_weight=0.5,
+                                   neighbor_weights={(r, s): 0.25
+                                                     for r in range(N)
+                                                     for s in [(r - 1) % N,
+                                                               (r + 1) % N]}))
+    expect = np.stack([
+        0.5 * x[r] + 0.25 * two[(r - 1) % N] + 0.25 * two[(r + 1) % N]
+        for r in range(N)])
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    bf.win_free()
+
+
+def test_win_put_partial_destinations():
+    """dst_weights dict restricts and scales destinations
+    (reference 'given destinations' cases)."""
+    setup_ring()
+    x = np.ones((N, 3), np.float32)
+    bf.win_create(x, "w", zero_init=True)
+    # Each rank sends only clockwise (to rank+1), weight 0.5.
+    dst = {((r), (r + 1) % N): 0.5 for r in range(N)}
+    bf.win_put(x, "w", dst_weights=dst)
+    out = np.asarray(bf.win_update("w", self_weight=1.0,
+                                   neighbor_weights={(r, s): 1.0
+                                                     for r in range(N)
+                                                     for s in [(r - 1) % N,
+                                                               (r + 1) % N]}))
+    # self (1.0) + 0.5 from counter-clockwise neighbor + 0 from clockwise.
+    np.testing.assert_allclose(out, np.full((N, 3), 1.5), rtol=1e-5)
+    bf.win_free()
+
+
+def test_win_accumulate():
+    setup_ring()
+    x = np.ones((N, 2), np.float32)
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_accumulate(x, "w")
+    bf.win_accumulate(x, "w")  # staging for each in-edge now holds 2.0
+    out = np.asarray(bf.win_update("w", self_weight=1.0,
+                                   neighbor_weights={(r, s): 1.0
+                                                     for r in range(N)
+                                                     for s in [(r - 1) % N,
+                                                               (r + 1) % N]}))
+    np.testing.assert_allclose(out, np.full((N, 2), 1.0 + 2.0 + 2.0),
+                               rtol=1e-5)
+    bf.win_free()
+
+
+def test_win_get():
+    setup_ring()
+    x = rank_major(2)
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_get("w", src_weights={(r, s): 0.5 for r in range(N)
+                                 for s in [(r - 1) % N, (r + 1) % N]})
+    out = np.asarray(bf.win_update("w", self_weight=1.0,
+                                   neighbor_weights={(r, s): 1.0
+                                                     for r in range(N)
+                                                     for s in [(r - 1) % N,
+                                                               (r + 1) % N]}))
+    expect = np.stack([
+        x[r] + 0.5 * x[(r - 1) % N] + 0.5 * x[(r + 1) % N] for r in range(N)])
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    bf.win_free()
+
+
+def test_win_versions():
+    setup_ring()
+    x = rank_major(3)
+    bf.win_create(x, "w")
+    assert bf.get_win_version("w", 0) == {(N - 1): 0, 1: 0}
+    bf.win_put(x, "w")
+    assert bf.get_win_version("w", 0) == {(N - 1): 1, 1: 1}
+    bf.win_put(x, "w")
+    assert bf.get_win_version("w", 0) == {(N - 1): 2, 1: 2}
+    bf.win_update("w")  # resets staleness counters
+    assert bf.get_win_version("w", 0) == {(N - 1): 0, 1: 0}
+    bf.win_free()
+
+
+def test_win_mutex_excludes_writers():
+    """Holding a rank's mutex blocks require_mutex puts to it until release
+    (reference ``test_win_mutex_full:705``)."""
+    import threading
+    import time
+    setup_ring()
+    x = np.ones((N, 2), np.float32)
+    bf.win_create(x, "w", zero_init=True)
+    progressed = threading.Event()
+
+    def writer():
+        bf.win_put(x, "w", require_mutex=True)
+        progressed.set()
+
+    with bf.win_mutex("w", ranks=list(range(N))):
+        t = threading.Thread(target=writer)
+        t.start()
+        time.sleep(0.15)
+        assert not progressed.is_set(), "put proceeded despite held mutex"
+    t.join(timeout=5)
+    assert progressed.is_set()
+    bf.win_free()
+
+
+def test_associated_p_push_sum_invariant():
+    """Randomized push-sum: after K column-stochastic accumulate+collect
+    rounds, sum(p) == n and x/p converges to the initial average
+    (reference ``torch_win_ops_test.py:780-863``)."""
+    bf.init(lambda: topo.RingGraph(N, connect_style=2))  # ring, send to i+1
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        x = rank_major(4, (N, 3))
+        target = x.mean(axis=0)
+        bf.win_create(x, "w", zero_init=True)
+        cur = x.copy()
+        self_share = 0.5  # directed ring: 1 out-neighbor
+        # Directed-ring mixing rate is |0.5 + 0.5 e^{2pi i/8}| ~= 0.92, so
+        # ~150 rounds reach 1e-5 consensus error.
+        for _ in range(150):
+            bf.win_accumulate(
+                cur, "w", self_weight=self_share,
+                dst_weights={(r, (r + 1) % N): 0.5 for r in range(N)})
+            cur = np.asarray(bf.win_update_then_collect("w"))
+            p = np.asarray(bf.win_associated_p("w"))
+            assert abs(p.sum() - N) < 1e-6, "P mass not conserved"
+        debiased = cur / p[:, None]
+        np.testing.assert_allclose(
+            debiased, np.tile(target, (N, 1)), rtol=1e-3, atol=1e-3)
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
+        bf.win_free()
