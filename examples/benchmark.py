@@ -1,0 +1,161 @@
+"""Throughput benchmark harness — the reference's headline experiment.
+
+Parity: ``examples/pytorch_benchmark.py`` (model choice, synthetic data,
+--dist-optimizer grid, 10-warmup / num-iters x num-batches-per-iter protocol,
+mean +- 1.96 sigma reporting).  Runs the FULL decentralized training step over
+every visible device.
+
+    python examples/benchmark.py --model resnet50 --batch-size 64 \
+        --dist-optimizer neighbor_allreduce
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet18", "resnet34", "resnet50", "resnet101",
+                             "resnet152", "lenet", "transformer"])
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-warmup-batches", type=int, default=10)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--dist-optimizer", default="neighbor_allreduce",
+                    choices=["neighbor_allreduce", "allreduce",
+                             "gradient_allreduce", "hierarchical",
+                             "win_put", "empty"])
+    ap.add_argument("--atc", action="store_true",
+                    help="adapt-then-combine order (default AWC)")
+    ap.add_argument("--dynamic", action="store_true",
+                    help="dynamic one-peer Exp2 topology")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import models
+    from bluefog_tpu.optim import CommunicationType
+
+    bf.init(local_size=None if args.dist_optimizer != "hierarchical" else
+            max(1, len(jax.devices()) // 2))
+    n = bf.size()
+
+    if args.model.startswith("resnet"):
+        model = getattr(models, args.model.replace("resnet", "ResNet"))(
+            num_classes=1000, dtype=jnp.bfloat16)
+        data = jnp.zeros((n, args.batch_size, args.image_size,
+                          args.image_size, 3), jnp.bfloat16)
+        labels = jnp.zeros((n, args.batch_size), jnp.int32)
+        has_bn = True
+    elif args.model == "lenet":
+        model = models.LeNet5()
+        data = jnp.zeros((n, args.batch_size, 28, 28, 1))
+        labels = jnp.zeros((n, args.batch_size), jnp.int32)
+        has_bn = False
+    else:
+        cfg = models.TransformerConfig(max_seq_len=args.seq_len)
+        model = models.TransformerLM(cfg)
+        data = jnp.zeros((n, args.batch_size, args.seq_len), jnp.int32)
+        labels = None
+        has_bn = False
+
+    sample = data[0][:2]
+    variables = model.init(jax.random.PRNGKey(0), sample)
+    rank_major = lambda t: jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t)
+
+    comm = {"neighbor_allreduce": CommunicationType.neighbor_allreduce,
+            "allreduce": CommunicationType.allreduce,
+            "hierarchical": CommunicationType.hierarchical_neighbor_allreduce,
+            "empty": CommunicationType.empty}.get(args.dist_optimizer)
+    base = optax.sgd(0.0125 * n, momentum=0.9)
+    if args.dist_optimizer == "gradient_allreduce":
+        opt = bf.optim.DistributedGradientAllreduceOptimizer(base)
+    elif args.dist_optimizer == "win_put":
+        opt = bf.optim.DistributedWinPutOptimizer(base)
+    else:
+        cls = (bf.optim.DistributedAdaptThenCombineOptimizer if args.atc
+               else bf.optim.DistributedAdaptWithCombineOptimizer)
+        opt = cls(base, comm, use_dynamic_topology=args.dynamic)
+
+    if has_bn:
+        params = rank_major(variables["params"])
+        bstats = rank_major(variables["batch_stats"])
+
+        def loss_fn(p, bs, x, y):
+            logits, new = model.apply({"params": p, "batch_stats": bs},
+                                      x, train=True, mutable=["batch_stats"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(), new["batch_stats"]
+
+        vgrad = jax.jit(jax.vmap(jax.value_and_grad(loss_fn, has_aux=True)))
+
+        def one_batch(params, bstats, state):
+            (_, bstats), grads = vgrad(params, bstats, data, labels)
+            params, state = opt.step(params, grads, state)
+            return params, bstats, state
+    else:
+        params = rank_major(variables["params"] if "params" in variables
+                            else variables)
+        if args.model == "transformer":
+            def loss_fn(p, x, _):
+                logits = model.apply(
+                    {"params": p} if "params" in variables else p, x)
+                tgt = jnp.roll(x, -1, axis=1)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, tgt).mean()
+        else:
+            def loss_fn(p, x, y):
+                logits = model.apply(
+                    {"params": p} if "params" in variables else p, x)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
+
+        vgrad = jax.jit(jax.vmap(jax.grad(loss_fn)))
+        bstats = None
+
+        def one_batch(params, bstats, state):
+            grads = vgrad(params, data, labels)
+            params, state = opt.step(params, grads, state)
+            return params, bstats, state
+
+    state = opt.init(params)
+
+    def sync(params):
+        leaf = jax.tree_util.tree_leaves(params)[0]
+        float(jnp.sum(leaf[..., :1].astype(jnp.float32)))
+
+    for _ in range(args.num_warmup_batches):
+        params, bstats, state = one_batch(params, bstats, state)
+    sync(params)
+
+    rates = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, bstats, state = one_batch(params, bstats, state)
+        sync(params)
+        dt = time.perf_counter() - t0
+        rate = n * args.batch_size * args.num_batches_per_iter / dt
+        rates.append(rate)
+        print(f"iter {i}: {rate:.1f} img/sec across {n} devices")
+
+    mean, ci = float(np.mean(rates)), 1.96 * float(np.std(rates))
+    unit = "tokens" if args.model == "transformer" else "img"
+    if args.model == "transformer":
+        mean, ci = mean * args.seq_len, ci * args.seq_len
+    print(f"total {unit}/sec: {mean:.1f} +- {ci:.1f} "
+          f"({mean / n:.1f}/device, model={args.model}, "
+          f"optimizer={args.dist_optimizer})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Examples double as e2e smoke tests (reference ``docs/code_structure.rst:14-16``)."""
+
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = "examples"
+
+
+def run_example(path, argv):
+    old = sys.argv
+    sys.argv = [path] + argv
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        sys.argv = old
+
+
+def test_average_consensus_static():
+    run_example(f"{EXAMPLES}/average_consensus.py",
+                ["--dim", "64", "--max-iters", "200"])
+
+
+def test_average_consensus_dynamic():
+    run_example(f"{EXAMPLES}/average_consensus.py",
+                ["--dim", "64", "--max-iters", "20", "--dynamic"])
+
+
+@pytest.mark.parametrize("method,maxerr", [
+    ("diffusion", 0.1),          # plain diffusion has O(lr) bias
+    ("exact_diffusion", 1e-3),
+    ("gradient_tracking", 1e-3),
+    ("push_diging", 1e-3),
+])
+def test_decentralized_algorithms_reach_minimizer(method, maxerr, capsys):
+    run_example(f"{EXAMPLES}/decentralized_optimization.py",
+                ["--method", method])
+    out = capsys.readouterr().out
+    err = float(out.strip().split()[-1])
+    assert err < maxerr, f"{method}: {err}"
+
+
+def test_mnist_lenet_short():
+    run_example(f"{EXAMPLES}/mnist_lenet.py",
+                ["--epochs", "6", "--per-rank-samples", "256",
+                 "--batch-size", "64"])
+
+
+def test_benchmark_harness_tiny():
+    run_example(f"{EXAMPLES}/benchmark.py",
+                ["--model", "lenet", "--batch-size", "4",
+                 "--num-warmup-batches", "1", "--num-iters", "2",
+                 "--num-batches-per-iter", "2"])
