@@ -1,0 +1,107 @@
+"""ctypes bindings for the native core (see ``src/bluefog_native.h``).
+
+Loads ``libbluefog_tpu_native.so`` if built (``make -C bluefog_tpu/native``),
+attempting a one-time build when a toolchain is available.  Everything has a
+pure-Python fallback, so ``lib() is None`` is always a supported state — the
+native layer is a performance/production feature (host-side schedule
+compilation, timeline writer, DCN window transport), not a correctness one.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libbluefog_tpu_native.so")
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+class WinMsg(ctypes.Structure):
+    _fields_ = [
+        ("op", ctypes.c_uint8),
+        ("src", ctypes.c_int32),
+        ("dst", ctypes.c_int32),
+        ("weight", ctypes.c_double),
+        ("p_weight", ctypes.c_double),
+        ("name", ctypes.c_char * 128),
+        ("payload_len", ctypes.c_uint64),
+    ]
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i32, i64, u64, dbl = (ctypes.c_int32, ctypes.c_int64, ctypes.c_uint64,
+                          ctypes.c_double)
+    ptr = ctypes.POINTER
+    lib.bf_rounds_from_matrix.restype = i32
+    lib.bf_rounds_from_matrix.argtypes = [
+        i32, ptr(dbl), ptr(i32), ptr(dbl), ptr(dbl), ptr(i32)]
+    lib.bf_uniform_weights.restype = None
+    lib.bf_uniform_weights.argtypes = [i32, ptr(dbl)]
+
+    lib.bf_timeline_open.restype = ctypes.c_void_p
+    lib.bf_timeline_open.argtypes = [ctypes.c_char_p, i32]
+    lib.bf_timeline_event.restype = None
+    lib.bf_timeline_event.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char,
+        i64, i64, i64]
+    lib.bf_timeline_dropped.restype = i64
+    lib.bf_timeline_dropped.argtypes = [ctypes.c_void_p]
+    lib.bf_timeline_close.restype = None
+    lib.bf_timeline_close.argtypes = [ctypes.c_void_p]
+
+    lib.bf_winsvc_start.restype = ctypes.c_void_p
+    lib.bf_winsvc_start.argtypes = [i32, i32]
+    lib.bf_winsvc_port.restype = i32
+    lib.bf_winsvc_port.argtypes = [ctypes.c_void_p]
+    lib.bf_winsvc_recv.restype = i32
+    lib.bf_winsvc_recv.argtypes = [
+        ctypes.c_void_p, ptr(WinMsg), ptr(ctypes.c_uint8), u64]
+    lib.bf_winsvc_send.restype = i32
+    lib.bf_winsvc_send.argtypes = [
+        ctypes.c_char_p, i32, ctypes.c_uint8, ctypes.c_char_p, i32, i32,
+        dbl, dbl, ptr(ctypes.c_uint8), u64]
+    lib.bf_winsvc_stop.restype = None
+    lib.bf_winsvc_stop.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def build(force: bool = False) -> bool:
+    """Compile the native library in place; returns success."""
+    if os.path.exists(_LIB_PATH) and not force:
+        return True
+    try:
+        subprocess.run(["make", "-C", _HERE, "-s"] + (["-B"] if force else []),
+                       check=True, capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def lib(auto_build: bool = True) -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            if not (auto_build and
+                    os.environ.get("BLUEFOG_TPU_NO_NATIVE") != "1" and
+                    build()):
+                return None
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None

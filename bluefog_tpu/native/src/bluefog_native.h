@@ -1,0 +1,98 @@
+/* C ABI of the bluefog_tpu native core.
+ *
+ * TPU-native analogue of the reference's C++ runtime layer
+ * (bluefog/common/{operations,mpi_controller,timeline}.cc): where the
+ * reference's native code *executes* communication (MPI/NCCL calls from a
+ * background thread), here the collectives are XLA programs — so the native
+ * layer instead owns the host-side machinery around them:
+ *   - schedule.cc : topology -> ppermute-round compilation (the per-topology
+ *                   host hot path; O(E) with n up to tens of thousands)
+ *   - timeline.cc : chrome-trace writer (SPSC ring buffer + writer thread,
+ *                   reference common/timeline.{h,cc} design)
+ *   - winsvc.cc   : async one-sided window transport over TCP for DCN
+ *                   multi-host gossip (reference NCCL passive-recv service,
+ *                   nccl_controller.cc:1113-1238, redesigned without MPI)
+ *
+ * Everything is plain C for ctypes consumption (no pybind11 in this image).
+ */
+
+#ifndef BLUEFOG_NATIVE_H_
+#define BLUEFOG_NATIVE_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---------------- schedule.cc ---------------- */
+
+/* Decompose the off-diagonal edges of the (n x n) row-major weight matrix
+ * into ppermute rounds by cyclic shift distance d = (dst - src) mod n.
+ * Outputs (caller-allocated):
+ *   distances   : int32[n-1]        distance of each nonempty round
+ *   send_scale  : double[(n-1)*n]   per-round per-src payload scale
+ *   recv_mask   : double[(n-1)*n]   1.0 iff rank receives in that round
+ *   src_of      : int32[(n-1)*n]    src feeding each dst, -1 if silent
+ * Returns the number of nonempty rounds (<= n-1). */
+int32_t bf_rounds_from_matrix(int32_t n, const double* w,
+                              int32_t* distances, double* send_scale,
+                              double* recv_mask, int32_t* src_of);
+
+/* Uniform 1/(indeg+1) averaging weights from a 0/1-ish adjacency (the
+ * reference default when topology weights are off). In/out: w (n x n). */
+void bf_uniform_weights(int32_t n, double* w);
+
+/* ---------------- timeline.cc ---------------- */
+
+typedef struct bf_timeline bf_timeline_t;
+
+bf_timeline_t* bf_timeline_open(const char* path, int32_t pid);
+/* phase: 'B' begin | 'E' end | 'X' complete (dur_us used). Non-blocking:
+ * events are dropped (counted) if the ring is full. */
+void bf_timeline_event(bf_timeline_t* t, const char* name, const char* cat,
+                       char phase, int64_t ts_us, int64_t dur_us,
+                       int64_t tid);
+int64_t bf_timeline_dropped(bf_timeline_t* t);
+void bf_timeline_close(bf_timeline_t* t);
+
+/* ---------------- winsvc.cc ---------------- */
+
+typedef struct bf_winsvc bf_winsvc_t;
+
+/* Inbound message, drained by the host framework (Python window store). */
+typedef struct {
+  uint8_t op;          /* 1=put 2=accumulate 3=get_request */
+  int32_t src;
+  int32_t dst;
+  double weight;
+  double p_weight;     /* associated-P mass carried with the payload */
+  char name[128];      /* window name (NUL-terminated) */
+  uint64_t payload_len;
+} bf_win_msg_t;
+
+/* Start a server listening on port (0 = ephemeral; bf_winsvc_port tells).
+ * max_pending bounds the inbound queue. */
+bf_winsvc_t* bf_winsvc_start(int32_t port, int32_t max_pending);
+int32_t bf_winsvc_port(bf_winsvc_t* s);
+
+/* Drain one inbound message; payload copied into caller buffer (cap bytes).
+ * Returns 1 if a message was produced, 0 if queue empty, -1 if payload
+ * exceeded cap (message stays queued; call again with a bigger buffer). */
+int32_t bf_winsvc_recv(bf_winsvc_t* s, bf_win_msg_t* msg, uint8_t* payload,
+                       uint64_t cap);
+
+/* Send a one-sided message to host:port (blocking; pooled connections).
+ * Returns 0 on success, negative errno-style code on failure. */
+int32_t bf_winsvc_send(const char* host, int32_t port, uint8_t op,
+                       const char* name, int32_t src, int32_t dst,
+                       double weight, double p_weight, const uint8_t* payload,
+                       uint64_t payload_len);
+
+void bf_winsvc_stop(bf_winsvc_t* s);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* BLUEFOG_NATIVE_H_ */

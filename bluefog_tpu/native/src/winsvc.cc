@@ -1,0 +1,236 @@
+// Async one-sided window transport over TCP (DCN path).
+//
+// The TPU-native answer to the reference's passive-recv service
+// (nccl_controller.cc:1113-1238): there, a dedicated thread answers MPI
+// control messages and issues ncclRecv into window buffers; here, a TCP
+// listener accepts framed put/accumulate/get messages from peer hosts and
+// queues them for the host framework (the Python window store) to apply.
+// ICI-local window traffic never touches this — it lives in host memory; this
+// service exists so win_put/win_accumulate/win_get work ACROSS hosts where
+// the reference used MPI RMA over the network.
+//
+// Wire format (little-endian):
+//   u32 magic 0xBF09F06D | u8 op | i32 src | i32 dst | f64 weight |
+//   f64 p_weight | u16 name_len | name | u64 payload_len | payload
+//
+// Threading: one accept thread; one reader thread per connection (peer count
+// = in-degree of this host, small by construction — Exp2 gives log2 n).
+// Inbound queue is bounded; when full the reader blocks, which backpressures
+// the sender's TCP stream rather than dropping gossip messages.
+
+#include "bluefog_native.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xBF09F06Du;
+
+struct Inbound {
+  bf_win_msg_t msg;
+  std::vector<uint8_t> payload;
+};
+
+bool ReadFull(int fd, void* buf, size_t len) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t r = ::recv(fd, p, len, 0);
+    if (r <= 0) return false;
+    p += r;
+    len -= (size_t)r;
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t len) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t r = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    len -= (size_t)r;
+  }
+  return true;
+}
+
+}  // namespace
+
+struct bf_winsvc {
+  int listen_fd = -1;
+  int32_t port = 0;
+  int32_t max_pending = 1024;
+  std::mutex m;
+  std::condition_variable cv_space;
+  std::deque<Inbound> q;
+  bool stopping = false;
+  std::thread acceptor;
+  std::mutex conn_m;
+  std::vector<std::thread> readers;
+  std::vector<int> conn_fds;
+
+  void Reader(int fd) {
+    for (;;) {
+      uint32_t magic;
+      if (!ReadFull(fd, &magic, 4) || magic != kMagic) break;
+      Inbound in{};
+      uint16_t name_len;
+      if (!ReadFull(fd, &in.msg.op, 1) || !ReadFull(fd, &in.msg.src, 4) ||
+          !ReadFull(fd, &in.msg.dst, 4) || !ReadFull(fd, &in.msg.weight, 8) ||
+          !ReadFull(fd, &in.msg.p_weight, 8) || !ReadFull(fd, &name_len, 2))
+        break;
+      if (name_len >= sizeof(in.msg.name)) break;
+      if (!ReadFull(fd, in.msg.name, name_len)) break;
+      in.msg.name[name_len] = '\0';
+      if (!ReadFull(fd, &in.msg.payload_len, 8)) break;
+      if (in.msg.payload_len > (1ull << 33)) break;  // 8 GiB sanity cap
+      in.payload.resize(in.msg.payload_len);
+      if (in.msg.payload_len &&
+          !ReadFull(fd, in.payload.data(), in.msg.payload_len))
+        break;
+      std::unique_lock<std::mutex> lk(m);
+      cv_space.wait(lk, [this] {
+        return stopping || (int32_t)q.size() < max_pending;
+      });
+      if (stopping) break;
+      q.push_back(std::move(in));
+    }
+    ::close(fd);
+  }
+
+  void Accept() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) break;  // listen_fd closed => shutdown
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(conn_m);
+      conn_fds.push_back(fd);
+      readers.emplace_back([this, fd] { Reader(fd); });
+    }
+  }
+};
+
+extern "C" {
+
+bf_winsvc_t* bf_winsvc_start(int32_t port, int32_t max_pending) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) < 0 ||
+      ::listen(fd, 64) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, (sockaddr*)&addr, &alen);
+  auto* s = new bf_winsvc;
+  s->listen_fd = fd;
+  s->port = (int32_t)ntohs(addr.sin_port);
+  if (max_pending > 0) s->max_pending = max_pending;
+  s->acceptor = std::thread([s] { s->Accept(); });
+  return s;
+}
+
+int32_t bf_winsvc_port(bf_winsvc_t* s) { return s ? s->port : -1; }
+
+int32_t bf_winsvc_recv(bf_winsvc_t* s, bf_win_msg_t* msg, uint8_t* payload,
+                       uint64_t cap) {
+  if (!s) return 0;
+  std::lock_guard<std::mutex> lk(s->m);
+  if (s->q.empty()) return 0;
+  Inbound& in = s->q.front();
+  if (in.payload.size() > cap) return -1;
+  *msg = in.msg;
+  if (!in.payload.empty())
+    std::memcpy(payload, in.payload.data(), in.payload.size());
+  s->q.pop_front();
+  s->cv_space.notify_one();
+  return 1;
+}
+
+int32_t bf_winsvc_send(const char* host, int32_t port, uint8_t op,
+                       const char* name, int32_t src, int32_t dst,
+                       double weight, double p_weight, const uint8_t* payload,
+                       uint64_t payload_len) {
+  // Pooled persistent connections keyed by host:port (thread-safe).
+  static std::mutex pool_m;
+  static std::map<std::string, int>* pool = new std::map<std::string, int>();
+  const std::string key = std::string(host) + ":" + std::to_string(port);
+
+  std::lock_guard<std::mutex> lk(pool_m);
+  int fd = -1;
+  auto it = pool->find(key);
+  if (it != pool->end()) fd = it->second;
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (fd < 0) {
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      const std::string port_s = std::to_string(port);
+      if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res)
+        return -1;
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) < 0) {
+        if (fd >= 0) ::close(fd);
+        ::freeaddrinfo(res);
+        return -2;
+      }
+      ::freeaddrinfo(res);
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      (*pool)[key] = fd;
+    }
+    uint16_t name_len = (uint16_t)std::strlen(name);
+    bool ok = WriteFull(fd, &kMagic, 4) && WriteFull(fd, &op, 1) &&
+              WriteFull(fd, &src, 4) && WriteFull(fd, &dst, 4) &&
+              WriteFull(fd, &weight, 8) && WriteFull(fd, &p_weight, 8) &&
+              WriteFull(fd, &name_len, 2) && WriteFull(fd, name, name_len) &&
+              WriteFull(fd, &payload_len, 8) &&
+              (payload_len == 0 || WriteFull(fd, payload, payload_len));
+    if (ok) return 0;
+    // Stale pooled connection (peer restarted): drop and retry once fresh.
+    ::close(fd);
+    pool->erase(key);
+    fd = -1;
+  }
+  return -3;
+}
+
+void bf_winsvc_stop(bf_winsvc_t* s) {
+  if (!s) return;
+  {
+    std::lock_guard<std::mutex> lk(s->m);
+    s->stopping = true;
+  }
+  s->cv_space.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  s->acceptor.join();
+  {
+    std::lock_guard<std::mutex> lk(s->conn_m);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);  // unblock recv()
+    for (auto& t : s->readers) t.join();
+  }
+  delete s;
+}
+
+}  // extern "C"

@@ -105,8 +105,11 @@ class PairGossipSchedule:
     self_scale: np.ndarray
 
 
-def _rounds_from_matrix(w: np.ndarray) -> Tuple[CommRound, ...]:
-    """Partition off-diagonal edges of ``w`` by shift distance into rounds."""
+def _rounds_from_matrix_py(w: np.ndarray) -> Tuple[CommRound, ...]:
+    """Partition off-diagonal edges of ``w`` by shift distance into rounds.
+
+    Pure-Python reference implementation (and the test oracle for the native
+    one below)."""
     n = w.shape[0]
     by_dist: Dict[int, List[Tuple[int, int]]] = {}
     srcs, dsts = np.nonzero(w)
@@ -126,6 +129,45 @@ def _rounds_from_matrix(w: np.ndarray) -> Tuple[CommRound, ...]:
             src_of[d] = s
         rounds.append(CommRound(pairs, send_scale, recv_mask, src_of))
     return tuple(rounds)
+
+
+def _rounds_from_matrix_native(w: np.ndarray) -> Optional[Tuple[CommRound, ...]]:
+    """Native-core round decomposition (``schedule.cc``); None if unbuilt."""
+    import ctypes
+
+    from bluefog_tpu import native
+    lib = native.lib()
+    if lib is None:
+        return None
+    n = w.shape[0]
+    if n < 2:
+        return ()
+    wq = np.ascontiguousarray(w, dtype=np.float64)
+    distances = np.empty(n - 1, dtype=np.int32)
+    send_scale = np.empty((n - 1, n), dtype=np.float64)
+    recv_mask = np.empty((n - 1, n), dtype=np.float64)
+    src_of = np.empty((n - 1, n), dtype=np.int32)
+    k = lib.bf_rounds_from_matrix(
+        n, wq.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        distances.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        send_scale.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        recv_mask.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        src_of.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    rounds = []
+    for r in range(k):
+        so = src_of[r]
+        dsts = np.nonzero(so >= 0)[0]
+        pairs = tuple(sorted((int(so[d]), int(d)) for d in dsts))
+        rounds.append(CommRound(pairs, send_scale[r].copy(),
+                                recv_mask[r].copy(), so.copy()))
+    return tuple(rounds)
+
+
+def _rounds_from_matrix(w: np.ndarray) -> Tuple[CommRound, ...]:
+    native_rounds = _rounds_from_matrix_native(w)
+    if native_rounds is not None:
+        return native_rounds
+    return _rounds_from_matrix_py(w)
 
 
 def uniform_weights(w_adj: np.ndarray) -> np.ndarray:

@@ -1,0 +1,106 @@
+"""DCN window transport: host-to-host one-sided gossip over TCP.
+
+Python face of ``native/src/winsvc.cc``.  In multi-host runs each process
+starts one ``WindowTransport``; ``win_put``/``win_accumulate`` targeting a
+rank owned by another host serialize the payload through the native client,
+and the peer's service thread queues it until the drain loop applies it to
+the local window store's staging buffers — the same observable semantics as
+the in-process path (versions, mutexes, associated-P).
+
+This is the structural analogue of the reference's NCCL window machinery
+(``nccl_controller.cc:1113-1238``): a passive service answering one-sided
+requests, with the control plane folded into the data message (no MPI
+request/ack/done handshake needed because TCP already orders and backpressures
+the stream).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from bluefog_tpu import native
+
+OP_PUT = 1
+OP_ACCUMULATE = 2
+
+__all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE"]
+
+
+class WindowTransport:
+    """One per-process TCP endpoint for window gossip.
+
+    ``apply(op, name, src, dst, weight, p_weight, payload)`` is invoked on the
+    drain thread for every inbound message; the window store supplies it.
+    """
+
+    def __init__(self, apply: Callable, *, port: int = 0,
+                 max_pending: int = 4096, drain_interval: float = 0.002):
+        self._lib = native.lib()
+        if self._lib is None:
+            raise RuntimeError(
+                "native core unavailable; build with `make -C "
+                "bluefog_tpu/native` (or use single-host windows)")
+        self._svc = self._lib.bf_winsvc_start(port, max_pending)
+        if not self._svc:
+            raise OSError(f"cannot start window service on port {port}")
+        self._apply = apply
+        self._interval = drain_interval
+        self._stop = threading.Event()
+        self._buf = np.empty(1 << 20, dtype=np.uint8)  # grows on demand
+        self._drainer = threading.Thread(target=self._drain, daemon=True,
+                                         name="bf-win-transport")
+        self._drainer.start()
+
+    @property
+    def port(self) -> int:
+        return int(self._lib.bf_winsvc_port(self._svc))
+
+    # -- outbound ----------------------------------------------------------
+    def send(self, host: str, port: int, op: int, name: str, src: int,
+             dst: int, weight: float, tensor: np.ndarray,
+             p_weight: float = 0.0) -> None:
+        payload = np.ascontiguousarray(tensor).view(np.uint8).reshape(-1)
+        rc = self._lib.bf_winsvc_send(
+            host.encode(), port, op, name.encode(), src, dst,
+            float(weight), float(p_weight),
+            payload.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            payload.size)
+        if rc != 0:
+            raise ConnectionError(
+                f"win transport send to {host}:{port} failed (code {rc})")
+
+    # -- inbound -----------------------------------------------------------
+    def _drain(self):
+        msg = native.WinMsg()
+        while not self._stop.is_set():
+            got = self._lib.bf_winsvc_recv(
+                self._svc, ctypes.byref(msg),
+                self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                self._buf.size)
+            if got == -1:  # payload larger than buffer: grow and retry
+                self._buf = np.empty(max(self._buf.size * 2, 1 << 24),
+                                     dtype=np.uint8)
+                continue
+            if got == 0:
+                self._stop.wait(self._interval)
+                continue
+            payload = bytes(self._buf[:msg.payload_len])
+            try:
+                self._apply(int(msg.op), msg.name.decode(), int(msg.src),
+                            int(msg.dst), float(msg.weight),
+                            float(msg.p_weight), payload)
+            except Exception:  # noqa: BLE001 — drain thread must survive
+                import logging
+                logging.getLogger("bluefog_tpu").exception(
+                    "window transport apply failed")
+
+    def stop(self):
+        self._stop.set()
+        self._drainer.join(timeout=5)
+        if self._svc:
+            self._lib.bf_winsvc_stop(self._svc)
+            self._svc = None

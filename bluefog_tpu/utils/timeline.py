@@ -74,7 +74,38 @@ class _TimelineWriter:
         self._thread.join(timeout=5)
 
 
-_writer: Optional[_TimelineWriter] = None
+class _NativeTimelineWriter:
+    """Native-core writer (``native/src/timeline.cc``): SPSC ring + writer
+    thread in C++, zero Python-side allocation per event."""
+
+    def __init__(self, path: str):
+        from bluefog_tpu import native
+        self._lib = native.lib()
+        assert self._lib is not None
+        self._h = self._lib.bf_timeline_open(path.encode(), os.getpid())
+        if not self._h:
+            raise OSError(f"cannot open timeline file {path!r}")
+
+    def emit(self, ev: dict):
+        self._lib.bf_timeline_event(
+            self._h, ev["name"].encode(), ev["cat"].encode(),
+            ev["ph"].encode(), ev["ts"], ev.get("dur", 0), ev["tid"])
+
+    def close(self):
+        if self._h:
+            self._lib.bf_timeline_close(self._h)
+            self._h = None
+
+
+def _make_writer(path: str):
+    from bluefog_tpu import native
+    if native.available() and \
+            os.environ.get("BLUEFOG_TPU_PYTHON_TIMELINE") != "1":
+        return _NativeTimelineWriter(path)
+    return _TimelineWriter(path)
+
+
+_writer = None
 _active: Dict[str, object] = {}
 _lock = threading.Lock()
 
@@ -98,7 +129,7 @@ def start_timeline(path: str) -> bool:
     with _lock:
         if _writer is not None:
             return False
-        _writer = _TimelineWriter(path)
+        _writer = _make_writer(path)
     return True
 
 

@@ -1,0 +1,151 @@
+"""Native core tests: C++ vs Python oracle parity, timeline output, TCP
+window transport loopback."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bluefog_tpu import native
+from bluefog_tpu import topology as topo
+from bluefog_tpu.ops import schedule as S
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core not built")
+
+
+@pytest.mark.parametrize("maker", [
+    lambda: topo.ExponentialTwoGraph(8),
+    lambda: topo.RingGraph(8),
+    lambda: topo.StarGraph(8),
+    lambda: topo.MeshGrid2DGraph(8),
+    lambda: topo.FullyConnectedGraph(8),
+    lambda: topo.RingGraph(5, connect_style=2),
+    lambda: topo.SymmetricExponentialGraph(12),
+])
+def test_native_rounds_match_python_oracle(maker):
+    w = topo.weight_matrix(maker())
+    py = S._rounds_from_matrix_py(w)
+    nat = S._rounds_from_matrix_native(w)
+    assert nat is not None
+    assert len(nat) == len(py)
+    for a, b in zip(nat, py):
+        assert a.pairs == b.pairs
+        np.testing.assert_allclose(a.send_scale, b.send_scale)
+        np.testing.assert_allclose(a.recv_mask, b.recv_mask)
+        np.testing.assert_array_equal(a.src_of, b.src_of)
+
+
+def test_native_rounds_random_matrices():
+    rng = np.random.RandomState(0)
+    for n in (2, 3, 7, 16):
+        for _ in range(5):
+            w = rng.rand(n, n) * (rng.rand(n, n) < 0.4)
+            py = S._rounds_from_matrix_py(w)
+            nat = S._rounds_from_matrix_native(w)
+            assert [r.pairs for r in nat] == [r.pairs for r in py]
+            for a, b in zip(nat, py):
+                np.testing.assert_allclose(a.send_scale, b.send_scale)
+
+
+def test_native_uniform_weights_matches_python():
+    import ctypes
+    lib = native.lib()
+    for maker in (topo.StarGraph, topo.ExponentialGraph):
+        w = topo.weight_matrix(maker(8))
+        expect = S.uniform_weights(w)
+        got = np.ascontiguousarray(w, dtype=np.float64)
+        lib.bf_uniform_weights(
+            8, got.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        np.testing.assert_allclose(got, expect)
+
+
+def test_native_timeline_writes_valid_chrome_trace(tmp_path):
+    lib = native.lib()
+    path = str(tmp_path / "trace.json")
+    h = lib.bf_timeline_open(path.encode(), 123)
+    assert h
+    lib.bf_timeline_event(h, b"alloc", b"NEGOTIATE", b"B", 1000, 0, 7)
+    lib.bf_timeline_event(h, b"alloc", b"NEGOTIATE", b"E", 2000, 0, 7)
+    lib.bf_timeline_event(h, b"comm", b"COMMUNICATE", b"X", 1500, 300, 7)
+    assert lib.bf_timeline_dropped(h) == 0
+    lib.bf_timeline_close(h)
+    events = json.load(open(path))
+    assert [e["ph"] for e in events] == ["B", "E", "X"]
+    assert events[2]["dur"] == 300
+    assert all(e["pid"] == 123 and e["tid"] == 7 for e in events)
+
+
+def test_timeline_python_api_uses_native(tmp_path):
+    from bluefog_tpu.utils import timeline as tl
+    path = str(tmp_path / "t.json")
+    assert tl.start_timeline(path)
+    with tl.timeline_context("tensor.a", "ALLREDUCE"):
+        pass
+    tl.timeline_start_activity("tensor.b")
+    tl.timeline_end_activity("tensor.b")
+    assert tl.stop_timeline()
+    events = json.load(open(path))
+    names = [(e["cat"], e["name"], e["ph"]) for e in events]
+    assert ("tensor.a", "ALLREDUCE", "B") in names
+    assert ("tensor.a", "ALLREDUCE", "E") in names
+    assert ("tensor.b", "USER", "B") in names
+
+
+def test_window_transport_loopback():
+    """Two endpoints on localhost: puts and accumulates arrive with weights
+    and associated-P mass intact, ordered per sender."""
+    from bluefog_tpu.ops.transport import (OP_ACCUMULATE, OP_PUT,
+                                           WindowTransport)
+    received = []
+    done = __import__("threading").Event()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        received.append((op, name, src, dst, weight, p_weight,
+                         np.frombuffer(payload, np.float32).copy()))
+        if len(received) == 3:
+            done.set()
+
+    server = WindowTransport(apply)
+    client = WindowTransport(lambda *a: None)
+    try:
+        x = np.arange(4, dtype=np.float32)
+        client.send("127.0.0.1", server.port, OP_PUT, "w", 1, 0, 0.25, x,
+                    p_weight=0.5)
+        client.send("127.0.0.1", server.port, OP_ACCUMULATE, "w", 2, 0,
+                    0.75, 2 * x, p_weight=0.25)
+        client.send("127.0.0.1", server.port, OP_PUT, "very.long/param:name",
+                    3, 0, 1.0, np.zeros(0, np.float32))
+        assert done.wait(timeout=10), f"only {len(received)} messages arrived"
+        op, name, src, dst, w, pw, data = received[0]
+        assert (op, name, src, dst, w, pw) == (OP_PUT, "w", 1, 0, 0.25, 0.5)
+        np.testing.assert_array_equal(data, x)
+        op, name, src, dst, w, pw, data = received[1]
+        assert op == OP_ACCUMULATE and w == 0.75
+        np.testing.assert_array_equal(data, 2 * x)
+        assert received[2][1] == "very.long/param:name"
+        assert received[2][6].size == 0
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_window_transport_large_payload():
+    """Payload bigger than the initial drain buffer (forces regrow)."""
+    from bluefog_tpu.ops.transport import OP_PUT, WindowTransport
+    got = []
+    done = __import__("threading").Event()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        got.append(np.frombuffer(payload, np.float32))
+        done.set()
+
+    server = WindowTransport(apply)
+    try:
+        x = np.random.RandomState(0).randn(3 << 20).astype(np.float32)  # 12MB
+        server.send("127.0.0.1", server.port, OP_PUT, "big", 0, 0, 1.0, x)
+        assert done.wait(timeout=30)
+        np.testing.assert_array_equal(got[0], x)
+    finally:
+        server.stop()
