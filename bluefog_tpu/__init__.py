@@ -23,6 +23,7 @@ from bluefog_tpu.version import __version__  # noqa: F401
 # `import bluefog_tpu` cheap and jax-initialization-free until first use.
 from bluefog_tpu.basics import (  # noqa: F401
     init,
+    init_distributed,
     shutdown,
     initialized,
     size,
@@ -59,6 +60,7 @@ from bluefog_tpu.basics import (  # noqa: F401
     wait,
     synchronize,
     barrier,
+    to_numpy,
     broadcast_parameters,
     allreduce_parameters,
 )

@@ -139,6 +139,31 @@ def init(topology_fn=None, is_weighted: bool = False, *,
             topology_util.ExponentialGraph(n // local_size), is_weighted=False)
 
 
+def init_distributed(topology_fn=None, is_weighted: bool = False) -> None:
+    """Multi-process init: rendezvous through the JAX distributed coordinator,
+    then ``init()`` over the GLOBAL device set.
+
+    Reads the ``BFTPU_COORDINATOR`` / ``BFTPU_NUM_PROCESSES`` /
+    ``BFTPU_PROCESS_ID`` env set by ``bfrun`` (``python -m bluefog_tpu.run``);
+    with none set, defers to ``jax.distributed.initialize()`` auto-detection
+    (TPU pod metadata).  Replaces the reference's ``MPI_Init`` + bfrun/mpirun
+    contract (``run/run.py:180-203``).
+    """
+    import os as _os
+    coord = _os.environ.get("BFTPU_COORDINATOR")
+    if coord is not None:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(_os.environ["BFTPU_NUM_PROCESSES"]),
+            process_id=int(_os.environ["BFTPU_PROCESS_ID"]))
+    elif jax.process_count() == 1:
+        try:
+            jax.distributed.initialize()
+        except Exception:  # single-process fallback (no pod metadata)
+            pass
+    init(topology_fn, is_weighted)
+
+
 def shutdown() -> None:
     from bluefog_tpu.ops import window as _window
     _window._free_all_windows()
@@ -571,7 +596,24 @@ def wait(handle: Handle) -> jnp.ndarray:
 
 
 def synchronize(handle: Handle) -> jnp.ndarray:
-    return jax.block_until_ready(handle)
+    from bluefog_tpu.utils import stall
+    with stall.watch("collective synchronize"):
+        return jax.block_until_ready(handle)
+
+
+def to_numpy(x) -> np.ndarray:
+    """Fetch a (possibly multi-host sharded) array as a full numpy array.
+
+    Single-process: plain device_get.  Multi-controller: gathers the
+    non-addressable shards over the coordinator transport
+    (``multihost_utils.process_allgather``)."""
+    x = jnp.asarray(x)
+    try:
+        return np.asarray(x)
+    except RuntimeError:
+        from jax.experimental import multihost_utils
+        return np.asarray(
+            multihost_utils.process_allgather(x, tiled=True))
 
 
 def barrier() -> None:

@@ -438,8 +438,10 @@ def win_wait(handle: int) -> bool:
         fut = _store.handles.pop(handle, None)
     if fut is None:
         return True
+    from bluefog_tpu.utils import stall
     try:
-        fut.result()
+        with stall.watch(f"win_wait(handle={handle})"):
+            fut.result()
     except KeyError:
         return False  # window freed while the op was in flight
     return True

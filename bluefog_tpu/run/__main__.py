@@ -1,0 +1,3 @@
+from bluefog_tpu.run.run import main
+
+raise SystemExit(main())

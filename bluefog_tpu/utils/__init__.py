@@ -1,0 +1,5 @@
+"""Auxiliary subsystems: timeline, config, logging, stall detection,
+checkpointing (reference SURVEY §5 inventory)."""
+
+from bluefog_tpu.utils import config  # noqa: F401
+from bluefog_tpu.utils import timeline  # noqa: F401

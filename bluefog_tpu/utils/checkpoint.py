@@ -1,0 +1,76 @@
+"""Checkpoint / resume (orbax).
+
+The reference has no in-framework checkpointing (SURVEY §5.4) — it only
+offers ``broadcast_parameters`` / ``broadcast_optimizer_state`` to re-sync
+after a torch-native restore.  Here checkpointing is a first-class subsystem:
+rank-major pytrees (params + optimizer state + step) save/restore through
+orbax, and the decentralized-specific concerns are handled explicitly:
+
+  * ``save``: optionally consensus-average the replicas first (a decentralized
+    run's ranks legitimately differ; the averaged model is the publishable
+    artifact, matching how BlueFog papers evaluate).
+  * ``restore``: returns the saved tree; ``broadcast_to_ranks`` re-expands a
+    consensus checkpoint back into per-rank replicas (the parity path for
+    ``broadcast_parameters``, reference ``torch/utility.py:22-52``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["save", "restore", "latest_step", "broadcast_to_ranks",
+           "consensus_average"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def consensus_average(tree):
+    """Average the rank replicas (leading axis) of every leaf."""
+    return jax.tree.map(lambda x: jnp.mean(x, axis=0), tree)
+
+
+def broadcast_to_ranks(tree, n: int):
+    """Expand a consensus tree back to rank-major replicas."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x)[None],
+                                   (n,) + jnp.asarray(x).shape), tree)
+
+
+def save(path: str, tree: Any, *, step: Optional[int] = None,
+         average_ranks: bool = False, force: bool = True) -> str:
+    """Save a pytree; returns the concrete directory written.
+
+    ``average_ranks=True`` stores the consensus-averaged model instead of all
+    replicas (smaller and the usual evaluation artifact)."""
+    if average_ranks:
+        tree = consensus_average(tree)
+    tree = jax.tree.map(np.asarray, tree)  # host-side, device-agnostic
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step:010d}")
+    _checkpointer().save(path, tree, force=force)
+    return path
+
+
+def restore(path: str, *, step: Optional[int] = None) -> Any:
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step:010d}")
+    return _checkpointer().restore(path)
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Newest ``step_*`` subdirectory under ``path``, or None."""
+    if not os.path.isdir(path):
+        return None
+    steps = [int(d.split("_")[1]) for d in os.listdir(path)
+             if d.startswith("step_") and d.split("_")[1].isdigit()]
+    return max(steps) if steps else None

@@ -1,0 +1,80 @@
+"""Environment-variable config system.
+
+Parity: the reference configures everything through ``BLUEFOG_*`` env vars
+(``docs/env_variable.rst``); this module is the single authoritative inventory
+for the TPU rebuild.  Values are read lazily on first access and cached; call
+``reload()`` after mutating ``os.environ`` in tests.
+
+| Variable | Default | Meaning |
+|---|---|---|
+| BLUEFOG_TIMELINE              | unset | timeline file prefix (one file/rank) |
+| BLUEFOG_TPU_LOG_LEVEL         | warn  | trace/debug/info/warn/error/fatal |
+| BLUEFOG_TPU_LOG_HIDE_TIME     | 0     | drop timestamps from log lines |
+| BLUEFOG_TPU_NO_NATIVE         | 0     | never build/load the C++ core |
+| BLUEFOG_TPU_PYTHON_TIMELINE   | 0     | force the Python timeline writer |
+| BLUEFOG_TPU_STALL_WARNING_SEC | 60    | stall-detector threshold (0=off) |
+| BLUEFOG_TPU_WIN_PORT          | 0     | DCN window-service port (0=ephemeral) |
+| BLUEFOG_TPU_WIN_MAX_PENDING   | 4096  | inbound window-message queue bound |
+| BFTPU_COORDINATOR             | unset | set by bfrun: coordinator host:port |
+| BFTPU_NUM_PROCESSES           | unset | set by bfrun |
+| BFTPU_PROCESS_ID              | unset | set by bfrun |
+
+(The reference's fusion/cycle-time/vendor-override knobs have no TPU
+equivalent: XLA owns fusion and scheduling, and there is exactly one vendor.)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["Config", "get", "reload"]
+
+
+def _flag(name: str, default: bool = False) -> bool:
+    return os.environ.get(name, "1" if default else "0") in ("1", "true",
+                                                             "True", "yes")
+
+
+@dataclass(frozen=True)
+class Config:
+    timeline_prefix: Optional[str]
+    log_level: str
+    log_hide_time: bool
+    no_native: bool
+    python_timeline: bool
+    stall_warning_sec: float
+    win_port: int
+    win_max_pending: int
+
+    @staticmethod
+    def from_env() -> "Config":
+        return Config(
+            timeline_prefix=os.environ.get("BLUEFOG_TIMELINE"),
+            log_level=os.environ.get("BLUEFOG_TPU_LOG_LEVEL", "warn").lower(),
+            log_hide_time=_flag("BLUEFOG_TPU_LOG_HIDE_TIME"),
+            no_native=_flag("BLUEFOG_TPU_NO_NATIVE"),
+            python_timeline=_flag("BLUEFOG_TPU_PYTHON_TIMELINE"),
+            stall_warning_sec=float(
+                os.environ.get("BLUEFOG_TPU_STALL_WARNING_SEC", "60")),
+            win_port=int(os.environ.get("BLUEFOG_TPU_WIN_PORT", "0")),
+            win_max_pending=int(
+                os.environ.get("BLUEFOG_TPU_WIN_MAX_PENDING", "4096")),
+        )
+
+
+_cfg: Optional[Config] = None
+
+
+def get() -> Config:
+    global _cfg
+    if _cfg is None:
+        _cfg = Config.from_env()
+    return _cfg
+
+
+def reload() -> Config:
+    global _cfg
+    _cfg = None
+    return get()

@@ -1,0 +1,49 @@
+"""Leveled logging (parity: reference ``common/logging.{h,cc}`` BFLOG macros
++ the Python "bluefog" logger, ``basics.py:27-34``).
+
+Env contract: ``BLUEFOG_TPU_LOG_LEVEL`` in {trace, debug, info, warn, error,
+fatal}; ``BLUEFOG_TPU_LOG_HIDE_TIME=1`` drops timestamps — mirroring
+``BLUEFOG_LOG_LEVEL`` / ``BLUEFOG_LOG_HIDE_TIME`` (``docs/env_variable.rst:9-23``).
+"""
+
+from __future__ import annotations
+
+import logging as _logging
+import sys
+
+from bluefog_tpu.utils import config
+
+__all__ = ["get_logger", "TRACE"]
+
+TRACE = 5  # below DEBUG, matching the reference's 6-level scale
+_logging.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "trace": TRACE,
+    "debug": _logging.DEBUG,
+    "info": _logging.INFO,
+    "warn": _logging.WARNING,
+    "warning": _logging.WARNING,
+    "error": _logging.ERROR,
+    "fatal": _logging.CRITICAL,
+}
+
+_configured = False
+
+
+def get_logger() -> _logging.Logger:
+    """The framework logger, configured once from the env."""
+    global _configured
+    logger = _logging.getLogger("bluefog_tpu")
+    if not _configured:
+        cfg = config.get()
+        logger.setLevel(_LEVELS.get(cfg.log_level, _logging.WARNING))
+        if not logger.handlers:
+            h = _logging.StreamHandler(sys.stderr)
+            fmt = "%(levelname)s %(name)s: %(message)s" if cfg.log_hide_time \
+                else "%(asctime)s %(levelname)s %(name)s: %(message)s"
+            h.setFormatter(_logging.Formatter(fmt))
+            logger.addHandler(h)
+            logger.propagate = False
+        _configured = True
+    return logger

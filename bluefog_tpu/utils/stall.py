@@ -1,0 +1,93 @@
+"""Stall detection: warn when a collective/window wait exceeds a threshold.
+
+Parity: the reference's coordinator-side stall check (``CheckForStalledTensors``,
+``operations.cc:388-433``) warns every 60 s listing tensors that only a subset
+of ranks submitted.  SPMD removes that failure mode (one program, no name
+matching), so the TPU equivalents of a "stall" are: a device computation that
+never completes (hung ICI collective / preempted pod member) or a window
+handle never drained.  This watchdog times every blocking wait and logs a
+warning with the op name once the threshold passes — same observability
+contract, adapted to the architecture.
+
+Threshold: ``BLUEFOG_TPU_STALL_WARNING_SEC`` (0 disables; default 60).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+from bluefog_tpu.utils import config
+from bluefog_tpu.utils.logging import get_logger
+
+__all__ = ["watch", "StallMonitor"]
+
+
+class StallMonitor:
+    """Tracks outstanding named waits; a daemon thread warns on overdue ones
+    every threshold interval (reference: rank-0 check every 60 s)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._outstanding = {}  # id -> (name, start_ts, warned_count)
+        self._next_id = 0
+        self._thread = None
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="bf-stall-monitor")
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            # Fixed short tick: the threshold can change between ticks (tests,
+            # env reload), so never sleep proportionally to a stale value.
+            time.sleep(0.25)
+            threshold = config.get().stall_warning_sec
+            if threshold <= 0:
+                continue
+            now = time.monotonic()
+            with self._lock:
+                items = list(self._outstanding.items())
+            for key, (name, start, warned) in items:
+                overdue = now - start
+                if overdue > threshold * (warned + 1):
+                    get_logger().warning(
+                        "One or more operations appear stalled: %r has been "
+                        "waiting %.0f s (threshold %.0f s). A missing peer "
+                        "process or a hung collective is the usual cause.",
+                        name, overdue, threshold)
+                    with self._lock:
+                        if key in self._outstanding:
+                            self._outstanding[key] = (name, start, warned + 1)
+
+    def begin(self, name: str) -> int:
+        if config.get().stall_warning_sec <= 0:
+            return -1
+        self._ensure_thread()
+        with self._lock:
+            key = self._next_id
+            self._next_id += 1
+            self._outstanding[key] = (name, time.monotonic(), 0)
+        return key
+
+    def end(self, key: int) -> None:
+        if key < 0:
+            return
+        with self._lock:
+            self._outstanding.pop(key, None)
+
+
+_monitor = StallMonitor()
+
+
+@contextmanager
+def watch(name: str):
+    """Wrap a blocking wait so the monitor can flag it if it stalls."""
+    key = _monitor.begin(name)
+    try:
+        yield
+    finally:
+        _monitor.end(key)

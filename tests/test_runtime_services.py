@@ -1,0 +1,141 @@
+"""Runtime services tests: config, logging, stall detection, checkpoint,
+launcher."""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.utils import checkpoint, config, stall
+from bluefog_tpu.utils.logging import get_logger
+
+
+def test_config_inventory(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_LOG_LEVEL", "debug")
+    monkeypatch.setenv("BLUEFOG_TPU_STALL_WARNING_SEC", "5")
+    monkeypatch.setenv("BLUEFOG_TIMELINE", "/tmp/tl_")
+    cfg = config.reload()
+    assert cfg.log_level == "debug"
+    assert cfg.stall_warning_sec == 5.0
+    assert cfg.timeline_prefix == "/tmp/tl_"
+    monkeypatch.delenv("BLUEFOG_TPU_LOG_LEVEL")
+    monkeypatch.delenv("BLUEFOG_TPU_STALL_WARNING_SEC")
+    monkeypatch.delenv("BLUEFOG_TIMELINE")
+    config.reload()
+
+
+def test_logger_exists():
+    log = get_logger()
+    assert log.name == "bluefog_tpu"
+
+
+def test_stall_monitor_warns(monkeypatch, caplog):
+    monkeypatch.setenv("BLUEFOG_TPU_STALL_WARNING_SEC", "0.3")
+    config.reload()
+    log = get_logger()
+    log.addHandler(caplog.handler)  # logger does not propagate to root
+    try:
+        with caplog.at_level(logging.WARNING, logger="bluefog_tpu"):
+            with stall.watch("test-op"):
+                time.sleep(1.2)
+        assert any("test-op" in r.message and "stalled" in r.message
+                   for r in caplog.records)
+    finally:
+        log.removeHandler(caplog.handler)
+        monkeypatch.delenv("BLUEFOG_TPU_STALL_WARNING_SEC")
+        config.reload()
+
+
+def test_stall_monitor_quiet_when_fast(monkeypatch, caplog):
+    monkeypatch.setenv("BLUEFOG_TPU_STALL_WARNING_SEC", "5")
+    config.reload()
+    try:
+        with caplog.at_level(logging.WARNING, logger="bluefog_tpu"):
+            with stall.watch("fast-op"):
+                pass
+        assert not any("fast-op" in r.message for r in caplog.records)
+    finally:
+        monkeypatch.delenv("BLUEFOG_TPU_STALL_WARNING_SEC")
+        config.reload()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    tree = {"w": jnp.arange(24, dtype=jnp.float32).reshape(8, 3),
+            "b": jnp.ones((8, 1))}
+    p = checkpoint.save(str(tmp_path / "ckpt"), tree, step=7)
+    assert "step_0000000007" in p
+    assert checkpoint.latest_step(str(tmp_path / "ckpt")) == 7
+    back = checkpoint.restore(str(tmp_path / "ckpt"), step=7)
+    np.testing.assert_array_equal(back["w"], np.asarray(tree["w"]))
+
+
+def test_checkpoint_consensus_average_and_rebroadcast(tmp_path):
+    tree = {"w": jnp.asarray(np.random.RandomState(0).randn(8, 3))}
+    p = checkpoint.save(str(tmp_path / "c2"), tree, average_ranks=True)
+    back = checkpoint.restore(p)
+    np.testing.assert_allclose(back["w"], np.asarray(tree["w"]).mean(0),
+                               rtol=1e-6)
+    expanded = checkpoint.broadcast_to_ranks(back, 8)
+    assert expanded["w"].shape == (8, 3)
+
+
+def test_bfrun_local_fanout(tmp_path):
+    """bfrun spawns N local processes with the rendezvous env; each process
+    reports its BFTPU_* identity."""
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os, json\n"
+        f"out = os.path.join({str(tmp_path)!r},"
+        " 'rank' + os.environ['BFTPU_PROCESS_ID'] + '.json')\n"
+        "json.dump({k: os.environ[k] for k in\n"
+        "    ('BFTPU_COORDINATOR', 'BFTPU_NUM_PROCESSES',"
+        " 'BFTPU_PROCESS_ID')}, open(out, 'w'))\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run", "-np", "3",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    lines = [json.load(open(tmp_path / f"rank{r}.json")) for r in range(3)]
+    assert sorted(l["BFTPU_PROCESS_ID"] for l in lines) == ["0", "1", "2"]
+    assert len({l["BFTPU_COORDINATOR"] for l in lines}) == 1
+    assert all(l["BFTPU_NUM_PROCESSES"] == "3" for l in lines)
+
+
+@pytest.mark.slow
+def test_bfrun_distributed_consensus(tmp_path):
+    """Full two-process rendezvous through jax.distributed: each process
+    contributes its rank; a psum over the global mesh must see both."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "train.py"
+    script.write_text(f"""
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import bluefog_tpu as bf
+bf.init_distributed()
+assert jax.process_count() == 2, jax.process_count()
+assert bf.size() == 4, bf.size()
+import numpy as np
+# Single-controller data model: every process passes the same global
+# rank-major array (row r = rank r's tensor).
+x = np.arange(4, dtype=np.float32)[:, None].repeat(2, 1) + 1.0
+out = bf.to_numpy(bf.allreduce(x, average=False))
+assert np.allclose(out, 10.0), out  # 1+2+3+4 on every rank
+print("OK", jax.process_index(), out[0, 0])
+""")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",
+         "--devices-per-proc", "2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, cwd=repo)
+    assert out.returncode == 0, f"stdout={out.stdout}\nstderr={out.stderr}"
+    oks = [l for l in out.stdout.splitlines() if l.startswith("OK")]
+    assert len(oks) == 2, out.stdout
