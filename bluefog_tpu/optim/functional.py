@@ -90,6 +90,7 @@ def make_combiner(
         def _empty(x, step=None, weights=None):
             _no_weights(weights, "CommunicationType.empty")
             return x
+        _empty.is_identity = True  # lets _tree_combine skip fusion copies
         return _empty
     if comm == CommunicationType.allreduce:
         def _ar(x, step=None, weights=None):
@@ -137,10 +138,26 @@ def make_combiner(
     raise ValueError(f"unknown communication type {comm}")
 
 
-def _tree_combine(params, combine, step, weights, steps_per_comm: int):
-    """Apply ``combine`` to every leaf, skipping steps where
-    ``step % steps_per_comm != 0`` (local aggregation)."""
+def _tree_combine(params, combine, step, weights, steps_per_comm: int,
+                  fuse: bool = True):
+    """Apply ``combine`` to a pytree, skipping steps where
+    ``step % steps_per_comm != 0`` (local aggregation).
+
+    ``fuse=True`` ravels the whole tree into ONE flat buffer so a model with
+    hundreds of parameters issues one ppermute set per round instead of one
+    per parameter — the TPU-native replacement for the reference's
+    FusionBufferManager + fused-response machinery (``tensor_queue.h:70-92``,
+    ``operations.cc:918-1001``), with zero copy-in/copy-out phases because XLA
+    fuses the concatenation into the collective's producers/consumers.
+    """
+    if getattr(combine, "is_identity", False):
+        return params  # empty communication: no fusion copies, no cond
+
     def comm_all(p):
+        if fuse:
+            from jax.flatten_util import ravel_pytree
+            flat, unravel = ravel_pytree(p)
+            return unravel(combine(flat, step=step, weights=weights))
         return jax.tree.map(lambda x: combine(x, step=step, weights=weights), p)
     if steps_per_comm == 1:
         return comm_all(params)
@@ -150,7 +167,7 @@ def _tree_combine(params, combine, step, weights, steps_per_comm: int):
 
 def awc_step(base: optax.GradientTransformation, combine: Combiner,
              params, grads, state: DistOptState, *,
-             weights=None, steps_per_comm: int = 1):
+             weights=None, steps_per_comm: int = 1, fuse: bool = True):
     """Adapt-with-combine: communicate params, then apply the base update.
 
     Matches ``_DistributedReduceOptimizer`` (reference
@@ -158,7 +175,8 @@ def awc_step(base: optax.GradientTransformation, combine: Combiner,
     of ``x_t`` while backward computes ``g_t``; ``step()`` waits and applies
     the local update to the *combined* parameters.
     """
-    combined = _tree_combine(params, combine, state.step, weights, steps_per_comm)
+    combined = _tree_combine(params, combine, state.step, weights,
+                             steps_per_comm, fuse)
     updates, base_state = base.update(grads, state.base, combined)
     new_params = optax.apply_updates(combined, updates)
     return new_params, DistOptState(base_state, state.step + 1)
@@ -166,7 +184,7 @@ def awc_step(base: optax.GradientTransformation, combine: Combiner,
 
 def atc_step(base: optax.GradientTransformation, combine: Combiner,
              params, grads, state: DistOptState, *,
-             weights=None, steps_per_comm: int = 1):
+             weights=None, steps_per_comm: int = 1, fuse: bool = True):
     """Adapt-then-combine: local base update first, then communicate.
 
     Matches ``_DistributedAdaptThenCombineOptimizer`` (reference
@@ -176,7 +194,8 @@ def atc_step(base: optax.GradientTransformation, combine: Combiner,
     """
     updates, base_state = base.update(grads, state.base, params)
     half = optax.apply_updates(params, updates)
-    new_params = _tree_combine(half, combine, state.step, weights, steps_per_comm)
+    new_params = _tree_combine(half, combine, state.step, weights,
+                               steps_per_comm, fuse)
     return new_params, DistOptState(base_state, state.step + 1)
 
 
@@ -225,12 +244,14 @@ def dist_init(base: optax.GradientTransformation, params) -> DistOptState:
 
 def step_fn(order: str, base: optax.GradientTransformation,
             combine: Combiner, *, axis_name: str,
-            steps_per_comm: int = 1) -> Callable:
+            steps_per_comm: int = 1, fuse: bool = True) -> Callable:
     """Bind an execution order to a ``(params, grads, state[, weights])`` fn."""
     if order == "awc":
-        return partial(awc_step, base, combine, steps_per_comm=steps_per_comm)
+        return partial(awc_step, base, combine,
+                       steps_per_comm=steps_per_comm, fuse=fuse)
     if order == "atc":
-        return partial(atc_step, base, combine, steps_per_comm=steps_per_comm)
+        return partial(atc_step, base, combine,
+                       steps_per_comm=steps_per_comm, fuse=fuse)
     if order == "gradient_allreduce":
         return partial(gradient_allreduce_step, base, axis_name=axis_name,
                        steps_per_comm=steps_per_comm)

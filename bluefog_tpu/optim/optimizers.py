@@ -81,7 +81,7 @@ class DistributedOptimizer:
                  *, order: str = "awc",
                  num_steps_per_communication: int = 1,
                  use_dynamic_topology: bool = False,
-                 phases=None):
+                 phases=None, fusion: bool = True):
         if isinstance(communication_type, str):
             communication_type = CommunicationType(communication_type)
         self.base = base
@@ -90,6 +90,8 @@ class DistributedOptimizer:
         self.num_steps_per_communication = int(num_steps_per_communication)
         self.use_dynamic_topology = use_dynamic_topology
         self.phases = phases
+        # Fused single-buffer communication (reference FusionBufferManager).
+        self.fusion = fusion
         self._jitted = {}
 
     # -- schedule resolution ------------------------------------------------
@@ -131,7 +133,8 @@ class DistributedOptimizer:
             machine_axis=MACHINE_AXIS if hier else None)
         inner = F.step_fn(self.order, self.base, combine,
                           axis_name=RANK_AXIS,
-                          steps_per_comm=self.num_steps_per_communication)
+                          steps_per_comm=self.num_steps_per_communication,
+                          fuse=self.fusion)
         mesh = ctx.hier_mesh if hier else ctx.mesh
         spec = P((MACHINE_AXIS, LOCAL_AXIS)) if hier else P(RANK_AXIS)
 

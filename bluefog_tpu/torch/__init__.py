@@ -1,0 +1,124 @@
+"""Torch interop layer.
+
+Parity role: the reference ships a second, minimal framework frontend
+(``bluefog/tensorflow``: allreduce/broadcast/allgather + variable broadcast,
+``tensorflow/mpi_ops.py:95-211``).  Here the second frontend is *torch*
+(CPU tensors): the same collective surface over rank-major ``torch.Tensor``s,
+plus module-replica utilities so BlueFog-style decentralized algorithms can
+be prototyped against torch models while the TPU fast path stays JAX.
+
+Data model matches the eager JAX surface: rank-major tensors, leading dim ==
+``bf.size()`` (row r = rank r's tensor).  ``replicate_module`` stacks a
+module's state into that form; ``neighbor_allreduce_module_`` averages a list
+of per-rank module replicas in place.
+
+This is an interop bridge — tensors round-trip host memory.  Training at
+speed belongs in the jitted JAX path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import torch
+
+from bluefog_tpu import basics as _b
+
+__all__ = [
+    "allreduce", "broadcast", "allgather", "neighbor_allreduce",
+    "neighbor_allgather", "pair_gossip", "broadcast_parameters",
+    "allreduce_parameters", "replicate_module", "load_replica",
+    "neighbor_allreduce_module_",
+]
+
+
+def _to_np(t: torch.Tensor) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def _like(t: torch.Tensor, arr) -> torch.Tensor:
+    return torch.from_numpy(np.asarray(arr)).to(dtype=t.dtype,
+                                                device=t.device)
+
+
+def allreduce(tensor: torch.Tensor, *, average: bool = True,
+              name: Optional[str] = None) -> torch.Tensor:
+    return _like(tensor, _b.allreduce(_to_np(tensor), average=average,
+                                      name=name))
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    return _like(tensor, _b.broadcast(_to_np(tensor), root_rank, name))
+
+
+def allgather(tensor: torch.Tensor,
+              name: Optional[str] = None) -> torch.Tensor:
+    return _like(tensor, _b.allgather(_to_np(tensor), name))
+
+
+def neighbor_allreduce(tensor: torch.Tensor, *, self_weight=None,
+                       src_weights=None, dst_weights=None,
+                       name: Optional[str] = None) -> torch.Tensor:
+    return _like(tensor, _b.neighbor_allreduce(
+        _to_np(tensor), self_weight=self_weight, src_weights=src_weights,
+        dst_weights=dst_weights, name=name))
+
+
+def neighbor_allgather(tensor: torch.Tensor,
+                       name: Optional[str] = None) -> torch.Tensor:
+    return _like(tensor, _b.neighbor_allgather(_to_np(tensor), name))
+
+
+def pair_gossip(tensor: torch.Tensor, target_ranks, *,
+                self_weight: float = 0.5,
+                target_weight: float = 0.5) -> torch.Tensor:
+    return _like(tensor, _b.pair_gossip(_to_np(tensor), target_ranks,
+                                        self_weight=self_weight,
+                                        target_weight=target_weight))
+
+
+# ---------------------------------------------------------------------------
+# Module utilities (parity: torch/utility.py:22-212 / tensorflow
+# broadcast_variables)
+# ---------------------------------------------------------------------------
+
+def replicate_module(module: torch.nn.Module, n: Optional[int] = None
+                     ) -> Dict[str, torch.Tensor]:
+    """Stack a module's state dict into rank-major tensors (n, ...)."""
+    n = n if n is not None else _b.size()
+    return {k: v.detach().unsqueeze(0).repeat((n,) + (1,) * v.dim())
+            for k, v in module.state_dict().items()}
+
+
+def load_replica(module: torch.nn.Module,
+                 stacked: Dict[str, torch.Tensor], rank: int) -> None:
+    """Load rank ``rank``'s slice of a rank-major state dict into a module."""
+    module.load_state_dict({k: v[rank] for k, v in stacked.items()})
+
+
+def broadcast_parameters(stacked: Dict[str, torch.Tensor],
+                         root_rank: int = 0) -> Dict[str, torch.Tensor]:
+    return {k: broadcast(v, root_rank, name=k) for k, v in stacked.items()}
+
+
+def allreduce_parameters(stacked: Dict[str, torch.Tensor],
+                         *, average: bool = True) -> Dict[str, torch.Tensor]:
+    return {k: allreduce(v, average=average, name=k)
+            for k, v in stacked.items()}
+
+
+@torch.no_grad()
+def neighbor_allreduce_module_(replicas: List[torch.nn.Module], **weights
+                               ) -> None:
+    """In-place neighbor averaging across a list of per-rank module replicas
+    (the AWC/ATC combine step for torch prototyping loops)."""
+    assert len(replicas) == _b.size(), \
+        f"need one replica per rank ({_b.size()}), got {len(replicas)}"
+    named = [dict(m.named_parameters()) for m in replicas]
+    for key in named[0]:
+        stacked = torch.stack([np_[key].detach() for np_ in named])
+        combined = neighbor_allreduce(stacked, name=key, **weights)
+        for r, np_ in enumerate(named):
+            np_[key].copy_(combined[r])

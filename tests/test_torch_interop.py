@@ -1,0 +1,67 @@
+"""Torch interop layer tests (mirrors the reference's second-frontend tests,
+``test/tensorflow_ops_test.py``)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import bluefog_tpu as bf  # noqa: E402
+import bluefog_tpu.torch as bft  # noqa: E402
+from bluefog_tpu import topology as topo  # noqa: E402
+
+N = 8
+
+
+def setup_function(_fn):
+    bf.init(lambda: topo.ExponentialTwoGraph(N))
+
+
+def test_torch_allreduce_and_broadcast():
+    x = torch.arange(N, dtype=torch.float32).reshape(N, 1) + 1
+    out = bft.allreduce(x, average=True)
+    assert torch.allclose(out, torch.full((N, 1), 4.5))
+    b = bft.broadcast(x, root_rank=2)
+    assert torch.allclose(b, torch.full((N, 1), 3.0))
+
+
+def test_torch_allgather_dtype_preserved():
+    x = torch.ones(N, 2, dtype=torch.float64)
+    out = bft.allgather(x)
+    assert out.dtype == torch.float64
+    assert out.shape == (N, N * 2)
+
+
+def test_torch_neighbor_allreduce_consensus():
+    x = torch.randn(N, 16)
+    target = x.mean(0)
+    y = x.clone()
+    for _ in range(60):
+        y = bft.neighbor_allreduce(y)
+    assert torch.allclose(y, target.expand_as(y), atol=1e-4)
+
+
+def test_torch_module_replicas_consensus():
+    models = [torch.nn.Linear(4, 2) for _ in range(N)]
+    bft.neighbor_allreduce_module_(models)
+    for _ in range(40):
+        bft.neighbor_allreduce_module_(models)
+    w0 = models[0].weight.detach()
+    for m in models[1:]:
+        assert torch.allclose(m.weight.detach(), w0, atol=1e-5)
+
+
+def test_torch_replicate_and_broadcast_parameters():
+    m = torch.nn.Linear(3, 3)
+    stacked = bft.replicate_module(m)
+    assert all(v.shape[0] == N for v in stacked.values())
+    # perturb non-root replicas, then broadcast root 0 back out
+    for k in stacked:
+        stacked[k][1:] += 1.0
+    synced = bft.broadcast_parameters(stacked, root_rank=0)
+    for k, v in synced.items():
+        for r in range(N):
+            assert torch.allclose(v[r], stacked[k][0])
+    m2 = torch.nn.Linear(3, 3)
+    bft.load_replica(m2, synced, rank=3)
+    assert torch.allclose(m2.weight, m.weight)
