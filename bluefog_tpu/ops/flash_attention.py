@@ -1,0 +1,188 @@
+"""Flash attention: Pallas TPU kernel (forward) + blockwise custom VJP.
+
+The hot op of the long-context path.  ``parallel.ring_attention`` and
+``parallel.ulysses`` shard the *sequence*; this kernel makes the per-device
+block attention itself O(S) in memory by streaming K/V blocks through VMEM
+with the online-softmax recurrence — logits never materialize in HBM.
+
+Forward: one Pallas program per (batch*head, q-block); K/V live in VMEM per
+head and are consumed ``block_k`` rows at a time on the MXU
+(``jnp.dot(..., preferred_element_type=f32)``).  Causal programs stop their
+K loop at the diagonal block (no wasted FLOPs on masked-out tiles).
+
+Backward: recomputes probabilities blockwise from the saved per-row
+logsumexp (the standard flash backward), expressed as a ``lax.scan`` over K
+blocks in plain JAX — still O(S) memory, and XLA maps the per-block matmuls
+onto the MXU directly.
+
+Layout: ``(B, S, H, D)`` like ``models.local_attention``; internally
+``(B*H, S, D)``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention", "flash_attention_impl"]
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                causal: bool, block_q: int, block_k: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)                       # (BQ, D)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+
+    n_kb = seq_len // block_k
+    if causal:
+        # Last K block that intersects the causal frontier of this Q block.
+        n_kb = jnp.minimum(n_kb, ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(kb, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        o_new = o * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    o, m, l = lax.fori_loop(0, n_kb, body, (o, m, l))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (o / l).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l))[:, 0]
+
+
+def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    B, S, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    bh = B * H
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(bh, S, D)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    assert S % block_q == 0 and S % block_k == 0, \
+        f"seq len {S} must be divisible by block sizes ({block_q},{block_k})"
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=S)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, S // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, S, D), q.dtype),
+            jax.ShapeDtypeStruct((bh, S), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    unfold = lambda t: t.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    return unfold(o), (qf, kf, vf, o, lse, (B, S, H, D, scale, causal))
+
+
+def _bwd(block_q, block_k, interpret, res, do):
+    """Blockwise flash backward (recompute-P from logsumexp), O(S) memory."""
+    qf, kf, vf, o, lse, (B, S, H, D, scale, causal) = res
+    bh = B * H
+    dof = do.transpose(0, 2, 1, 3).reshape(bh, S, D).astype(jnp.float32)
+    q32, k32, v32 = (t.astype(jnp.float32) for t in (qf, kf, vf))
+    o32 = o.astype(jnp.float32)
+    delta = jnp.sum(dof * o32, axis=-1)                   # (bh, S)
+
+    block_k = min(block_k, S)
+    n_kb = S // block_k
+    pos = jnp.arange(S)
+
+    def per_kblock(kb):
+        ks = kb * block_k
+        kblk = lax.dynamic_slice_in_dim(k32, ks, block_k, axis=1)
+        vblk = lax.dynamic_slice_in_dim(v32, ks, block_k, axis=1)
+        s = jnp.einsum("bqd,bkd->bqk", q32, kblk) * scale
+        if causal:
+            k_pos = ks + jnp.arange(block_k)
+            mask = k_pos[None, None, :] <= pos[None, :, None]
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, :, None])                  # (bh, S, BK)
+        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, vblk)
+        ds = p * (dp - delta[:, :, None]) * scale
+        dk = jnp.einsum("bqk,bqd->bkd", ds, q32)
+        dq_part = jnp.einsum("bqk,bkd->bqd", ds, kblk)
+        return dq_part, dk, dv
+
+    def scan_body(dq_acc, kb):
+        dq_part, dk, dv = per_kblock(kb)
+        return dq_acc + dq_part, (dk, dv)
+
+    dq, (dks, dvs) = lax.scan(scan_body, jnp.zeros_like(q32),
+                              jnp.arange(n_kb))
+    dk = jnp.moveaxis(dks, 0, 1).reshape(bh, S, D)
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, S, D)
+    unfold = lambda t, dt: t.reshape(B, H, S, D).transpose(0, 2, 1, 3) \
+        .astype(dt)
+    return (unfold(dq, qf.dtype), unfold(dk, kf.dtype), unfold(dv, vf.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                  interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                interpret=interpret)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    return _bwd(block_q, block_k, interpret, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = None):
+    """Memory-O(S) exact attention; inputs/outputs ``(B, S, H, D)``.
+
+    ``interpret`` defaults to True off-TPU (Pallas interpreter) and False on
+    TPU (compiled Mosaic kernel)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+def flash_attention_impl(block_q: int = 128, block_k: int = 128):
+    """``attn_impl`` for ``models.TransformerLM`` / ``parallel.ulysses``."""
+    def impl(q, k, v, *, causal=True):
+        return flash_attention(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k)
+    return impl
