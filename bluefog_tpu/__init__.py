@@ -79,6 +79,7 @@ from bluefog_tpu.ops.window import (  # noqa: F401
     win_wait,
     win_poll,
     win_mutex,
+    win_fence,
     get_win_version,
     get_current_created_window_names,
     win_associated_p,

@@ -162,11 +162,23 @@ def init_distributed(topology_fn=None, is_weighted: bool = False) -> None:
         except Exception:  # single-process fallback (no pod metadata)
             pass
     init(topology_fn, is_weighted)
+    if jax.process_count() > 1:
+        # Bring up the DCN window transport so the one-sided family works
+        # across processes (each process owns its local devices' ranks).
+        from bluefog_tpu.ops import window as _window
+        try:
+            _window.init_transport()
+        except RuntimeError as e:
+            from bluefog_tpu.utils.logging import get_logger
+            get_logger().warning(
+                "window transport unavailable (%s); win_* ops will raise in "
+                "this multi-process run", e)
 
 
 def shutdown() -> None:
     from bluefog_tpu.ops import window as _window
     _window._free_all_windows()
+    _window._shutdown_transport()
     _reset_for_tests()
 
 
@@ -335,7 +347,9 @@ def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
             run, mesh=ctx.mesh,
             in_specs=(P(RANK_AXIS),) + (P(),) * n_extra,
             out_specs=P(RANK_AXIS)))
-    return _jitted(("flat", key, len(extra)), build)(_place(x), *extra)
+    from bluefog_tpu.utils.timeline import op_span
+    with op_span(str(key[0]), "ENQUEUE"):
+        return _jitted(("flat", key, len(extra)), build)(_place(x), *extra)
 
 
 def _dispatch_hier(key, fn, x) -> jnp.ndarray:
@@ -345,7 +359,9 @@ def _dispatch_hier(key, fn, x) -> jnp.ndarray:
             lambda b: fn(b[0])[None], mesh=ctx.hier_mesh,
             in_specs=P((MACHINE_AXIS, LOCAL_AXIS)),
             out_specs=P((MACHINE_AXIS, LOCAL_AXIS))))
-    return _jitted(("hier", key), build)(_place(x))
+    from bluefog_tpu.utils.timeline import op_span
+    with op_span(str(key[0]), "ENQUEUE"):
+        return _jitted(("hier", key), build)(_place(x))
 
 
 def _weight_override_matrix(
@@ -597,7 +613,9 @@ def wait(handle: Handle) -> jnp.ndarray:
 
 def synchronize(handle: Handle) -> jnp.ndarray:
     from bluefog_tpu.utils import stall
-    with stall.watch("collective synchronize"):
+    from bluefog_tpu.utils.timeline import op_span
+    with stall.watch("collective synchronize"), \
+            op_span("synchronize", "COMMUNICATE"):
         return jax.block_until_ready(handle)
 
 

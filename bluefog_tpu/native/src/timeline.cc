@@ -1,8 +1,11 @@
-// Chrome-trace timeline writer: SPSC ring buffer + dedicated writer thread.
+// Chrome-trace timeline writer: MPSC ring buffer + dedicated writer thread.
 //
-// Same architecture as the reference's Timeline (common/timeline.h:46-76:
+// Same role as the reference's Timeline (common/timeline.h:46-76:
 // boost::lockfree::spsc_queue capacity 2^20 + writer thread) without the
-// boost dependency: a fixed-slot ring with atomic head/tail.  The training
+// boost dependency.  Producers are *multiple* Python threads (user thread,
+// window workers, transport drain — ctypes releases the GIL), so slots are
+// claimed with a CAS on head and published through per-slot sequence
+// numbers (Vyukov bounded-queue scheme, single consumer).  The training
 // thread never blocks — on overflow events are dropped and counted
 // (the reference blocks instead; dropping is the right call on a TPU host
 // where the training thread also drives dispatch).
@@ -25,6 +28,10 @@ constexpr int kNameCap = 96;
 constexpr int kCatCap = 64;
 
 struct Event {
+  // seq == slot index: free for the producer claiming that index;
+  // seq == index + 1: payload published, ready for the consumer;
+  // consumer recycles with seq = index + kRingSize.
+  std::atomic<uint64_t> seq;
   char name[kNameCap];
   char cat[kCatCap];
   char phase;
@@ -51,13 +58,16 @@ struct bf_timeline {
   void Run() {
     for (;;) {
       uint64_t t = tail.load(std::memory_order_relaxed);
-      if (t == head.load(std::memory_order_acquire)) {
-        if (stop.load(std::memory_order_acquire)) break;
+      Event& e = ring[t & kRingMask];
+      if (e.seq.load(std::memory_order_acquire) != t + 1) {
+        // Slot not yet published (empty, or a producer mid-write).
+        if (stop.load(std::memory_order_acquire) &&
+            t == head.load(std::memory_order_acquire))
+          break;
         std::unique_lock<std::mutex> lk(wake_m);
         wake_cv.wait_for(lk, std::chrono::milliseconds(50));
         continue;
       }
-      const Event& e = ring[t & kRingMask];
       if (!first) std::fputs(",\n", f);
       first = false;
       if (e.phase == 'X') {
@@ -73,6 +83,7 @@ struct bf_timeline {
                      e.name, e.cat, e.phase, (long long)e.ts_us, pid,
                      (long long)e.tid);
       }
+      e.seq.store(t + kRingSize, std::memory_order_release);  // recycle slot
       tail.store(t + 1, std::memory_order_release);
     }
     std::fflush(f);
@@ -88,6 +99,8 @@ bf_timeline_t* bf_timeline_open(const char* path, int32_t pid) {
   t->f = f;
   t->pid = pid;
   t->ring = new Event[kRingSize];
+  for (uint64_t i = 0; i < kRingSize; ++i)
+    t->ring[i].seq.store(i, std::memory_order_relaxed);
   std::fputs("[\n", f);
   t->writer = std::thread([t] { t->Run(); });
   return t;
@@ -98,18 +111,29 @@ void bf_timeline_event(bf_timeline_t* t, const char* name, const char* cat,
                        int64_t tid) {
   if (!t) return;
   uint64_t h = t->head.load(std::memory_order_relaxed);
-  if (h - t->tail.load(std::memory_order_acquire) >= kRingSize) {
-    t->dropped.fetch_add(1, std::memory_order_relaxed);
-    return;
+  Event* e;
+  for (;;) {  // claim a slot (multi-producer CAS loop)
+    e = &t->ring[h & kRingMask];
+    uint64_t seq = e->seq.load(std::memory_order_acquire);
+    intptr_t dif = (intptr_t)seq - (intptr_t)h;
+    if (dif == 0) {
+      if (t->head.compare_exchange_weak(h, h + 1,
+                                        std::memory_order_relaxed))
+        break;
+    } else if (dif < 0) {  // ring full: drop, never stall the producer
+      t->dropped.fetch_add(1, std::memory_order_relaxed);
+      return;
+    } else {
+      h = t->head.load(std::memory_order_relaxed);
+    }
   }
-  Event& e = t->ring[h & kRingMask];
-  std::snprintf(e.name, kNameCap, "%s", name ? name : "");
-  std::snprintf(e.cat, kCatCap, "%s", cat ? cat : "");
-  e.phase = phase;
-  e.ts_us = ts_us;
-  e.dur_us = dur_us;
-  e.tid = tid;
-  t->head.store(h + 1, std::memory_order_release);
+  std::snprintf(e->name, kNameCap, "%s", name ? name : "");
+  std::snprintf(e->cat, kCatCap, "%s", cat ? cat : "");
+  e->phase = phase;
+  e->ts_us = ts_us;
+  e->dur_us = dur_us;
+  e->tid = tid;
+  e->seq.store(h + 1, std::memory_order_release);  // publish
   t->wake_cv.notify_one();
 }
 

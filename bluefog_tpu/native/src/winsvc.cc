@@ -167,28 +167,46 @@ int32_t bf_winsvc_recv(bf_winsvc_t* s, bf_win_msg_t* msg, uint8_t* payload,
   return 1;
 }
 
+namespace {
+
+// One pooled persistent connection per peer, each with its own mutex so a
+// slow or backpressured peer only stalls traffic headed to that peer — the
+// pool lock is held just long enough to find/create the entry, never across
+// getaddrinfo/connect/send.
+struct Conn {
+  std::mutex m;
+  int fd = -1;
+};
+
+}  // namespace
+
 int32_t bf_winsvc_send(const char* host, int32_t port, uint8_t op,
                        const char* name, int32_t src, int32_t dst,
                        double weight, double p_weight, const uint8_t* payload,
                        uint64_t payload_len) {
-  // Pooled persistent connections keyed by host:port (thread-safe).
   static std::mutex pool_m;
-  static std::map<std::string, int>* pool = new std::map<std::string, int>();
+  static std::map<std::string, Conn*>* pool =
+      new std::map<std::string, Conn*>();
   const std::string key = std::string(host) + ":" + std::to_string(port);
 
-  std::lock_guard<std::mutex> lk(pool_m);
-  int fd = -1;
-  auto it = pool->find(key);
-  if (it != pool->end()) fd = it->second;
+  Conn* conn;
+  {
+    std::lock_guard<std::mutex> lk(pool_m);
+    auto it = pool->find(key);
+    if (it == pool->end()) it = pool->emplace(key, new Conn).first;
+    conn = it->second;
+  }
+
+  std::lock_guard<std::mutex> lk(conn->m);  // serializes per peer only
   for (int attempt = 0; attempt < 2; ++attempt) {
-    if (fd < 0) {
+    if (conn->fd < 0) {
       addrinfo hints{}, *res = nullptr;
       hints.ai_family = AF_INET;
       hints.ai_socktype = SOCK_STREAM;
       const std::string port_s = std::to_string(port);
       if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res)
         return -1;
-      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      int fd = ::socket(AF_INET, SOCK_STREAM, 0);
       if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) < 0) {
         if (fd >= 0) ::close(fd);
         ::freeaddrinfo(res);
@@ -197,8 +215,9 @@ int32_t bf_winsvc_send(const char* host, int32_t port, uint8_t op,
       ::freeaddrinfo(res);
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      (*pool)[key] = fd;
+      conn->fd = fd;
     }
+    int fd = conn->fd;
     uint16_t name_len = (uint16_t)std::strlen(name);
     bool ok = WriteFull(fd, &kMagic, 4) && WriteFull(fd, &op, 1) &&
               WriteFull(fd, &src, 4) && WriteFull(fd, &dst, 4) &&
@@ -209,8 +228,7 @@ int32_t bf_winsvc_send(const char* host, int32_t port, uint8_t op,
     if (ok) return 0;
     // Stale pooled connection (peer restarted): drop and retry once fresh.
     ::close(fd);
-    pool->erase(key);
-    fd = -1;
+    conn->fd = -1;
   }
   return -3;
 }

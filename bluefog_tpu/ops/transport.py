@@ -24,10 +24,22 @@ import numpy as np
 
 from bluefog_tpu import native
 
+# Wire op codes — the single source of truth for the window protocol.  The
+# native layer carries ``op`` opaquely; codes beyond put/accumulate are
+# interpreted purely in Python (ops/window.py documents field use per op).
 OP_PUT = 1
 OP_ACCUMULATE = 2
+OP_GET_REQ = 3
+OP_GET_REPLY = 4
+OP_FENCE_REQ = 5
+OP_FENCE_ACK = 6
+OP_MUTEX_ACQ = 7
+OP_MUTEX_GRANT = 8
+OP_MUTEX_REL = 9
 
-__all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE"]
+__all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
+           "OP_GET_REPLY", "OP_FENCE_REQ", "OP_FENCE_ACK", "OP_MUTEX_ACQ",
+           "OP_MUTEX_GRANT", "OP_MUTEX_REL"]
 
 
 class WindowTransport:

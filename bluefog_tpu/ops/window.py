@@ -24,13 +24,21 @@ Semantics preserved from the reference (test oracle:
     per-edge staleness; associated-P mirrors every put/accumulate/update on a
     scalar so push-sum can de-bias.
 
-A process-global store is correct here because the eager API is single-
-controller (all ranks live in this process).  Multi-host DCN transport plugs
-in behind the same `_WindowStore` interface.
+Single-process runs use the process-global store directly (the eager API is
+single-controller: all ranks live in this process).  Multi-process runs keep
+the same API but split authority by *rank ownership*: each process is
+authoritative for the ranks of its local devices; one-sided edges whose
+target rank lives in another process travel over the DCN TCP transport
+(``ops/transport.py`` + ``native/src/winsvc.cc``) and are applied by the
+owner's drain thread with identical observable semantics — versions, mutex,
+associated-P (the structural analogue of the reference's passive-recv
+service, ``nccl_controller.cc:1113-1238``).  ``win_fence`` provides the
+epoch synchronization (parity: ``torch/mpi_win_ops.cc:608-646``).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from contextlib import contextmanager
@@ -44,10 +52,22 @@ __all__ = [
     "win_create", "win_free", "win_put", "win_put_nonblocking",
     "win_get", "win_get_nonblocking", "win_accumulate",
     "win_accumulate_nonblocking", "win_update", "win_update_then_collect",
-    "win_wait", "win_poll", "win_mutex", "get_win_version",
+    "win_wait", "win_poll", "win_mutex", "win_fence", "get_win_version",
     "get_current_created_window_names", "win_associated_p",
     "turn_on_win_ops_with_associated_p", "turn_off_win_ops_with_associated_p",
 ]
+
+# Wire op codes live in ops.transport (single source of truth).  Field use:
+#   GET_REQ    src=window src rank (owned by receiver), dst=requesting rank
+#   GET_REPLY  src/dst as the originating GET_REQ; payload = main[src]
+#   FENCE_REQ  src=requesting rank; FENCE_ACK echoes it back
+#   MUTEX_ACQ  src=requesting rank, dst=rank whose mutex; GRANT echoes;
+#   MUTEX_REL  src=requesting rank, dst=rank whose mutex
+from bluefog_tpu.ops.transport import (  # noqa: E402
+    OP_PUT, OP_ACCUMULATE, OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
+    OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL)
+
+_MSG_TIMEOUT_SEC = 300.0  # hard cap on waiting for a peer's reply
 
 
 class _Window:
@@ -81,15 +101,53 @@ class _Window:
         self.p_staging: Dict[tuple, float] = {k: 0.0 for k in self.staging}
 
 
+class _Distrib:
+    """Multi-process window state: DCN transport + rank-ownership directory.
+
+    ``rank_owner[r]`` is the process index authoritative for rank ``r``;
+    ``proc_addr[p]`` is process ``p``'s (host, port) transport endpoint."""
+
+    def __init__(self, transport, rank_owner: Dict[int, int],
+                 proc_addr: Dict[int, tuple], my_proc: int):
+        self.transport = transport
+        self.rank_owner = rank_owner
+        self.proc_addr = proc_addr
+        self.my_proc = my_proc
+        self.my_rank = min(r for r, p in rank_owner.items() if p == my_proc)
+        self.cv = threading.Condition()
+        self.pending_gets: Dict[tuple, int] = {}   # (name, dst, src) -> n
+        self.fence_acks = 0
+        # remote-mutex bookkeeping.  grant_events is safe keyed on
+        # (name, rank) because mutex_serial allows one outstanding ACQ per
+        # (name, rank) per process; different processes land in distinct
+        # remote_holds entries (keyed by requester rank).
+        self.grant_events: Dict[tuple, threading.Event] = {}  # (name, rank)
+        self.remote_holds: Dict[tuple, threading.Event] = {}  # (name, rank, req)
+        self.mutex_serial: Dict[tuple, threading.Lock] = {}   # (name, rank)
+        # inbound messages for windows not yet created locally (SPMD skew)
+        self.parked: Dict[str, list] = {}
+
+
 class _WindowStore:
     def __init__(self):
         self.windows: Dict[str, _Window] = {}
         self.lock = threading.RLock()
-        self.pool = ThreadPoolExecutor(max_workers=4,
+        self.pool = ThreadPoolExecutor(max_workers=8,
                                        thread_name_prefix="bf-win")
+        # Inbound service work (GET replies, fence acks) runs on its own
+        # executor: user ops on `pool` BLOCK waiting for peers' replies, so
+        # servicing replies from the same pool could deadlock both sides
+        # until timeout when the pool is saturated with blocked user ops.
+        self.svc_pool = ThreadPoolExecutor(max_workers=4,
+                                           thread_name_prefix="bf-win-svc")
         self.handles: Dict[int, Future] = {}
         self.next_handle = 0
         self.associated_p_enabled = False
+        self.distrib: Optional[_Distrib] = None
+        # Messages that arrived between the listener going live and the
+        # directory being installed (peers can finish init_transport's
+        # allgather earlier than us and start sending immediately).
+        self.preinit_msgs: list = []
 
     def get(self, name: str) -> _Window:
         with self.lock:
@@ -120,8 +178,275 @@ def _free_all_windows() -> None:
         _store.windows.clear()
 
 
+def _shutdown_transport() -> None:
+    d = _store.distrib
+    _store.distrib = None
+    if d is not None:
+        d.transport.stop()
+
+
 def _to_numpy(x) -> np.ndarray:
-    return np.asarray(jax.device_get(x))
+    try:
+        return np.asarray(jax.device_get(x))
+    except RuntimeError:
+        # Multi-host sharded array: assemble the addressable rows; rows of
+        # ranks owned elsewhere are zero-filled and never read (only owned
+        # rows feed edge sends and self-scaling).
+        x = jnp.asarray(x)
+        out = np.zeros(x.shape, dtype=np.dtype(x.dtype.name))
+        for shard in x.addressable_shards:
+            out[shard.index] = np.asarray(shard.data)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Multi-process plumbing (rank ownership + DCN transport)
+# ---------------------------------------------------------------------------
+
+def _owns(rank: int) -> bool:
+    d = _store.distrib
+    return d is None or d.rank_owner[rank] == d.my_proc
+
+
+def _owned_ranks(n: int) -> List[int]:
+    d = _store.distrib
+    if d is None:
+        return list(range(n))
+    return [r for r in range(n) if d.rank_owner[r] == d.my_proc]
+
+
+def _local_host_addr() -> str:
+    """This process's DCN-reachable address for the window transport."""
+    import socket
+    override = os.environ.get("BFTPU_WIN_HOST")
+    if override:
+        return override
+    coord = os.environ.get("BFTPU_COORDINATOR")
+    if coord and ":" in coord:
+        # Learn the interface that routes to the coordinator (UDP trick:
+        # no packet is sent, the kernel just picks the route).
+        try:
+            host, port = coord.rsplit(":", 1)
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((host, int(port)))
+                return s.getsockname()[0]
+        except OSError:
+            pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def init_transport() -> bool:
+    """Start the DCN window transport and exchange the rank directory.
+
+    Called by ``basics.init_distributed()`` when the world spans processes.
+    The per-process (host, port) endpoint is allgathered over the coordinator
+    (``multihost_utils.process_allgather``), replacing the reference's MPI
+    control plane for window bootstrap (``nccl_controller.cc:1240-1286``)."""
+    from bluefog_tpu import basics
+    if _store.distrib is not None:
+        return True
+    if jax.process_count() == 1:
+        return False
+    from bluefog_tpu.ops.transport import WindowTransport
+    transport = WindowTransport(_apply_inbound)
+    me = f"{_local_host_addr()}:{transport.port}".encode()
+    if len(me) > 64:
+        raise ValueError(f"transport address too long: {me!r}")
+    buf = np.zeros(64, np.uint8)
+    buf[:len(me)] = np.frombuffer(me, np.uint8)
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    proc_addr = {}
+    for p in range(gathered.shape[0]):
+        addr = bytes(gathered[p]).rstrip(b"\0").decode()
+        host, _, port = addr.rpartition(":")
+        proc_addr[p] = (host, int(port))
+    rank_owner = {i: d.process_index
+                  for i, d in enumerate(basics._ctx.devices)}
+    with _store.lock:
+        # Install the directory and replay messages that raced ahead of it
+        # under one lock hold, so the drain thread (blocked on this lock in
+        # its preinit check) cannot interleave a newer message first.
+        _store.distrib = _Distrib(transport, rank_owner, proc_addr,
+                                  jax.process_index())
+        pending, _store.preinit_msgs = _store.preinit_msgs, []
+        for msg in pending:
+            _apply_inbound(*msg)
+    return True
+
+
+def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
+                  weight: float, p_weight: float = 0.0,
+                  payload: Optional[np.ndarray] = None) -> None:
+    d = _store.distrib
+    host, port = d.proc_addr[proc]
+    d.transport.send(host, port, op, name, src, dst, weight,
+                     payload if payload is not None
+                     else np.empty(0, np.uint8), p_weight)
+
+
+def _send_to_rank_owner(rank: int, op: int, name: str, src: int, dst: int,
+                        weight: float, p_weight: float = 0.0,
+                        payload: Optional[np.ndarray] = None) -> None:
+    _send_to_proc(_store.distrib.rank_owner[rank], op, name, src, dst,
+                  weight, p_weight, payload)
+
+
+def _payload_row(win: _Window, payload: bytes) -> np.ndarray:
+    return np.frombuffer(payload, dtype=win.dtype).reshape(win.shape).copy()
+
+
+def _reply_get(name: str, src: int, dst: int, weight: float) -> None:
+    """Answer a GET_REQ: ship ``main[src]`` (owned here) back to ``dst``'s
+    owner, which scales by ``weight`` on receipt.  ``win.lock`` gives the
+    row snapshot atomicity; callers wanting writer exclusion take the
+    distributed mutex explicitly (``win_mutex``)."""
+    try:
+        win = _store.get(name)
+    except KeyError:
+        return  # freed concurrently; requester's timeout reports it
+    with win.lock:
+        row = win.main[src].copy()
+        p_w = weight * float(win.p_main[src])
+    _send_to_rank_owner(dst, OP_GET_REPLY, name, src, dst, weight, p_w, row)
+
+
+@contextmanager
+def _remote_mutex(name: str, rank: int, my_rank: int):
+    """Writer-side distributed mutex on a remotely-owned rank: ACQ → wait
+    GRANT → (critical section) → REL.  The REL travels the same FIFO stream
+    as any puts sent inside, so the owner applies them before releasing —
+    the TCP analogue of lock/put/unlock (``mpi_controller.cc:953-1034``)."""
+    d = _store.distrib
+    with d.cv:
+        serial = d.mutex_serial.setdefault((name, rank), threading.Lock())
+    with serial:  # one outstanding ACQ per (name, rank) per process
+        granted = threading.Event()
+        with d.cv:
+            d.grant_events[(name, rank)] = granted
+        try:
+            _send_to_rank_owner(rank, OP_MUTEX_ACQ, name, my_rank, rank, 0.0)
+            if not granted.wait(timeout=_MSG_TIMEOUT_SEC):
+                raise ConnectionError(
+                    f"win_mutex({name!r}): rank {rank}'s owner did not grant "
+                    f"within {_MSG_TIMEOUT_SEC:.0f}s")
+            yield
+        finally:
+            _send_to_rank_owner(rank, OP_MUTEX_REL, name, my_rank, rank, 0.0)
+            with d.cv:
+                d.grant_events.pop((name, rank), None)
+
+
+def _hold_mutex_for_remote(name: str, rank: int, requester: int) -> None:
+    """Acquire rank's (locally-owned) mutex on behalf of a remote requester;
+    hold it until the matching MUTEX_REL arrives.  Runs on its own daemon
+    thread (holds are long-lived; they must not occupy service workers)."""
+    d = _store.distrib
+    try:
+        win = _store.get(name)
+    except KeyError:
+        return
+    release = threading.Event()
+    key = (name, rank, requester)
+    with d.cv:
+        d.remote_holds[key] = release
+    try:
+        with win.mutexes[rank]:
+            _send_to_rank_owner(requester, OP_MUTEX_GRANT, name, requester,
+                                rank, 0.0)
+            release.wait(timeout=_MSG_TIMEOUT_SEC)
+    finally:
+        with d.cv:
+            # Only remove our own registration: a back-to-back ACQ from the
+            # same requester may already have installed its successor event.
+            if d.remote_holds.get(key) is release:
+                d.remote_holds.pop(key, None)
+
+
+def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
+                   p_weight: float, payload: bytes) -> None:
+    """Drain-thread entry: apply one inbound transport message to the local
+    (owned) window state.  Must never block on peers — replies and mutex
+    holds are pushed onto the worker pool."""
+    d = _store.distrib
+    if d is None:
+        with _store.lock:
+            if _store.distrib is None:
+                # Directory not installed yet (peer finished init first):
+                # buffer — init_transport replays in arrival order.
+                _store.preinit_msgs.append(
+                    (op, name, src, dst, weight, p_weight, payload))
+                return
+            d = _store.distrib
+    if op == OP_FENCE_REQ:
+        _store.svc_pool.submit(_send_to_rank_owner, src, OP_FENCE_ACK, "",
+                               src, dst, 0.0)
+        return
+    if op == OP_FENCE_ACK:
+        with d.cv:
+            d.fence_acks += 1
+            d.cv.notify_all()
+        return
+    if op == OP_MUTEX_GRANT:
+        with d.cv:
+            ev = d.grant_events.get((name, dst))
+        if ev is not None:
+            ev.set()
+        return
+    if op == OP_MUTEX_REL:
+        with d.cv:
+            ev = d.remote_holds.get((name, dst, src))
+        if ev is not None:
+            ev.set()
+        return
+    with _store.lock:
+        win = _store.windows.get(name)
+        if win is None:
+            # SPMD skew: the peer created + wrote this window before our
+            # win_create ran.  Park; win_create replays in arrival order.
+            d.parked.setdefault(name, []).append(
+                (op, name, src, dst, weight, p_weight, payload))
+            return
+    if op in (OP_PUT, OP_ACCUMULATE):
+        # Deliberately mutex-free: the drain thread must never block on a
+        # rank mutex (a remote holder's REL would be queued behind us —
+        # deadlock).  Slot atomicity comes from win.lock; writer exclusion
+        # is the sender's job via the distributed mutex (_remote_mutex).
+        row = _payload_row(win, payload)
+        with win.lock:
+            if (dst, src) not in win.staging:
+                return
+            if op == OP_ACCUMULATE:
+                win.staging[(dst, src)] += row * win.dtype.type(weight)
+            else:
+                win.staging[(dst, src)] = row * win.dtype.type(weight)
+            win.versions[dst, src] += 1
+            if _store.associated_p_enabled:
+                if op == OP_ACCUMULATE:
+                    win.p_staging[(dst, src)] += p_weight
+                else:
+                    win.p_staging[(dst, src)] = p_weight
+    elif op == OP_GET_REQ:
+        _store.svc_pool.submit(_reply_get, name, src, dst, weight)
+    elif op == OP_GET_REPLY:
+        row = _payload_row(win, payload)
+        with win.lock:
+            if (dst, src) in win.staging:
+                win.staging[(dst, src)] = row * win.dtype.type(weight)
+                win.versions[dst, src] += 1
+                if _store.associated_p_enabled:
+                    win.p_staging[(dst, src)] = p_weight
+        with d.cv:
+            key = (name, dst, src)
+            d.pending_gets[key] = d.pending_gets.get(key, 0) - 1
+            d.cv.notify_all()
+    elif op == OP_MUTEX_ACQ:
+        threading.Thread(target=_hold_mutex_for_remote,
+                         args=(name, dst, src), daemon=True,
+                         name=f"bf-win-hold-{dst}").start()
 
 
 def _neighbors_from_topology():
@@ -175,14 +500,27 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
     """Create a named window from a rank-major tensor ``(size, ...)``.
 
     Allocates one staging buffer per in-neighbor edge of the *current*
-    topology (which is frozen while windows exist, as in the reference)."""
+    topology (which is frozen while windows exist, as in the reference).
+    In multi-process runs this is an SPMD call (every process creates the
+    window); inbound gossip that raced ahead of local creation is replayed
+    in arrival order."""
+    if jax.process_count() > 1 and _store.distrib is None:
+        raise RuntimeError(
+            "window ops across processes need the DCN transport: call "
+            "bf.init_distributed() (and build the native core with "
+            "`make -C bluefog_tpu/native`) before win_create — without it "
+            "each process would silently gossip with its own private copy")
     n, in_nbrs, out_nbrs = _neighbors_from_topology()
     t = _to_numpy(tensor)
     assert t.shape[0] == n, f"rank-major tensor required (leading dim {n})"
+    d = _store.distrib
     with _store.lock:
         if name in _store.windows:
             return False
         _store.windows[name] = _Window(name, t, in_nbrs, out_nbrs, zero_init)
+        if d is not None:
+            for msg in d.parked.pop(name, []):
+                _apply_inbound(*msg)
     return True
 
 
@@ -225,7 +563,30 @@ def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
         win = _store.get(name)
     except KeyError:
         return  # window freed after dispatch; put becomes a no-op
+    op = OP_ACCUMULATE if accumulate else OP_PUT
     for (src, dst), w in edges.items():
+        if not _owns(src):
+            continue  # src's owner performs this edge
+        if not _owns(dst):
+            # Remote edge: ship the raw row + weight; the owner's drain
+            # thread scales and applies (one-sided put completion = local
+            # send completion; remote visibility is ordered by win_fence /
+            # win_update, as with MPI_Put).  require_mutex maps to the
+            # writer-side distributed mutex, as in the reference.
+            with win.lock:
+                p_w = w * float(win.p_main[src]) \
+                    if _store.associated_p_enabled else 0.0
+            # Cast to the window dtype: the receiver reconstructs the row
+            # with frombuffer(win.dtype), so a mismatched payload would be
+            # dropped on exactly the cross-process edges.
+            payload = np.ascontiguousarray(tensor[src], dtype=win.dtype)
+            if require_mutex:
+                with _remote_mutex(name, dst, src):
+                    _send_to_rank_owner(dst, op, name, src, dst, w, p_w,
+                                        payload)
+            else:
+                _send_to_rank_owner(dst, op, name, src, dst, w, p_w, payload)
+            continue
         payload = tensor[src] * win.dtype.type(w)
         mutex = win.mutexes[dst] if require_mutex else None
         if mutex:
@@ -250,14 +611,18 @@ def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
     if self_weight is not None:
         # Self-scaling happens AFTER the edge sends so outgoing payloads carry
         # the PRE-scaled associated-P mass (column-stochastic conservation:
-        # self_weight + sum of dst weights == 1 must hold on p_old).
+        # self_weight + sum of dst weights == 1 must hold on p_old).  Only
+        # owned rows are authoritative here.
         sw = np.asarray(self_weight, dtype=float)
         with win.lock:
             shape = (-1,) + (1,) * len(win.shape)
-            win.main[:] = (tensor * sw.reshape(shape)).astype(win.dtype) \
-                if sw.ndim else tensor * win.dtype.type(float(sw))
-            if _store.associated_p_enabled:
-                win.p_main *= sw if sw.ndim else float(sw)
+            scaled = (tensor * sw.reshape(shape)).astype(win.dtype) \
+                if sw.ndim else (tensor * win.dtype.type(float(sw)))
+            sw_vec = sw if sw.ndim else np.full(win.n, float(sw))
+            for r in _owned_ranks(win.n):
+                win.main[r] = scaled[r]
+                if _store.associated_p_enabled:
+                    win.p_main[r] *= sw_vec[r]
 
 
 def win_put_nonblocking(tensor, name: str, *, self_weight=None,
@@ -274,9 +639,13 @@ def win_put_nonblocking(tensor, name: str, *, self_weight=None,
     win = _store.get(name)  # raise early on unknown window
     edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0)
     _validate_edges(edges, win.out_nbrs, peer_is_src=False, op="win_put")
-    return _store.submit(
-        lambda: _do_put(name, t, edges, require_mutex,
-                        accumulate=False, self_weight=self_weight))
+    from bluefog_tpu.utils.timeline import op_span
+
+    def _work():
+        with op_span(f"win_put.{name}", "COMMUNICATE"):
+            _do_put(name, t, edges, require_mutex,
+                    accumulate=False, self_weight=self_weight)
+    return _store.submit(_work)
 
 
 def win_put(tensor, name: str, *, self_weight: float = None, dst_weights=None,
@@ -299,9 +668,13 @@ def win_accumulate_nonblocking(tensor, name: str, *, self_weight=None,
     edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0)
     _validate_edges(edges, win.out_nbrs, peer_is_src=False,
                     op="win_accumulate")
-    return _store.submit(
-        lambda: _do_put(name, t, edges, require_mutex,
-                        accumulate=True, self_weight=self_weight))
+    from bluefog_tpu.utils.timeline import op_span
+
+    def _work():
+        with op_span(f"win_accumulate.{name}", "COMMUNICATE"):
+            _do_put(name, t, edges, require_mutex,
+                    accumulate=True, self_weight=self_weight)
+    return _store.submit(_work)
 
 
 def win_accumulate(tensor, name: str, *, self_weight=None,
@@ -317,7 +690,14 @@ def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
         win = _store.get(name)
     except KeyError:
         return  # window freed after dispatch; get becomes a no-op
+    d = _store.distrib
+    remote = []
     for (dst, src), w in edges.items():
+        if not _owns(dst):
+            continue  # dst's owner performs this edge
+        if not _owns(src):
+            remote.append((dst, src, w))
+            continue
         mutex = win.mutexes[src] if require_mutex else None
         if mutex:
             mutex.acquire()
@@ -332,6 +712,28 @@ def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
         finally:
             if mutex:
                 mutex.release()
+    if remote:
+        # One-sided pull: request each remote row, then wait for the replies
+        # (the blocking analogue of chunked MPI_Get, mpi_controller.cc:1123).
+        with d.cv:
+            for (dst, src, w) in remote:
+                key = (name, dst, src)
+                d.pending_gets[key] = d.pending_gets.get(key, 0) + 1
+        for (dst, src, w) in remote:
+            _send_to_rank_owner(src, OP_GET_REQ, name, src, dst, w)
+        deadline_keys = [(name, dst, src) for (dst, src, _) in remote]
+        with d.cv:
+            ok = d.cv.wait_for(
+                lambda: all(d.pending_gets.get(k, 0) <= 0
+                            for k in deadline_keys),
+                timeout=_MSG_TIMEOUT_SEC)
+            for k in deadline_keys:
+                d.pending_gets.pop(k, None)
+        if not ok:
+            raise ConnectionError(
+                f"win_get({name!r}): no reply from remote rank(s) "
+                f"{sorted({s for (_, s, _) in remote})} within "
+                f"{_MSG_TIMEOUT_SEC:.0f}s")
 
 
 def win_get_nonblocking(name: str, *, src_weights=None,
@@ -341,7 +743,12 @@ def win_get_nonblocking(name: str, *, src_weights=None,
     edges = _resolve_edge_weights(src_weights, win.in_nbrs, 1.0,
                                   peer_is_src=True)
     _validate_edges(edges, win.in_nbrs, peer_is_src=True, op="win_get")
-    return _store.submit(lambda: _do_get(name, edges, require_mutex))
+    from bluefog_tpu.utils.timeline import op_span
+
+    def _work():
+        with op_span(f"win_get.{name}", "COMMUNICATE"):
+            _do_get(name, edges, require_mutex)
+    return _store.submit(_work)
 
 
 def win_get(name: str, *, src_weights=None, require_mutex: bool = False) -> bool:
@@ -375,15 +782,21 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
 
     ``out_i = sw_i * main_i + sum_src w[dst=i,src] * staging[i,src]``; writes
     back to self memory and returns the rank-major result as a jax array.
-    ``reset_weights`` zeroes the staging buffers afterwards."""
+    ``reset_weights`` zeroes the staging buffers afterwards.
+
+    Multi-process: only rows of ranks owned by this process are combined and
+    returned fresh (every process runs the same update for its own ranks);
+    other rows of the returned array are this process's last-known copies."""
+    from bluefog_tpu.utils.timeline import op_span
     win = _store.get(name)
+    owned = _owned_ranks(win.n)
     acquired = []
     if require_mutex:
-        for m in win.mutexes:
-            m.acquire()
-            acquired.append(m)
+        for r in owned:  # only owned mutexes matter — remote writers to my
+            win.mutexes[r].acquire()   # staging serialize on my owner locks
+            acquired.append(win.mutexes[r])
     try:
-        with win.lock:
+        with op_span(f"win_update.{name}", "UPDATE"), win.lock:
             if (self_weight is None) != (neighbor_weights is None):
                 raise ValueError(
                     "self_weight and neighbor_weights have to be presented at "
@@ -395,24 +808,35 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
                 self_w = np.full(n, 1.0 if self_weight is None else self_weight)
                 nbr_w = _resolve_edge_weights(
                     neighbor_weights, win.in_nbrs, 1.0, peer_is_src=True)
-            out = win.main * self_w.reshape((-1,) + (1,) * len(win.shape)) \
-                if isinstance(self_w, np.ndarray) \
-                else win.main * self_w
-            out = np.asarray(out, dtype=win.dtype)
-            p_out = win.p_main * (self_w if isinstance(self_w, np.ndarray)
-                                  else np.full(win.n, self_w))
-            for (dst, src), w in nbr_w.items():
-                if (dst, src) in win.staging:
-                    out[dst] += win.staging[(dst, src)] * win.dtype.type(w)
-                    p_out[dst] += w * win.p_staging[(dst, src)]
+            self_w_vec = self_w if isinstance(self_w, np.ndarray) \
+                else np.full(win.n, float(self_w))
+            out = win.main.copy()
+            p_out = win.p_main.copy()
+            # Combine + reset are scoped to owned ranks: rows owned by other
+            # processes stay untouched (their owners run the same update),
+            # and version counters reset per updated target only — one
+            # rank's update never wipes another's staleness counters
+            # (reference per-target semantics, mpi_context.cc:91-113).
+            for dst in owned:
+                acc = np.asarray(win.main[dst] * self_w_vec[dst],
+                                 dtype=win.dtype)
+                p_acc = win.p_main[dst] * self_w_vec[dst]
+                for src in win.in_nbrs[dst]:
+                    w = nbr_w.get((dst, src))
+                    if w is None or (dst, src) not in win.staging:
+                        continue
+                    acc = acc + win.staging[(dst, src)] * win.dtype.type(w)
+                    p_acc += w * win.p_staging[(dst, src)]
+                out[dst] = acc
+                p_out[dst] = p_acc
+                win.versions[dst, :] = 0
+                if reset_weights:
+                    for src in win.in_nbrs[dst]:
+                        win.staging[(dst, src)][:] = 0
+                        win.p_staging[(dst, src)] = 0.0
             win.main[:] = out
             if _store.associated_p_enabled:
                 win.p_main[:] = p_out
-            if reset_weights:
-                for k in win.staging:
-                    win.staging[k][:] = 0
-                    win.p_staging[k] = 0.0
-            win.versions[:] = 0
             return jnp.asarray(out)
     finally:
         for m in acquired:
@@ -458,21 +882,73 @@ def win_mutex(name: str, *, for_self: bool = False,
               ranks: Optional[List[int]] = None):
     """Acquire the distributed mutex of the given ranks (default: my
     out-neighbors; ``for_self`` adds my own rank) — reference
-    ``mpi_controller.cc:1532-1602`` exposed via ``bf.win_mutex``."""
+    ``mpi_controller.cc:1532-1602`` exposed via ``bf.win_mutex``.
+
+    Ranks owned by other processes are locked through the transport
+    (ACQ→GRANT, released by REL): the owner's worker holds the rank's local
+    lock until our release message lands.  Acquisition is in ascending rank
+    order everywhere, so cross-process lock cycles cannot form."""
     from bluefog_tpu import basics
     win = _store.get(name)
+    d = _store.distrib
     if ranks is None:
         ranks = sorted(set(basics.out_neighbor_ranks(basics.rank())))
         if for_self:
             ranks = sorted(set(ranks + [basics.rank()]))
-    locks = [win.mutexes[r] for r in sorted(set(ranks))]
-    for l in locks:
-        l.acquire()
-    try:
+    my_rank = basics.rank()
+    from contextlib import ExitStack
+    with ExitStack() as stack:
+        for r in sorted(set(ranks)):  # ascending everywhere: no lock cycles
+            if _owns(r):
+                win.mutexes[r].acquire()
+                stack.callback(win.mutexes[r].release)
+            else:
+                stack.enter_context(_remote_mutex(name, r, my_rank))
         yield
-    finally:
-        for l in reversed(locks):
-            l.release()
+
+
+def win_fence(name: Optional[str] = None) -> None:
+    """Collective epoch fence over the one-sided family (parity:
+    ``bf.win_fence``, reference ``torch/mpi_win_ops.cc:608-646``).
+
+    On return: every window op this process dispatched has executed, every
+    transport message any process sent before its fence has been applied at
+    its target, and all processes have reached the fence.  Per-connection
+    TCP FIFO makes the ack exact: our FENCE_REQ trails our puts on the same
+    stream, so the peer's ack certifies those puts were applied."""
+    from bluefog_tpu import basics
+    with _store.lock:
+        outstanding = list(_store.handles.items())
+    errors = []
+    for _, fut in outstanding:
+        try:
+            fut.result(timeout=_MSG_TIMEOUT_SEC)
+        except KeyError:
+            pass  # window freed while the op was in flight (win_wait parity)
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            errors.append(e)
+    with _store.lock:
+        # Fence completes the handles it waited on — a fence-only flow
+        # (nonblocking ops, no win_wait) must not leak futures forever.
+        for h, _ in outstanding:
+            _store.handles.pop(h, None)
+    if errors:
+        raise errors[0]
+    d = _store.distrib
+    if d is not None:
+        peers = [p for p in d.proc_addr if p != d.my_proc]
+        with d.cv:
+            d.fence_acks = 0
+        for p in peers:
+            _send_to_proc(p, OP_FENCE_REQ, name or "", d.my_rank, -1, 0.0)
+        with d.cv:
+            ok = d.cv.wait_for(lambda: d.fence_acks >= len(peers),
+                               timeout=_MSG_TIMEOUT_SEC)
+        if not ok:
+            raise ConnectionError(
+                f"win_fence: missing acks ({d.fence_acks}/{len(peers)}) "
+                f"after {_MSG_TIMEOUT_SEC:.0f}s")
+    basics.barrier()
 
 
 def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:

@@ -60,11 +60,28 @@ def save(path: str, tree: Any, *, step: Optional[int] = None,
     return path
 
 
-def restore(path: str, *, step: Optional[int] = None) -> Any:
+def restore(path: str, *, step: Optional[int] = None,
+            target: Any = None) -> Any:
+    """Restore a pytree.
+
+    Without ``target``, orbax returns generic dicts/lists — fine for plain
+    dict trees, but NamedTuples (e.g. ``DistOptState``) and optax state
+    tuples lose their structure.  Pass ``target`` (a matching tree of arrays,
+    e.g. a freshly-initialized optimizer state) to get the original structure
+    back, ready for ``opt.step``."""
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step:010d}")
-    return _checkpointer().restore(path)
+    ckpt = _checkpointer()
+    if target is None:
+        return ckpt.restore(path)
+    import orbax.checkpoint as ocp
+    restored = ckpt.restore(
+        path, args=ocp.args.PyTreeRestore(item=jax.tree.map(np.asarray,
+                                                            target)))
+    # Re-attach the target's tree structure (NamedTuple/custom nodes).
+    return jax.tree.unflatten(jax.tree.structure(target),
+                              jax.tree.leaves(restored))
 
 
 def latest_step(path: str) -> Optional[int]:

@@ -110,12 +110,27 @@ _active: Dict[str, object] = {}
 _lock = threading.Lock()
 
 
+def _process_index() -> int:
+    """This process's rank for timeline file naming (never 0-hardcoded:
+    under ``bfrun`` fan-out every process would clobber the same file)."""
+    env = os.environ.get("BFTPU_PROCESS_ID")
+    if env is not None:
+        return int(env)
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
 def _maybe_autostart():
     global _writer
     if _writer is None:
         prefix = os.environ.get("BLUEFOG_TIMELINE")
         if prefix:
-            start_timeline(f"{prefix}0.json")
+            # One file per rank, <prefix><rank>.json — matches reference
+            # operations.cc:450-459.
+            start_timeline(f"{prefix}{_process_index()}.json")
 
 
 def timeline_enabled() -> bool:
@@ -186,3 +201,26 @@ def timeline_context(tensor_name: str, activity_name: str = "USER"):
         yield
     finally:
         timeline_end_activity(tensor_name, activity_name)
+
+
+@contextmanager
+def op_span(op_name: str, phase: str):
+    """Framework-internal op-phase span (ENQUEUE/COMMUNICATE/UPDATE...):
+    the automatic analogue of the reference's per-phase ActivityStart/End
+    hooks (``mpi_controller.cc:540-561``).  Near-zero cost when tracing is
+    off (one module-global check, no autostart probe)."""
+    if _writer is None and not os.environ.get("BLUEFOG_TIMELINE"):
+        yield
+        return
+    _maybe_autostart()
+    w = _writer
+    if w is None:
+        yield
+        return
+    base = {"name": phase, "cat": op_name, "pid": os.getpid(),
+            "tid": threading.get_ident()}
+    w.emit({**base, "ph": "B", "ts": time.monotonic_ns() // 1000})
+    try:
+        yield
+    finally:
+        w.emit({**base, "ph": "E", "ts": time.monotonic_ns() // 1000})

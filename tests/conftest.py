@@ -42,3 +42,8 @@ def _reset_bluefog_state():
         basics._reset_for_tests()
     except (ImportError, AttributeError):
         pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running multi-process integration test")

