@@ -54,6 +54,10 @@ from bluefog_tpu.basics import (  # noqa: F401
     dynamic_neighbor_allreduce_nonblocking,
     hierarchical_neighbor_allreduce,
     hierarchical_neighbor_allreduce_nonblocking,
+    dynamic_hierarchical_neighbor_allreduce,
+    dynamic_hierarchical_neighbor_allreduce_nonblocking,
+    local_allreduce,
+    local_allreduce_nonblocking,
     pair_gossip,
     pair_gossip_nonblocking,
     poll,

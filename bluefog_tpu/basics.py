@@ -58,6 +58,11 @@ class _Context:
         self.machine_topology: Optional[nx.DiGraph] = None
         self.is_topo_weighted: bool = False
         self.is_machine_topo_weighted: bool = False
+        # Monotonic generations: cache keys use these, never id(graph) —
+        # Python recycles id()s, so an id-keyed cache can serve a stale
+        # compiled schedule for a different topology object.
+        self.topology_version: int = 0
+        self.machine_topology_version: int = 0
         self._static_scheds: Dict = {}
         self._lock = threading.RLock()
 
@@ -71,11 +76,13 @@ class _Context:
                     # FIFO eviction: per-step varying weight matrices must not
                     # grow host memory without bound.  (For genuinely
                     # time-varying weights prefer the dynamic-schedule path,
-                    # which switches phases without re-compiling.)
+                    # which switches phases without re-compiling.)  Jit
+                    # entries referencing the evicted schedule key go with it.
                     evicted_key = next(iter(self._static_scheds))
-                    evicted = self._static_scheds.pop(evicted_key)
+                    self._static_scheds.pop(evicted_key)
                     cache = self.__dict__.get("_jit_cache", {})
-                    for k in [k for k in cache if id(evicted) in str(k)]:
+                    for k in [k for k in cache
+                              if _key_mentions(k, evicted_key)]:
                         cache.pop(k, None)
                 self._static_scheds[key] = build()
             return self._static_scheds[key]
@@ -84,6 +91,15 @@ class _Context:
         with self._lock:
             self._static_scheds.clear()
             self.__dict__.setdefault("_jit_cache", {}).clear()
+
+
+def _key_mentions(tree, needle) -> bool:
+    """True when ``needle`` appears as a (nested) element of key ``tree``."""
+    if tree == needle:
+        return True
+    if isinstance(tree, tuple):
+        return any(_key_mentions(t, needle) for t in tree)
+    return False
 
 
 _ctx = _Context()
@@ -256,6 +272,7 @@ def set_topology(topology: Optional[nx.DiGraph] = None,
             f"topology has {topology.number_of_nodes()} nodes, world size is {size()}")
     ctx.topology = topology
     ctx.is_topo_weighted = is_weighted
+    ctx.topology_version += 1
     ctx.invalidate_schedules()
     return True
 
@@ -270,6 +287,7 @@ def set_machine_topology(topology: nx.DiGraph, is_weighted: bool = False) -> boo
             f"machine count is {machine_size()}")
     ctx.machine_topology = topology
     ctx.is_machine_topo_weighted = is_weighted
+    ctx.machine_topology_version += 1
     ctx.invalidate_schedules()
     return True
 
@@ -352,16 +370,19 @@ def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
         return _jitted(("flat", key, len(extra)), build)(_place(x), *extra)
 
 
-def _dispatch_hier(key, fn, x) -> jnp.ndarray:
+def _dispatch_hier(key, fn, x, *extra) -> jnp.ndarray:
     ctx = _require_init()
     def build():
+        def run(b, *e):
+            return fn(b[0], *e)[None]
+        n_extra = len(extra)
         return jax.jit(jax.shard_map(
-            lambda b: fn(b[0])[None], mesh=ctx.hier_mesh,
-            in_specs=P((MACHINE_AXIS, LOCAL_AXIS)),
+            run, mesh=ctx.hier_mesh,
+            in_specs=(P((MACHINE_AXIS, LOCAL_AXIS)),) + (P(),) * n_extra,
             out_specs=P((MACHINE_AXIS, LOCAL_AXIS))))
     from bluefog_tpu.utils.timeline import op_span
     with op_span(str(key[0]), "ENQUEUE"):
-        return _jitted(("hier", key), build)(_place(x))
+        return _jitted(("hier", key, len(extra)), build)(_place(x), *extra)
 
 
 def _weight_override_matrix(
@@ -462,25 +483,30 @@ def allgather(x, name: Optional[str] = None) -> jnp.ndarray:
     return synchronize(allgather_nonblocking(x, name))
 
 
-def _nbr_schedule(weights: Optional[np.ndarray]) -> S.StaticSchedule:
+def _nbr_schedule(weights: Optional[np.ndarray]):
+    """Resolve (schedule, content-key) for the active static topology.
+
+    The key doubles as the jit-cache key component, so compiled closures are
+    tied to schedule *content*, never to recyclable object identities."""
     ctx = _require_init()
     if weights is not None:
         key = ("static_override", weights.tobytes())
         return ctx.static_schedule(
-            key, lambda: S.compile_static(load_topology(), src_weights=weights))
-    key = ("static", id(ctx.topology), ctx.is_topo_weighted)
+            key,
+            lambda: S.compile_static(load_topology(), src_weights=weights)), key
+    key = ("static", ctx.topology_version, ctx.is_topo_weighted)
     return ctx.static_schedule(
-        key, lambda: S.compile_static(load_topology(),
-                                      use_topo_weights=ctx.is_topo_weighted))
+        key, lambda: S.compile_static(
+            load_topology(), use_topo_weights=ctx.is_topo_weighted)), key
 
 
 def neighbor_allreduce_nonblocking(x, *, self_weight=None, src_weights=None,
                                    dst_weights=None,
                                    name: Optional[str] = None) -> Handle:
     w = _weight_override_matrix(self_weight, src_weights, dst_weights)
-    sched = _nbr_schedule(w)
+    sched, skey = _nbr_schedule(w)
     return _dispatch_flat(
-        ("neighbor_allreduce", id(sched)),
+        ("neighbor_allreduce", skey),
         partial(C.neighbor_allreduce, sched=sched, axis_name=RANK_AXIS), x)
 
 
@@ -499,7 +525,7 @@ def dynamic_neighbor_allreduce_nonblocking(x, step: int, *,
 
     ``phases`` defaults to the phase table of the active topology."""
     ctx = _require_init()
-    key = ("dynamic", id(ctx.topology)) if phases is None else (
+    key = ("dynamic", ctx.topology_version) if phases is None else (
         "dynphases", tuple(ph.send_to for ph in phases))
     if phases is None:
         sched = ctx.static_schedule(
@@ -510,7 +536,7 @@ def dynamic_neighbor_allreduce_nonblocking(x, step: int, *,
             key, lambda: S.compile_dynamic(phases, size()))
     step_arr = jnp.asarray(step, dtype=jnp.int32)
     fn = partial(C.dynamic_neighbor_allreduce, sched=sched, axis_name=RANK_AXIS)
-    return _dispatch_flat(("dynamic_neighbor_allreduce", id(sched)),
+    return _dispatch_flat(("dynamic_neighbor_allreduce", key),
                           fn, x, step_arr)
 
 
@@ -520,9 +546,9 @@ def dynamic_neighbor_allreduce(x, step: int, *, phases=None) -> jnp.ndarray:
 
 
 def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> Handle:
-    sched = _nbr_schedule(None)
+    sched, skey = _nbr_schedule(None)
     return _dispatch_flat(
-        ("neighbor_allgather", id(sched)),
+        ("neighbor_allgather", skey),
         partial(C.neighbor_allgather, sched=sched, axis_name=RANK_AXIS), x)
 
 
@@ -538,8 +564,8 @@ def hierarchical_neighbor_allreduce_nonblocking(
     ctx = _require_init()
     if ctx.machine_topology is None:
         raise RuntimeError("set_machine_topology() required for hierarchical ops")
-    key = ("hier", id(ctx.machine_topology), ctx.is_machine_topo_weighted,
-           self_weight,
+    key = ("hier", ctx.machine_topology_version,
+           ctx.is_machine_topo_weighted, self_weight,
            None if src_machine_weights is None
            else np.asarray(src_machine_weights, dtype=float).tobytes())
     def build():
@@ -550,7 +576,7 @@ def hierarchical_neighbor_allreduce_nonblocking(
             src_weights=src_machine_weights)
     sched = ctx.static_schedule(key, build)
     return _dispatch_hier(
-        ("hierarchical_neighbor_allreduce", id(sched)),
+        ("hierarchical_neighbor_allreduce", key),
         partial(C.hierarchical_neighbor_allreduce, sched=sched,
                 local_axis=LOCAL_AXIS, machine_axis=MACHINE_AXIS), x)
 
@@ -565,6 +591,56 @@ def hierarchical_neighbor_allreduce(x, *, self_weight=None,
     return synchronize(hierarchical_neighbor_allreduce_nonblocking(
         x, self_weight=self_weight, src_machine_weights=src_machine_weights,
         name=name))
+
+
+def local_allreduce_nonblocking(x, *, average: bool = True,
+                                name: Optional[str] = None) -> Handle:
+    return _dispatch_hier(
+        ("local_allreduce", average),
+        partial(C.local_allreduce, local_axis=LOCAL_AXIS, average=average), x)
+
+
+def local_allreduce(x, *, average: bool = True,
+                    name: Optional[str] = None) -> jnp.ndarray:
+    """Allreduce restricted to each machine's local ranks (DP-6: the
+    reference's ``allreduce(..., is_hierarchical_local=True)`` over the
+    LOCAL communicator, ``mpi_controller.cc:145-147``)."""
+    return synchronize(local_allreduce_nonblocking(x, average=average,
+                                                   name=name))
+
+
+def dynamic_hierarchical_neighbor_allreduce_nonblocking(
+        x, step: int, *, phases=None) -> Handle:
+    """Hierarchical averaging with a per-step machine-level topology.
+
+    ``phases`` defaults to the one-peer dynamic walk over the installed
+    machine topology — the jitted analogue of driving
+    ``GetExp2DynamicSendRecvMachineRanks`` by hand (reference
+    ``topology_util.py:360-396``)."""
+    ctx = _require_init()
+    if ctx.machine_topology is None:
+        raise RuntimeError("set_machine_topology() required for hierarchical ops")
+    m = machine_size()
+    key = ("dynhier", ctx.machine_topology_version) if phases is None else (
+        "dynhierphases", tuple(ph.send_to for ph in phases))
+    if phases is None:
+        sched = ctx.static_schedule(
+            key, lambda: S.compile_dynamic(
+                topology_util.dynamic_phase_table(ctx.machine_topology), m))
+    else:
+        sched = ctx.static_schedule(
+            key, lambda: S.compile_dynamic(phases, m))
+    step_arr = jnp.asarray(step, dtype=jnp.int32)
+    fn = partial(C.dynamic_hierarchical_neighbor_allreduce, sched=sched,
+                 local_axis=LOCAL_AXIS, machine_axis=MACHINE_AXIS)
+    return _dispatch_hier(("dynamic_hierarchical_neighbor_allreduce", key),
+                          fn, x, step_arr)
+
+
+def dynamic_hierarchical_neighbor_allreduce(x, step: int, *,
+                                            phases=None) -> jnp.ndarray:
+    return synchronize(dynamic_hierarchical_neighbor_allreduce_nonblocking(
+        x, step, phases=phases))
 
 
 def pair_gossip_nonblocking(x, target_ranks: Union[Dict[int, int], List[int]],
@@ -585,7 +661,7 @@ def pair_gossip_nonblocking(x, target_ranks: Union[Dict[int, int], List[int]],
         key, lambda: S.compile_pair_gossip(
             tgt, n, self_weight=self_weight, target_weight=target_weight))
     return _dispatch_flat(
-        ("pair_gossip", id(sched)),
+        ("pair_gossip", key),
         partial(C.pair_gossip, sched=sched, axis_name=RANK_AXIS), x)
 
 

@@ -105,14 +105,18 @@ class DistributedOptimizer:
             raise RuntimeError("no (machine) topology installed; call bf.init()")
         n = topo.number_of_nodes()
         if self.use_dynamic_topology:
-            key = ("opt_dyn", id(topo),
+            version = (ctx.machine_topology_version if hier
+                       else ctx.topology_version)
+            key = ("opt_dyn", version,
                    None if self.phases is None
                    else tuple(tuple(ph.pairs) for ph in self.phases))
             phases = self.phases
             return None, ctx.static_schedule(key, lambda: S.compile_dynamic(
                 phases if phases is not None
                 else topology_util.dynamic_phase_table(topo), n))
-        key = ("opt_static", id(topo), weighted)
+        version = (ctx.machine_topology_version if hier
+                   else ctx.topology_version)
+        key = ("opt_static", version, weighted)
         return ctx.static_schedule(
             key, lambda: S.compile_static(topo, use_topo_weights=weighted)), None
 
@@ -153,7 +157,8 @@ class DistributedOptimizer:
 
     def _step_callable(self, with_weights: bool):
         ctx = basics._require_init()
-        key = (id(ctx.topology), id(ctx.machine_topology), with_weights)
+        key = (ctx.topology_version, ctx.machine_topology_version,
+               with_weights)
         if key not in self._jitted:
             self._jitted[key] = self._build_step(with_weights)
         return self._jitted[key]
