@@ -12,9 +12,11 @@ Modes
     python -m bluefog_tpu.run -np 4 python train.py
   spawns 4 processes on this machine wired to a local coordinator; each sets
   ``BFTPU_*`` env consumed by ``bf.init_distributed()``.
-* Multi-host (one process per host, reference ``-H`` flag):
-    python -m bluefog_tpu.run -np 2 -H tpu-host-0,tpu-host-1 python train.py
-  launches via ssh with the coordinator on the first host.
+* Multi-host (reference ``-H host:slots`` flag, ``run/run.py:58-118``):
+    python -m bluefog_tpu.run -np 8 -H tpu-host-0:4,tpu-host-1:4 python train.py
+  launches ``slots`` processes per host via ssh (slot-major rank order, like
+  mpirun ``-map-by slot``) with the coordinator on the first host.  A bare
+  hostname means one slot.
 * TPU pod slices: run the same command on every host (GKE/xmanager style);
   ``bf.init_distributed()`` with no env auto-detects the TPU pod coordinator.
 """
@@ -29,7 +31,49 @@ import socket
 import subprocess
 import sys
 
-__all__ = ["main", "build_parser"]
+__all__ = ["main", "build_parser", "parse_hosts"]
+
+
+def parse_hosts(spec: str, num_proc: int):
+    """Expand ``h1:4,h2:4`` into a rank-ordered list of (host, local_rank).
+
+    Mirrors the reference launcher's host-slot parsing (``run/run.py:58-118``):
+    each entry contributes ``slots`` consecutive ranks (mpirun ``-map-by
+    slot``), bare hostnames count as one slot, and the total slot count must
+    cover ``num_proc``.
+    """
+    entries = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        host, sep, slots_s = item.partition(":")
+        if not host:
+            raise ValueError(f"bad host entry {item!r}")
+        if sep:
+            try:
+                slots = int(slots_s)
+            except ValueError:
+                raise ValueError(f"bad slot count in {item!r}") from None
+            if slots <= 0:
+                raise ValueError(f"slot count must be positive in {item!r}")
+        else:
+            slots = 1
+        entries.append((host, slots))
+    total = sum(s for _, s in entries)
+    if total < num_proc:
+        raise ValueError(
+            f"host slots ({total}) < requested processes ({num_proc})")
+    placement = []
+    next_local = {}  # repeated host entries keep accumulating local ranks
+    for host, slots in entries:
+        for _ in range(slots):
+            if len(placement) == num_proc:
+                break
+            local_rank = next_local.get(host, 0)
+            next_local[host] = local_rank + 1
+            placement.append((host, local_rank))
+    return placement[:num_proc]
 
 
 def _free_port() -> int:
@@ -45,7 +89,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-np", "--num-proc", type=int, required=True,
                    help="number of processes to launch")
     p.add_argument("-H", "--hosts", default=None,
-                   help="comma-separated hosts (default: all local)")
+                   help="comma-separated host[:slots] entries "
+                        "(default: all local)")
     p.add_argument("--ssh-port", type=int, default=22)
     p.add_argument("--coordinator-port", type=int, default=None)
     p.add_argument("--devices-per-proc", type=int, default=None,
@@ -57,11 +102,12 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _child_env(args, coord: str, rank: int) -> dict:
+def _child_env(args, coord: str, rank: int, local_rank: int = 0) -> dict:
     env = dict(os.environ)
     env["BFTPU_COORDINATOR"] = coord
     env["BFTPU_NUM_PROCESSES"] = str(args.num_proc)
     env["BFTPU_PROCESS_ID"] = str(rank)
+    env["BFTPU_LOCAL_ID"] = str(local_rank)
     if args.devices_per_proc:
         env["BFTPU_LOCAL_DEVICES"] = str(args.devices_per_proc)
         flags = env.get("XLA_FLAGS", "")
@@ -81,20 +127,25 @@ def main(argv=None) -> int:
     if not cmd:
         print("bfrun: no command given", file=sys.stderr)
         return 2
+    if args.num_proc < 1:
+        print("bfrun: -np must be >= 1", file=sys.stderr)
+        return 2
 
     port = args.coordinator_port or _free_port()
-    hosts = (args.hosts.split(",") if args.hosts
-             else ["127.0.0.1"] * args.num_proc)
-    if len(hosts) != args.num_proc:
-        print(f"bfrun: {args.num_proc} processes but {len(hosts)} hosts",
-              file=sys.stderr)
-        return 2
-    coord = f"{hosts[0]}:{port}"
+    if args.hosts:
+        try:
+            placement = parse_hosts(args.hosts, args.num_proc)
+        except ValueError as e:
+            print(f"bfrun: {e}", file=sys.stderr)
+            return 2
+    else:
+        placement = [("127.0.0.1", i) for i in range(args.num_proc)]
+    coord = f"{placement[0][0]}:{port}"
 
     procs = []
     try:
-        for rank, host in enumerate(hosts):
-            env = _child_env(args, coord, rank)
+        for rank, (host, local_rank) in enumerate(placement):
+            env = _child_env(args, coord, rank, local_rank)
             if host in ("127.0.0.1", "localhost", socket.gethostname()):
                 procs.append(subprocess.Popen(cmd, env=env))
             else:

@@ -18,6 +18,7 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BFTPU_COORDINATOR             | unset | set by bfrun: coordinator host:port |
 | BFTPU_NUM_PROCESSES           | unset | set by bfrun |
 | BFTPU_PROCESS_ID              | unset | set by bfrun |
+| BFTPU_LOCAL_ID                | 0     | set by bfrun: slot index on the host |
 
 (The reference's fusion/cycle-time/vendor-override knobs have no TPU
 equivalent: XLA owns fusion and scheduling, and there is exactly one vendor.)

@@ -172,3 +172,54 @@ def test_checkpoint_restore_with_target_structure(tmp_path):
         jax.tree.map(jnp.asarray, back["params"]),
         grads, jax.tree.map(jnp.asarray, back["state"]))
     assert int(s2.step) == 2
+
+
+def test_parse_hosts_slots():
+    """-H host:slots expands slot-major like mpirun -map-by slot (reference
+    run/run.py:58-118): h1:2,h2:2 with np=3 gives h1 ranks 0-1, h2 rank 0."""
+    from bluefog_tpu.run.run import parse_hosts
+    assert parse_hosts("h1:2,h2:2", 4) == [
+        ("h1", 0), ("h1", 1), ("h2", 0), ("h2", 1)]
+    # np smaller than total slots: trailing slots unused
+    assert parse_hosts("h1:2,h2:2", 3) == [("h1", 0), ("h1", 1), ("h2", 0)]
+    # bare hostname = one slot
+    assert parse_hosts("h1,h2", 2) == [("h1", 0), ("h2", 0)]
+    # whitespace tolerated
+    assert parse_hosts(" h1:1 , h2:1 ", 2) == [("h1", 0), ("h2", 0)]
+    # repeated host entries accumulate local ranks (mpirun hostfile semantics)
+    assert parse_hosts("h1:2,h1:2", 4) == [
+        ("h1", 0), ("h1", 1), ("h1", 2), ("h1", 3)]
+
+
+def test_parse_hosts_errors():
+    from bluefog_tpu.run.run import parse_hosts
+    with pytest.raises(ValueError, match="host slots"):
+        parse_hosts("h1:1", 2)
+    with pytest.raises(ValueError, match="slot count"):
+        parse_hosts("h1:zero", 1)
+    with pytest.raises(ValueError, match="slot count"):
+        parse_hosts("h1:0", 1)
+    with pytest.raises(ValueError, match="bad host"):
+        parse_hosts(":3", 1)
+
+
+def test_bfrun_host_slots_local(tmp_path):
+    """-H 127.0.0.1:3 launches 3 local processes with distinct global ranks
+    and slot-major local ids."""
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os, json\n"
+        f"out = os.path.join({str(tmp_path)!r},"
+        " 'rank' + os.environ['BFTPU_PROCESS_ID'] + '.json')\n"
+        "json.dump({k: os.environ[k] for k in\n"
+        "    ('BFTPU_PROCESS_ID', 'BFTPU_LOCAL_ID',"
+        " 'BFTPU_NUM_PROCESSES')}, open(out, 'w'))\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run", "-np", "3",
+         "-H", "127.0.0.1:3", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    lines = [json.load(open(tmp_path / f"rank{r}.json")) for r in range(3)]
+    assert [l["BFTPU_LOCAL_ID"] for l in lines] == ["0", "1", "2"]
+    assert all(l["BFTPU_NUM_PROCESSES"] == "3" for l in lines)
