@@ -155,6 +155,22 @@ def init(topology_fn=None, is_weighted: bool = False, *,
             topology_util.ExponentialGraph(n // local_size), is_weighted=False)
 
 
+def _local_device_kwargs(env) -> dict:
+    """Device ownership for multi-slot hosts (``bfrun -H host:slots``).
+
+    With several processes on one host, each slot must claim a disjoint
+    device — the reference maps one GPU per mpirun slot
+    (``run/run.py:180-203`` ``-map-by slot``); here slot ``i`` owns local
+    device ``i`` via ``jax.distributed.initialize(local_device_ids=[i])``.
+    The virtual CPU mode (``BFTPU_LOCAL_DEVICES``) is exempt: there each
+    process forges its own private host-platform devices.
+    """
+    local_size = int(env.get("BFTPU_LOCAL_SIZE", "1"))
+    if local_size > 1 and "BFTPU_LOCAL_DEVICES" not in env:
+        return {"local_device_ids": [int(env.get("BFTPU_LOCAL_ID", "0"))]}
+    return {}
+
+
 def init_distributed(topology_fn=None, is_weighted: bool = False) -> None:
     """Multi-process init: rendezvous through the JAX distributed coordinator,
     then ``init()`` over the GLOBAL device set.
@@ -171,7 +187,8 @@ def init_distributed(topology_fn=None, is_weighted: bool = False) -> None:
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(_os.environ["BFTPU_NUM_PROCESSES"]),
-            process_id=int(_os.environ["BFTPU_PROCESS_ID"]))
+            process_id=int(_os.environ["BFTPU_PROCESS_ID"]),
+            **_local_device_kwargs(_os.environ))
     elif jax.process_count() == 1:
         try:
             jax.distributed.initialize()

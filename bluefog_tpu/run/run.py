@@ -73,7 +73,7 @@ def parse_hosts(spec: str, num_proc: int):
             local_rank = next_local.get(host, 0)
             next_local[host] = local_rank + 1
             placement.append((host, local_rank))
-    return placement[:num_proc]
+    return placement
 
 
 def _free_port() -> int:
@@ -102,12 +102,14 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _child_env(args, coord: str, rank: int, local_rank: int = 0) -> dict:
+def _child_env(args, coord: str, rank: int, local_rank: int = 0,
+               local_size: int = 1) -> dict:
     env = dict(os.environ)
     env["BFTPU_COORDINATOR"] = coord
     env["BFTPU_NUM_PROCESSES"] = str(args.num_proc)
     env["BFTPU_PROCESS_ID"] = str(rank)
     env["BFTPU_LOCAL_ID"] = str(local_rank)
+    env["BFTPU_LOCAL_SIZE"] = str(local_size)
     if args.devices_per_proc:
         env["BFTPU_LOCAL_DEVICES"] = str(args.devices_per_proc)
         flags = env.get("XLA_FLAGS", "")
@@ -142,10 +144,14 @@ def main(argv=None) -> int:
         placement = [("127.0.0.1", i) for i in range(args.num_proc)]
     coord = f"{placement[0][0]}:{port}"
 
+    host_slots = {}
+    for host, _ in placement:
+        host_slots[host] = host_slots.get(host, 0) + 1
+
     procs = []
     try:
         for rank, (host, local_rank) in enumerate(placement):
-            env = _child_env(args, coord, rank, local_rank)
+            env = _child_env(args, coord, rank, local_rank, host_slots[host])
             if host in ("127.0.0.1", "localhost", socket.gethostname()):
                 procs.append(subprocess.Popen(cmd, env=env))
             else:

@@ -19,6 +19,11 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BFTPU_NUM_PROCESSES           | unset | set by bfrun |
 | BFTPU_PROCESS_ID              | unset | set by bfrun |
 | BFTPU_LOCAL_ID                | 0     | set by bfrun: slot index on the host |
+| BFTPU_LOCAL_SIZE              | 1     | set by bfrun: slots on this host |
+
+(The ``BFTPU_*`` rendezvous variables are consumed directly by
+``basics.init_distributed`` at process startup, not through ``Config`` —
+they describe the launch, not tunable behavior.)
 
 (The reference's fusion/cycle-time/vendor-override knobs have no TPU
 equivalent: XLA owns fusion and scheduling, and there is exactly one vendor.)

@@ -7,6 +7,14 @@ every visible device.
 
     python examples/benchmark.py --model resnet50 --batch-size 64 \
         --dist-optimizer neighbor_allreduce
+
+``--efficiency`` reports scaling efficiency — n-device throughput over n x
+single-device throughput, the reference's headline scaling metric
+(``examples/pytorch_benchmark.py:228-256`` totals img/sec across workers; the
+paper reports it relative to one worker).  Single-process only: it compares
+the devices this process owns against one of them.  On a multi-host pod,
+run the benchmark once per world size instead and divide the totals — the
+harness prints the absolute numbers either way.
 """
 
 import argparse
@@ -15,7 +23,7 @@ import time
 import numpy as np
 
 
-def main():
+def build_parser():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet18", "resnet34", "resnet50", "resnet101",
@@ -34,8 +42,15 @@ def main():
                     help="dynamic one-peer Exp2 topology")
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--seq-len", type=int, default=1024)
-    args = ap.parse_args()
+    ap.add_argument("--efficiency", action="store_true",
+                    help="also measure 1-device throughput and report "
+                         "n-device scaling efficiency")
+    return ap
 
+
+def measure(args, devices=None, quiet=False):
+    """Run the benchmark over ``devices`` (default: all) and return
+    ``(mean_rate, ci, n_devices)`` where rate is samples/sec across devices."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -44,8 +59,11 @@ def main():
     from bluefog_tpu import models
     from bluefog_tpu.optim import CommunicationType
 
-    bf.init(local_size=None if args.dist_optimizer != "hierarchical" else
-            max(1, len(jax.devices()) // 2))
+    local_size = None
+    if args.dist_optimizer == "hierarchical":
+        ndev = len(devices) if devices is not None else len(jax.devices())
+        local_size = max(1, ndev // 2)
+    bf.init(devices=devices, local_size=local_size)
     n = bf.size()
 
     if args.model.startswith("resnet"):
@@ -146,15 +164,34 @@ def main():
         dt = time.perf_counter() - t0
         rate = n * args.batch_size * args.num_batches_per_iter / dt
         rates.append(rate)
-        print(f"iter {i}: {rate:.1f} img/sec across {n} devices")
+        if not quiet:
+            print(f"iter {i}: {rate:.1f} img/sec across {n} devices")
 
-    mean, ci = float(np.mean(rates)), 1.96 * float(np.std(rates))
+    return float(np.mean(rates)), 1.96 * float(np.std(rates)), n
+
+
+def main():
+    args = build_parser().parse_args()
+    import jax
+
+    mean, ci, n = measure(args)
     unit = "tokens" if args.model == "transformer" else "img"
     if args.model == "transformer":
         mean, ci = mean * args.seq_len, ci * args.seq_len
     print(f"total {unit}/sec: {mean:.1f} +- {ci:.1f} "
           f"({mean / n:.1f}/device, model={args.model}, "
           f"optimizer={args.dist_optimizer})")
+
+    if args.efficiency and n > 1:
+        mean1, _, _ = measure(args, devices=jax.devices()[:1], quiet=True)
+        if args.model == "transformer":
+            mean1 = mean1 * args.seq_len
+        eff = mean / (n * mean1)
+        print(f"single-device {unit}/sec: {mean1:.1f}")
+        print(f"scaling efficiency at {n} devices: {100 * eff:.1f}% "
+              f"({mean:.1f} vs {n} x {mean1:.1f})")
+    elif args.efficiency:
+        print("scaling efficiency: only one device visible; nothing to compare")
 
 
 if __name__ == "__main__":

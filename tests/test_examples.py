@@ -52,3 +52,35 @@ def test_benchmark_harness_tiny():
                 ["--model", "lenet", "--batch-size", "4",
                  "--num-warmup-batches", "1", "--num-iters", "2",
                  "--num-batches-per-iter", "2"])
+
+
+def test_benchmark_scaling_efficiency(capsys):
+    """--efficiency measures 1-device vs n-device throughput and prints the
+    efficiency ratio (reference protocol pytorch_benchmark.py:228-256)."""
+    run_example(f"{EXAMPLES}/benchmark.py",
+                ["--model", "lenet", "--batch-size", "4",
+                 "--num-warmup-batches", "1", "--num-iters", "2",
+                 "--num-batches-per-iter", "1", "--efficiency"])
+    out = capsys.readouterr().out
+    assert "scaling efficiency at 8 devices:" in out, out
+    line = [l for l in out.splitlines() if "scaling efficiency" in l][0]
+    eff = float(line.split(":")[1].strip().split("%")[0])
+    assert 0.0 < eff, out  # sane ratio; CPU-mesh value itself is meaningless
+
+
+def test_benchmark_measure_single_device_subset():
+    """measure(devices=[one]) runs the whole protocol over a device subset
+    (world size 1) — the building block of the efficiency harness."""
+    import jax
+    sys.path.insert(0, EXAMPLES)
+    try:
+        import benchmark as bm
+    finally:
+        sys.path.pop(0)
+    args = bm.build_parser().parse_args(
+        ["--model", "lenet", "--batch-size", "4", "--num-warmup-batches", "1",
+         "--num-iters", "2", "--num-batches-per-iter", "1"])
+    mean, ci, n = bm.measure(args, devices=jax.devices()[:1], quiet=True)
+    assert n == 1 and mean > 0, (mean, ci, n)
+    import bluefog_tpu as bf
+    bf.shutdown()

@@ -212,7 +212,7 @@ def test_bfrun_host_slots_local(tmp_path):
         f"out = os.path.join({str(tmp_path)!r},"
         " 'rank' + os.environ['BFTPU_PROCESS_ID'] + '.json')\n"
         "json.dump({k: os.environ[k] for k in\n"
-        "    ('BFTPU_PROCESS_ID', 'BFTPU_LOCAL_ID',"
+        "    ('BFTPU_PROCESS_ID', 'BFTPU_LOCAL_ID', 'BFTPU_LOCAL_SIZE',"
         " 'BFTPU_NUM_PROCESSES')}, open(out, 'w'))\n")
     out = subprocess.run(
         [sys.executable, "-m", "bluefog_tpu.run", "-np", "3",
@@ -222,4 +222,19 @@ def test_bfrun_host_slots_local(tmp_path):
     assert out.returncode == 0, out.stderr
     lines = [json.load(open(tmp_path / f"rank{r}.json")) for r in range(3)]
     assert [l["BFTPU_LOCAL_ID"] for l in lines] == ["0", "1", "2"]
+    assert all(l["BFTPU_LOCAL_SIZE"] == "3" for l in lines)
     assert all(l["BFTPU_NUM_PROCESSES"] == "3" for l in lines)
+
+
+def test_local_device_ownership_kwargs():
+    """Co-hosted slots each claim one local device (reference -map-by slot:
+    one GPU per slot); the virtual CPU mode is exempt."""
+    from bluefog_tpu.basics import _local_device_kwargs
+    env = {"BFTPU_LOCAL_SIZE": "4", "BFTPU_LOCAL_ID": "2"}
+    assert _local_device_kwargs(env) == {"local_device_ids": [2]}
+    # single slot per host: the process owns all local devices (default)
+    assert _local_device_kwargs({"BFTPU_LOCAL_SIZE": "1"}) == {}
+    assert _local_device_kwargs({}) == {}
+    # CPU testing mode forges private per-process devices
+    env["BFTPU_LOCAL_DEVICES"] = "2"
+    assert _local_device_kwargs(env) == {}
