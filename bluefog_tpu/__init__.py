@@ -26,6 +26,9 @@ from bluefog_tpu.basics import (  # noqa: F401
     init_distributed,
     shutdown,
     initialized,
+    suspend,
+    resume,
+    suspended,
     size,
     rank,
     local_size,

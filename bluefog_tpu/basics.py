@@ -50,6 +50,7 @@ class _Context:
 
     def __init__(self):
         self.initialized = False
+        self.suspended = False
         self.devices: list = []
         self.mesh: Optional[Mesh] = None            # 1-D (rank,)
         self.hier_mesh: Optional[Mesh] = None       # 2-D (machine, local)
@@ -114,6 +115,15 @@ def _require_init() -> _Context:
     if not _ctx.initialized:
         raise RuntimeError("bluefog_tpu is not initialized; call bf.init() first")
     return _ctx
+
+
+def _require_active() -> _Context:
+    ctx = _require_init()
+    if ctx.suspended:
+        raise RuntimeError(
+            "bluefog_tpu is suspended (bf.suspend()); call bf.resume() "
+            "before issuing communication ops")
+    return ctx
 
 
 # ---------------------------------------------------------------------------
@@ -212,7 +222,52 @@ def shutdown() -> None:
     from bluefog_tpu.ops import window as _window
     _window._free_all_windows()
     _window._shutdown_transport()
+    from bluefog_tpu.utils.stall import _monitor
+    _monitor.unpause()  # a suspended session must not outlive its context
     _reset_for_tests()
+
+
+def suspend() -> None:
+    """Quiesce background activity for interactive use (reference
+    ``bf.suspend``, ``common/basics.py:497-515``: parks the communication
+    thread so an idle Jupyter kernel stops consuming resources).
+
+    The TPU rebuild has no polling thread to park; what suspend does here is
+    (1) drain all outstanding window handles so no async work is in flight,
+    (2) silence the stall watchdog (an idle prompt is not a stalled peer),
+    and (3) reject new communication ops until :func:`resume` — catching the
+    cells that would otherwise hang waiting on a suspended peer.  Queries
+    (rank/size/topology) and reading window state stay available.
+    """
+    ctx = _require_init()
+    if ctx.suspended:
+        return
+    from bluefog_tpu.ops import window as _window
+    if not _window._drain_handles():
+        from bluefog_tpu.utils.logging import get_logger
+        get_logger().warning(
+            "suspend: outstanding window ops did not drain within 60 s; "
+            "suspending anyway — a hung peer or dead transport is likely")
+    from bluefog_tpu.utils.stall import _monitor
+    _monitor.pause()
+    from bluefog_tpu.utils.timeline import flush as _tl_flush
+    _tl_flush()
+    ctx.suspended = True
+
+
+def resume() -> None:
+    """Re-enable communication after :func:`suspend` (reference
+    ``bf.resume``, ``common/basics.py:507-515``)."""
+    ctx = _require_init()
+    if not ctx.suspended:
+        return
+    from bluefog_tpu.utils.stall import _monitor
+    _monitor.unpause()
+    ctx.suspended = False
+
+
+def suspended() -> bool:
+    return _ctx.initialized and _ctx.suspended
 
 
 def initialized() -> bool:
@@ -373,7 +428,7 @@ def _jitted(key, build):
 
 
 def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
-    ctx = _require_init()
+    ctx = _require_active()
     def build():
         def run(b, *e):
             return fn(b[0], *e)[None]
@@ -388,7 +443,7 @@ def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
 
 
 def _dispatch_hier(key, fn, x, *extra) -> jnp.ndarray:
-    ctx = _require_init()
+    ctx = _require_active()
     def build():
         def run(b, *e):
             return fn(b[0], *e)[None]

@@ -156,6 +156,8 @@ class _WindowStore:
             return self.windows[name]
 
     def submit(self, fn) -> int:
+        from bluefog_tpu import basics
+        basics._require_active()  # suspended sessions reject new async work
         with self.lock:
             h = self.next_handle
             self.next_handle += 1
@@ -168,6 +170,24 @@ _store = _WindowStore()
 
 def _any_window_exists() -> bool:
     return bool(_store.windows)
+
+
+def _drain_handles(timeout: float = 60.0) -> bool:
+    """Wait for every outstanding nonblocking window op (``bf.suspend``
+    quiesce step).  Returns False if any op is still in flight at timeout —
+    op *errors* are left for the owning ``win_wait`` to surface."""
+    from concurrent.futures import TimeoutError as _FutTimeout
+    with _store.lock:
+        futures = list(_store.handles.values())
+    drained = True
+    for f in futures:
+        try:
+            f.result(timeout=timeout)
+        except _FutTimeout:
+            drained = False
+        except Exception:
+            pass  # the owning win_wait will surface the error
+    return drained
 
 
 def _free_all_windows() -> None:
@@ -889,6 +909,7 @@ def win_mutex(name: str, *, for_self: bool = False,
     lock until our release message lands.  Acquisition is in ascending rank
     order everywhere, so cross-process lock cycles cannot form."""
     from bluefog_tpu import basics
+    basics._require_active()
     win = _store.get(name)
     d = _store.distrib
     if ranks is None:
@@ -917,6 +938,7 @@ def win_fence(name: Optional[str] = None) -> None:
     TCP FIFO makes the ack exact: our FENCE_REQ trails our puts on the same
     stream, so the peer's ack certifies those puts were applied."""
     from bluefog_tpu import basics
+    basics._require_active()
     with _store.lock:
         outstanding = list(_store.handles.items())
     errors = []

@@ -1,0 +1,118 @@
+"""``ibfrun``: interactive sessions on the TPU mesh.
+
+Parity: reference ``run/interactive_run.py:34-90`` — ``ibfrun start -np 4``
+boots an ipcontroller plus mpirun'd ipengines so a notebook can drive the MPI
+world, paired with ``bf.suspend()/bf.resume()`` to park the background thread
+between cells.
+
+The TPU rebuild is single-controller SPMD: ONE Python process drives every
+device, so there is no engine fleet to boot and no ipyparallel dependency —
+any Jupyter kernel or plain REPL that imports ``bluefog_tpu`` *is* the
+interactive mode.  What this launcher adds is the environment bootstrap the
+reference's ``ibfrun start`` performed:
+
+* ``ibfrun`` — drop into an IPython (fallback: ``python -i``) shell with
+  ``bf`` imported and ``bf.init()`` already run over the real devices.
+* ``ibfrun -np 8`` — same, over a virtual 8-device CPU mesh (the testing
+  topology-development loop; XLA device-count flags must be set before JAX
+  loads, which is exactly why this is a launcher and not a helper function).
+* ``ibfrun -np 8 jupyter notebook`` (any command) — run that command inside
+  the prepared environment instead of a REPL; kernels started by it inherit
+  the virtual mesh.
+
+Inside the session, ``bf.suspend()`` / ``bf.resume()`` quiesce and re-enable
+communication between cells (reference ``common/basics.py:497-515``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+from bluefog_tpu.run.run import virtual_mesh_env
+
+__all__ = ["main", "build_parser"]
+
+_BOOT = ("import bluefog_tpu as bf; bf.init(); "
+         "print('bluefog_tpu interactive: %d rank(s) ready; "
+         "bf.suspend()/bf.resume() park the session' % bf.size())")
+# Site hooks can pin jax_platforms via jax.config, which env vars don't
+# override — force it the way tests/conftest.py does.
+_BOOT_CPU = "import jax; jax.config.update('jax_platforms', 'cpu'); " + _BOOT
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ibfrun", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="virtual CPU device count (default: real devices)")
+    p.add_argument("--no-init", action="store_true",
+                   help="prepare the environment but skip bf.init()")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run instead of a REPL")
+    return p
+
+
+def _cpu_pin_dir() -> str:
+    """A dir whose ``sitecustomize`` pins ``jax_platforms`` to cpu in every
+    Python child — env vars alone lose to site hooks that pin the platform
+    via ``jax.config`` (e.g. TPU-VM images), and command mode (``ibfrun -np 8
+    jupyter notebook``) has no boot string to do it in-process.  The shim
+    chains to the environment's own sitecustomize first."""
+    d = tempfile.mkdtemp(prefix="bf-ibfrun-")
+    with open(os.path.join(d, "sitecustomize.py"), "w") as f:
+        f.write(textwrap.dedent("""\
+            import os as _os, sys as _sys
+            _d = _os.path.dirname(_os.path.abspath(__file__))
+            _sys.path = [p for p in _sys.path
+                         if _os.path.abspath(p or '.') != _d]
+            _sys.modules.pop('sitecustomize', None)
+            try:
+                import sitecustomize  # noqa: F401 — the environment's own
+            except ImportError:
+                pass
+            _sys.path.insert(0, _d)
+            try:
+                import jax
+                jax.config.update('jax_platforms', 'cpu')
+            except Exception:
+                pass
+            """))
+    return d
+
+
+def _prepared_env(num_proc) -> dict:
+    env = dict(os.environ)
+    if num_proc:
+        virtual_mesh_env(env, num_proc)
+        pin = _cpu_pin_dir()
+        env["PYTHONPATH"] = pin + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    env = _prepared_env(args.num_proc)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+
+    if cmd:
+        return subprocess.call(cmd, env=env)
+
+    boot = "" if args.no_init else (_BOOT_CPU if args.num_proc else _BOOT)
+    if shutil.which("ipython"):
+        argv = ["ipython", "-i", "-c", boot] if boot else ["ipython"]
+    else:
+        argv = [sys.executable, "-i"] + (["-c", boot] if boot else [])
+    return subprocess.call(argv, env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,20 @@ import socket
 import subprocess
 import sys
 
-__all__ = ["main", "build_parser", "parse_hosts"]
+__all__ = ["main", "build_parser", "parse_hosts", "virtual_mesh_env"]
+
+
+def virtual_mesh_env(env: dict, num_devices: int) -> dict:
+    """Mutate ``env`` so a child Python sees ``num_devices`` virtual CPU
+    devices (testing mode shared by ``bfrun --devices-per-proc`` and
+    ``ibfrun -np``).  Must land before JAX loads in the child — XLA reads
+    the device-count flag at backend init."""
+    env["BFTPU_LOCAL_DEVICES"] = str(num_devices)
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count="
+                        f"{num_devices}")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
 
 
 def parse_hosts(spec: str, num_proc: int):
@@ -111,11 +124,7 @@ def _child_env(args, coord: str, rank: int, local_rank: int = 0,
     env["BFTPU_LOCAL_ID"] = str(local_rank)
     env["BFTPU_LOCAL_SIZE"] = str(local_size)
     if args.devices_per_proc:
-        env["BFTPU_LOCAL_DEVICES"] = str(args.devices_per_proc)
-        flags = env.get("XLA_FLAGS", "")
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count="
-                            f"{args.devices_per_proc}")
-        env["JAX_PLATFORMS"] = "cpu"
+        virtual_mesh_env(env, args.devices_per_proc)
     if args.timeline:
         env["BLUEFOG_TIMELINE"] = args.timeline
     return env

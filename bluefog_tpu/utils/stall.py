@@ -33,6 +33,7 @@ class StallMonitor:
         self._outstanding = {}  # id -> (name, start_ts, warned_count)
         self._next_id = 0
         self._thread = None
+        self._paused = False
 
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
@@ -46,7 +47,7 @@ class StallMonitor:
             # env reload), so never sleep proportionally to a stale value.
             time.sleep(0.25)
             threshold = config.get().stall_warning_sec
-            if threshold <= 0:
+            if threshold <= 0 or self._paused:
                 continue
             now = time.monotonic()
             with self._lock:
@@ -78,6 +79,14 @@ class StallMonitor:
             return
         with self._lock:
             self._outstanding.pop(key, None)
+
+    def pause(self) -> None:
+        """Silence stall warnings while the session is suspended (an
+        interactive user idling at a prompt is not a stalled peer)."""
+        self._paused = True
+
+    def unpause(self) -> None:
+        self._paused = False
 
 
 _monitor = StallMonitor()

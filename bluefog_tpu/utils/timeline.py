@@ -32,6 +32,7 @@ __all__ = [
     "timeline_context",
     "start_timeline",
     "stop_timeline",
+    "flush",
 ]
 
 _TRACE_EVENT_SENTINEL = None
@@ -156,6 +157,21 @@ def stop_timeline() -> bool:
         _writer.close()
         _writer = None
     return True
+
+
+def flush() -> None:
+    """Best-effort drain of queued events to disk (used by ``bf.suspend`` so
+    a paused notebook can open the trace).  The Python writer flushes per
+    event once the queue drains; the native writer flushes on its own tick —
+    here we just give both a moment to catch up without tearing down."""
+    w = _writer
+    if w is None:
+        return
+    q = getattr(w, "q", None)
+    if q is not None:
+        deadline = time.monotonic() + 2.0
+        while not q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
 
 
 def timeline_start_activity(tensor_name: str, activity_name: str = "USER") -> bool:
