@@ -29,7 +29,8 @@ __all__ = [
     "allreduce", "broadcast", "allgather", "neighbor_allreduce",
     "neighbor_allgather", "pair_gossip", "broadcast_parameters",
     "allreduce_parameters", "replicate_module", "load_replica",
-    "neighbor_allreduce_module_",
+    "neighbor_allreduce_module_", "broadcast_module_",
+    "DistributedOptimizer",
 ]
 
 
@@ -110,15 +111,123 @@ def allreduce_parameters(stacked: Dict[str, torch.Tensor],
 
 
 @torch.no_grad()
+def _combine_module_tensors_(replicas: List[torch.nn.Module], combine,
+                             *, include_buffers: bool = False) -> None:
+    """Stack each named tensor rank-major, run ``combine(stacked, name)``,
+    write each rank's row back in place.  ``include_buffers`` extends the
+    combine to floating-point buffers (BatchNorm running stats etc.) so
+    consensus covers the full ``state_dict``, not just weights; integer
+    buffers (step counters) are never averaged."""
+    assert len(replicas) == _b.size(), \
+        f"need one replica per rank ({_b.size()}), got {len(replicas)}"
+    named = [dict(m.named_parameters()) for m in replicas]
+    if include_buffers:
+        for r, m in enumerate(replicas):
+            for k, buf in m.named_buffers():
+                if torch.is_floating_point(buf):
+                    named[r]["buffer/" + k] = buf
+    for key in named[0]:
+        stacked = torch.stack([np_[key].detach() for np_ in named])
+        combined = combine(stacked, key)
+        for r, np_ in enumerate(named):
+            np_[key].copy_(combined[r])
+
+
 def neighbor_allreduce_module_(replicas: List[torch.nn.Module], **weights
                                ) -> None:
     """In-place neighbor averaging across a list of per-rank module replicas
     (the AWC/ATC combine step for torch prototyping loops)."""
-    assert len(replicas) == _b.size(), \
-        f"need one replica per rank ({_b.size()}), got {len(replicas)}"
-    named = [dict(m.named_parameters()) for m in replicas]
-    for key in named[0]:
-        stacked = torch.stack([np_[key].detach() for np_ in named])
-        combined = neighbor_allreduce(stacked, name=key, **weights)
-        for r, np_ in enumerate(named):
-            np_[key].copy_(combined[r])
+    _combine_module_tensors_(
+        replicas, lambda s, k: neighbor_allreduce(s, name=k, **weights))
+
+
+@torch.no_grad()
+def broadcast_module_(replicas: List[torch.nn.Module],
+                      root_rank: int = 0) -> None:
+    """Synchronize all replicas to rank ``root_rank``'s parameters and
+    buffers (reference ``tensorflow/utility.py broadcast_variables``)."""
+    src = replicas[root_rank].state_dict()
+    for r, m in enumerate(replicas):
+        if r != root_rank:
+            m.load_state_dict(src)
+
+
+class DistributedOptimizer:
+    """Decentralized training driver over per-rank torch module replicas.
+
+    Parity role: the reference's second-frontend optimizer wrappers
+    (``tensorflow/optimizers.py:135-203`` — gradient-allreduce
+    ``DistributedOptimizer`` / ``DistributedGradientTape``), widened to the
+    decentralized modes of the torch layer:
+
+    * ``"gradient_allreduce"`` — DP-1: average gradients across all ranks,
+      then each rank's base optimizer steps (Horovod-equivalent).
+    * ``"neighbor_allreduce"`` — ATC: each rank steps on its local gradient,
+      then parameters are neighbor-averaged over the active topology.
+    * ``"allreduce"`` — parameter consensus: step, then global average.
+    * ``"empty"`` — no communication (local baseline).
+
+    One torch optimizer per replica (built by ``optimizer_factory``), so
+    per-rank optimizer state (momentum etc.) stays rank-local exactly as
+    separate processes' optimizers would in the reference.
+
+    >>> opt = bf.torch.DistributedOptimizer(replicas, lambda ps:
+    ...     torch.optim.SGD(ps, lr=0.05), communication_type="neighbor_allreduce")
+    >>> loss = sum(loss_fn(m(x[r]), y[r]) for r, m in enumerate(replicas))
+    >>> opt.zero_grad(); loss.backward(); opt.step()
+    """
+
+    _MODES = ("gradient_allreduce", "neighbor_allreduce", "allreduce",
+              "empty")
+
+    def __init__(self, replicas: List[torch.nn.Module], optimizer_factory,
+                 *, communication_type: str = "neighbor_allreduce"):
+        if communication_type not in self._MODES:
+            raise ValueError(f"communication_type must be one of "
+                             f"{self._MODES}, got {communication_type!r}")
+        assert len(replicas) == _b.size(), \
+            f"need one replica per rank ({_b.size()}), got {len(replicas)}"
+        self.replicas = replicas
+        self.optimizers = [optimizer_factory(m.parameters())
+                           for m in replicas]
+        self.communication_type = communication_type
+
+    def zero_grad(self) -> None:
+        for opt in self.optimizers:
+            opt.zero_grad()
+
+    @torch.no_grad()
+    def _allreduce_grads(self) -> None:
+        named = [dict(m.named_parameters()) for m in self.replicas]
+        for key in named[0]:
+            grads = [np_[key].grad for np_ in named]
+            if all(g is None for g in grads):
+                continue
+            # A rank whose branch didn't run contributes zero — averaging
+            # over ALL ranks keeps replicas identical (DP-1 invariant);
+            # skipping the key would let populated ranks step un-averaged.
+            stacked = torch.stack(
+                [g if g is not None else torch.zeros_like(named[r][key])
+                 for r, g in enumerate(grads)])
+            combined = allreduce(stacked, average=True, name=key)
+            for r, np_ in enumerate(named):
+                if named[r][key].grad is None:
+                    named[r][key].grad = combined[r].clone()
+                else:
+                    named[r][key].grad.copy_(combined[r])
+
+    def step(self) -> None:
+        if self.communication_type == "gradient_allreduce":
+            self._allreduce_grads()
+        for opt in self.optimizers:
+            opt.step()
+        if self.communication_type == "neighbor_allreduce":
+            _combine_module_tensors_(
+                self.replicas,
+                lambda s, k: neighbor_allreduce(s, name=k),
+                include_buffers=True)
+        elif self.communication_type == "allreduce":
+            _combine_module_tensors_(
+                self.replicas,
+                lambda s, k: allreduce(s, average=True, name=k),
+                include_buffers=True)
