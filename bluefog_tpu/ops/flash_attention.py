@@ -68,7 +68,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     o, m, l = lax.fori_loop(0, n_kb, body, (o, m, l))
     l = jnp.maximum(l, 1e-30)
     o_ref[:] = (o / l).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l))[:, 0]
+    # (block_q, 1): the trailing singleton keeps the block's minor dim equal
+    # to the array's (Mosaic requires minor block dims be (8,128)-tiled or
+    # full) — a flat (block_q,) lse block fails to lower on TPU.
+    lse_ref[:] = m + jnp.log(l)
 
 
 def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
@@ -95,14 +98,15 @@ def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, S, D), q.dtype),
-            jax.ShapeDtypeStruct((bh, S), jnp.float32),
+            jax.ShapeDtypeStruct((bh, S, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
+    lse = lse[..., 0]
     unfold = lambda t: t.reshape(B, H, S, D).transpose(0, 2, 1, 3)
     return unfold(o), (qf, kf, vf, o, lse, (B, S, H, D, scale, causal))
 

@@ -45,6 +45,9 @@ def build_parser():
     ap.add_argument("--efficiency", action="store_true",
                     help="also measure 1-device throughput and report "
                          "n-device scaling efficiency")
+    ap.add_argument("--flash-attention", action="store_true",
+                    help="transformer model: use the Pallas flash-attention "
+                         "kernel (compiled Mosaic on TPU) instead of dense")
     return ap
 
 
@@ -80,7 +83,11 @@ def measure(args, devices=None, quiet=False):
         has_bn = False
     else:
         cfg = models.TransformerConfig(max_seq_len=args.seq_len)
-        model = models.TransformerLM(cfg)
+        attn = None
+        if args.flash_attention:
+            from bluefog_tpu.ops.flash_attention import flash_attention_impl
+            attn = flash_attention_impl()
+        model = models.TransformerLM(cfg, attn_impl=attn)
         data = jnp.zeros((n, args.batch_size, args.seq_len), jnp.int32)
         labels = None
         has_bn = False
