@@ -37,9 +37,11 @@ def main():
     devs = jax.devices()
     n = len(devs)
     on_tpu = jax.default_backend() == "tpu"
-    # Reference protocol on accelerators (batch raised 64 -> 128: the TPU is
-    # not memory-bound at 64 and gains ~18%); tiny smoke scale on CPU.
-    batch = 128 if on_tpu else 2
+    # Reference protocol on accelerators (batch raised 64 -> 256: the step is
+    # HBM-bandwidth-bound, and larger batches amortize the per-step parameter
+    # and BN-statistics traffic — +4.5% over 128, measured; see
+    # docs/performance.md profile). Tiny smoke scale on CPU.
+    batch = 256 if on_tpu else 2
     image = 224 if on_tpu else 64
     warmup, iters, batches_per_iter = (10, 10, 10) if on_tpu else (1, 2, 2)
 
