@@ -176,13 +176,15 @@ def _drain_handles(timeout: float = 60.0) -> bool:
     """Wait for every outstanding nonblocking window op (``bf.suspend``
     quiesce step).  Returns False if any op is still in flight at timeout —
     op *errors* are left for the owning ``win_wait`` to surface."""
+    import time as _time
     from concurrent.futures import TimeoutError as _FutTimeout
     with _store.lock:
         futures = list(_store.handles.values())
+    deadline = _time.monotonic() + timeout  # one budget for ALL handles
     drained = True
     for f in futures:
         try:
-            f.result(timeout=timeout)
+            f.result(timeout=max(0.0, deadline - _time.monotonic()))
         except _FutTimeout:
             drained = False
         except Exception:

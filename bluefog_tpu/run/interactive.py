@@ -93,6 +93,7 @@ def _prepared_env(num_proc) -> dict:
         virtual_mesh_env(env, num_proc)
         pin = _cpu_pin_dir()
         env["PYTHONPATH"] = pin + os.pathsep + env.get("PYTHONPATH", "")
+        env["_BF_PIN_DIR"] = pin  # removed by main() after the child exits
     return env
 
 
@@ -103,15 +104,21 @@ def main(argv=None) -> int:
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
 
-    if cmd:
-        return subprocess.call(cmd, env=env)
+    try:
+        if cmd:
+            return subprocess.call(cmd, env=env)
 
-    boot = "" if args.no_init else (_BOOT_CPU if args.num_proc else _BOOT)
-    if shutil.which("ipython"):
-        argv = ["ipython", "-i", "-c", boot] if boot else ["ipython"]
-    else:
-        argv = [sys.executable, "-i"] + (["-c", boot] if boot else [])
-    return subprocess.call(argv, env=env)
+        boot = "" if args.no_init else (_BOOT_CPU if args.num_proc else _BOOT)
+        if shutil.which("ipython"):
+            argv = ["ipython", "-i", "-c", boot] if boot else ["ipython"]
+        else:
+            argv = [sys.executable, "-i"] + (["-c", boot] if boot else [])
+        return subprocess.call(argv, env=env)
+    finally:
+        pin = env.get("_BF_PIN_DIR")
+        if pin:
+            import shutil as _sh
+            _sh.rmtree(pin, ignore_errors=True)
 
 
 if __name__ == "__main__":
