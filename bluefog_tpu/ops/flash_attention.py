@@ -31,7 +31,8 @@ import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "flash_attention_impl"]
+__all__ = ["flash_attention", "flash_attention_lse",
+           "flash_attention_impl"]
 
 _NEG_INF = -1e30
 
@@ -89,14 +90,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
 def _fit_block(want: int, seq_len: int) -> int:
     """Largest block <= ``want`` that divides ``seq_len`` (halving down), so
-    the default 1024 still serves S=768/1280/... by dropping to 256/128."""
+    the default 1024 still serves S=768/1280/... by dropping to 256/128.
+    Raises when the fit degrades past Mosaic's tiling floor (second-minor
+    block dims must be multiples of 8, or the full dimension)."""
     b = min(want, seq_len)
     while seq_len % b:
         b //= 2
+    if b % 8 and b != seq_len:
+        raise ValueError(
+            f"seq len {seq_len} has no TPU-tileable block <= {want}: the "
+            f"largest power-of-two divisor is {b}, below Mosaic's multiple-"
+            "of-8 floor. Pad the sequence or pass explicit block sizes.")
     return b
 
 
-def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, *, causal, block_q, block_k, interpret, vma=None):
     B, S, H, D = q.shape
     scale = 1.0 / np.sqrt(D)
     bh = B * H
@@ -130,8 +138,8 @@ def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, S, D), q.dtype),
-            jax.ShapeDtypeStruct((bh, S, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, S, D), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, S, 1), jnp.float32, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
@@ -230,15 +238,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(block_q, block_k, interpret, res, do):
+def _bwd(block_q, block_k, interpret, vma, res, cotangents):
     """Flash backward as two Pallas kernels (dq accumulating over k-blocks;
     dk/dv accumulating over q-blocks) — O(block) VMEM, O(S) HBM, and no
-    S x S materialization anywhere."""
+    S x S materialization anywhere.
+
+    Takes cotangents for BOTH outputs ``(do, dlse)``.  A non-zero ``dlse``
+    (sequence-parallel consumers weight partial results by their logsumexp,
+    e.g. the ring-attention merge) folds into the delta term:
+    ``d lse_i / d s_ij = p_ij``, so ``ds += dlse_i * p_ij`` — i.e.
+    ``delta_eff = delta - dlse``."""
     qf, kf, vf, o, lse, (B, S, H, D, scale, causal) = res
+    do, dlse = cotangents
     bh = B * H
     dof = do.transpose(0, 2, 1, 3).reshape(bh, S, D)
     delta = jnp.sum(dof.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)               # (bh, S, 1)
+    delta = delta - dlse.astype(jnp.float32).transpose(0, 2, 1) \
+        .reshape(bh, S)[..., None]
     lse3 = lse[..., None]                                 # (bh, S, 1)
 
     block_q = _fit_block(block_q, S)
@@ -277,7 +294,7 @@ def _bwd(block_q, block_k, interpret, res, do):
         in_specs=[q_at(own), k_at(red_dq), k_at(red_dq), q_at(own),
                   r_at(own), r_at(own)],
         out_specs=q_at(own),
-        out_shape=jax.ShapeDtypeStruct((bh, S, D), qf.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, S, D), qf.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret, **params,
     )(qf, kf, vf, dof, lse3, delta)
@@ -290,8 +307,8 @@ def _bwd(block_q, block_k, interpret, res, do):
                   r_at(red_kv), r_at(red_kv)],
         out_specs=[k_at(own), k_at(own)],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, S, D), kf.dtype),
-            jax.ShapeDtypeStruct((bh, S, D), vf.dtype),
+            jax.ShapeDtypeStruct((bh, S, D), kf.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, S, D), vf.dtype, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
@@ -303,20 +320,27 @@ def _bwd(block_q, block_k, interpret, res, do):
     return (unfold(dq, qf.dtype), unfold(dk, kf.dtype), unfold(dv, vf.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-                  interpret=interpret)
-    return out
+def _lse_bsh(lse, B, S, H):
+    return lse.reshape(B, H, S).transpose(0, 2, 1)         # -> (B, S, H)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-                interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, vma=None):
+    out, (_, _, _, _, lse, (B, S, H, _, _, _)) = _fwd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, vma=vma)
+    return out, _lse_bsh(lse, B, S, H)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, do):
-    return _bwd(block_q, block_k, interpret, res, do)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, vma=None):
+    out, res = _fwd(q, k, v, causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=interpret, vma=vma)
+    B, S, H = res[5][0], res[5][1], res[5][2]
+    return (out, _lse_bsh(res[4], B, S, H)), res
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, vma, res, cotangents):
+    return _bwd(block_q, block_k, interpret, vma, res, cotangents)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -335,7 +359,24 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 1024,
     the forward ~20x and the backward ~12x faster than 128-blocks."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)[0]
+
+
+def flash_attention_lse(q, k, v, *, causal: bool = True, block_q: int = 1024,
+                        block_k: int = 1024, interpret: bool = None,
+                        vma=None):
+    """Like :func:`flash_attention` but also returns the per-row logsumexp
+    ``(B, S, H)`` — the merge weight sequence-parallel consumers need
+    (``parallel.ring_attention`` combines per-hop partials with it).
+    Differentiable in both outputs (the lse cotangent folds into the
+    backward's delta term).
+
+    ``vma``: frozenset of mesh axis names the inputs vary over — required
+    when called inside ``shard_map(..., check_vma=True)`` (Pallas outputs
+    must declare their varying axes)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, interpret, vma)
 
 
 def flash_attention_impl(block_q: int = 1024, block_k: int = 1024):

@@ -8,13 +8,25 @@ structural cousin), applied to K/V blocks instead of parameters.
 Algorithm (blockwise online softmax, a la Ring Attention / FlashAttention
 accumulation): each device owns a sequence chunk of Q, K, V.  For ``n`` steps,
 compute the partial attention of the local Q block against the currently-held
-K/V block while accumulating a numerically-stable running (max, sum, output)
-triple, then rotate K/V one hop around the ring.  Communication rides ICI
+K/V block while accumulating a numerically-stable running (output, logsumexp)
+pair, then rotate K/V one hop around the ring.  Communication rides ICI
 concurrently with the block matmuls; memory is O(S/n) per device, so sequence
 length scales linearly with the mesh axis.
 
+The per-hop block attention is the Pallas flash kernel
+(``ops.flash_attention.flash_attention_lse``), so the local chunk itself
+never materializes its S_local x S_local logits either: with contiguous
+sharding a hop is all-visible (non-causal flash), on-diagonal (causal flash),
+or fully masked (skipped) — selected by ``lax.switch`` on the rotating source
+index.  Partials merge by logsumexp weighting, and the lse cotangent flows
+back through the kernel's VJP, keeping the whole op differentiable.
+
 All inputs/outputs are per-device blocks ``(B, S_local, H, D)`` — call inside
-``shard_map`` with the sequence axis sharded over ``axis_name``.
+``shard_map`` with the sequence axis sharded over ``axis_name``.  On CPU
+(Pallas interpreter) pass ``check_vma=False`` to that ``shard_map``:
+in-kernel constants are not vma-tracked under the interpreter.  Compiled
+Mosaic kernels on TPU work under the default ``check_vma=True`` (the kernels
+declare their varying axes via ``vma``).
 """
 
 from __future__ import annotations
@@ -25,32 +37,29 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from bluefog_tpu.ops.flash_attention import flash_attention_lse
+
 __all__ = ["ring_attention", "ring_attention_impl"]
 
-_NEG_INF = -1e30
+_NEG = -1e30  # finite "minus infinity": logaddexp/exp stay NaN-free
 
 
-def _block_step(q, k_blk, v_blk, o, m, l, q_pos, k_pos, *, causal, scale):
-    """One blockwise-attention accumulation step (all float32 accumulators).
+def _pvary(x, axis_name):
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return lax.pvary(x, (axis_name,))
 
-    q: (B, Sq, H, D); k_blk/v_blk: (B, Sk, H, D); o: (B, Sq, H, D) f32;
-    m, l: (B, Sq, H) f32 running max / normalizer.
+
+def _merge(o, lse, o_h, lse_h):
+    """Logsumexp-weighted merge of two normalized partial attentions.
+
+    ``o``: (B, S, H, D) f32; ``lse``: (B, S, H) f32.  Rows that saw no keys
+    carry lse ~ -1e30 and weight out to ~0.
     """
-    s = jnp.einsum("bqhd,bkhd->bqhk", q, k_blk).astype(jnp.float32) * scale
-    if causal:
-        mask = (k_pos[None, None, None, :] <= q_pos[None, :, None, None])
-        s = jnp.where(mask, s, _NEG_INF)
-    m_new = jnp.maximum(m, s.max(axis=-1))
-    # Guard fully-masked rows: keep them finite (l stays 0 there).
-    m_new = jnp.maximum(m_new, _NEG_INF / 2)
-    p = jnp.exp(s - m_new[..., None])
-    if causal:
-        p = jnp.where(mask, p, 0.0)
-    corr = jnp.exp(m - m_new)
-    l_new = l * corr + p.sum(axis=-1)
-    o_new = o * corr[..., None] + jnp.einsum(
-        "bqhk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk).astype(jnp.float32)
-    return o_new, m_new, l_new
+    lse_new = jnp.logaddexp(lse, lse_h)
+    safe = jnp.maximum(lse_new, _NEG / 2)
+    w, w_h = jnp.exp(lse - safe), jnp.exp(lse_h - safe)
+    return o * w[..., None] + o_h * w_h[..., None], lse_new
 
 
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
@@ -63,30 +72,46 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, S, H, D = q.shape
-    scale = 1.0 / (D ** 0.5)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    q_pos = me * S + jnp.arange(S)
+    def flash(q, k_blk, v_blk, hop_causal):
+        o, lse = flash_attention_lse(q, k_blk, v_blk, causal=hop_causal,
+                                     vma=frozenset({axis_name}))
+        return o.astype(jnp.float32), lse
+
+    def hop_partial(q, k_blk, v_blk, src):
+        """(o, lse) of the local Q against this hop's K/V block."""
+        if not causal:
+            return flash(q, k_blk, v_blk, False)
+        skip = lambda q, k_blk, v_blk: (
+            _pvary(jnp.zeros((B, S, H, D), jnp.float32), axis_name),
+            _pvary(jnp.full((B, S, H), _NEG, jnp.float32), axis_name))
+        # src < me: fully visible; src == me: on-diagonal; src > me: masked.
+        mode = jnp.where(src == me, 1, jnp.where(src < me, 0, 2))
+        return lax.switch(
+            mode,
+            [partial(flash, hop_causal=False),
+             partial(flash, hop_causal=True), skip],
+            q, k_blk, v_blk)
+
     # Accumulators enter the loop carry device-varying (they mix with
     # ppermuted data inside), so mark the fresh constants as varying too.
-    o = lax.pvary(jnp.zeros((B, S, H, D), jnp.float32), (axis_name,))
-    m = lax.pvary(jnp.full((B, S, H), _NEG_INF, jnp.float32), (axis_name,))
-    l = lax.pvary(jnp.zeros((B, S, H), jnp.float32), (axis_name,))
+    o = _pvary(jnp.zeros((B, S, H, D), jnp.float32), axis_name)
+    lse = _pvary(jnp.full((B, S, H), _NEG, jnp.float32), axis_name)
 
-    def body(t, carry):
-        o, m, l, k_blk, v_blk = carry
+    # Unrolled ring (n = mesh axis size, static and small): XLA overlaps
+    # each hop's ppermute with the previous hop's kernel, and unrolling
+    # keeps the pallas_call out of a fori_loop body (which also trips a
+    # lowering bug in current JAX when switch+pallas nest under vma).
+    k_blk, v_blk = k, v
+    for t in range(n):
         src = (me - t) % n                      # who produced this K/V block
-        k_pos = src * S + jnp.arange(S)
-        o, m, l = _block_step(q, k_blk, v_blk, o, m, l, q_pos, k_pos,
-                              causal=causal, scale=scale)
-        # Rotate AFTER consuming; skip the final (wasted) hop.
-        k_blk, v_blk = jax.tree.map(
-            lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
-        return o, m, l, k_blk, v_blk
-
-    o, m, l, _, _ = lax.fori_loop(0, n, body, (o, m, l, k, v))
-    l = jnp.maximum(l, 1e-20)  # fully-masked rows (none if causal & aligned)
-    return (o / l[..., None]).astype(q.dtype)
+        o_h, lse_h = hop_partial(q, k_blk, v_blk, src)
+        o, lse = _merge(o, lse, o_h, lse_h)
+        if t + 1 < n:                           # final rotation is dead
+            k_blk, v_blk = jax.tree.map(
+                lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
+    return o.astype(q.dtype)
 
 
 def ring_attention_impl(axis_name: str):

@@ -87,19 +87,23 @@ def _cpu_pin_dir() -> str:
     return d
 
 
-def _prepared_env(num_proc) -> dict:
+def _prepared_env(num_proc):
+    """Returns ``(env, pin_dir)``; ``pin_dir`` (or None) is owned by the
+    caller, which must remove it after the child exits — it is deliberately
+    NOT carried in the environment, where a nested ibfrun would inherit and
+    delete its parent session's live pin directory."""
     env = dict(os.environ)
+    pin = None
     if num_proc:
         virtual_mesh_env(env, num_proc)
         pin = _cpu_pin_dir()
         env["PYTHONPATH"] = pin + os.pathsep + env.get("PYTHONPATH", "")
-        env["_BF_PIN_DIR"] = pin  # removed by main() after the child exits
-    return env
+    return env, pin
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    env = _prepared_env(args.num_proc)
+    env, pin = _prepared_env(args.num_proc)
     cmd = args.command
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
@@ -115,10 +119,8 @@ def main(argv=None) -> int:
             argv = [sys.executable, "-i"] + (["-c", boot] if boot else [])
         return subprocess.call(argv, env=env)
     finally:
-        pin = env.get("_BF_PIN_DIR")
         if pin:
-            import shutil as _sh
-            _sh.rmtree(pin, ignore_errors=True)
+            shutil.rmtree(pin, ignore_errors=True)
 
 
 if __name__ == "__main__":
