@@ -43,12 +43,24 @@ from bluefog_tpu.basics import (  # noqa: F401
     load_machine_topology,
     in_neighbor_ranks,
     out_neighbor_ranks,
+    in_neighbor_machine_ranks,
+    out_neighbor_machine_ranks,
     allreduce,
+    allreduce_,
     allreduce_nonblocking,
+    allreduce_nonblocking_,
     allgather,
     allgather_nonblocking,
     broadcast,
+    broadcast_,
     broadcast_nonblocking,
+    broadcast_nonblocking_,
+    broadcast_optimizer_state,
+    set_skip_negotiate_stage,
+    get_skip_negotiate_stage,
+    mpi_threads_supported,
+    nccl_built,
+    unified_mpi_window_model_supported,
     neighbor_allgather,
     neighbor_allgather_nonblocking,
     neighbor_allreduce,

@@ -801,3 +801,64 @@ def broadcast_parameters(params, root_rank: int = 0):
 def allreduce_parameters(params, *, average: bool = True):
     """Allreduce (average) a pytree of rank-major arrays."""
     return jax.tree.map(lambda p: allreduce(p, average=average), params)
+
+
+def broadcast_optimizer_state(state, root_rank: int = 0):
+    """Broadcast a pytree of optimizer state from ``root_rank`` (parity:
+    ``torch/utility.py:85-212``, which round-trips ``state_dict`` through a
+    pickle broadcast — optax state is already a pytree, so this is just
+    :func:`broadcast_parameters` with integer leaves passed through)."""
+    return jax.tree.map(
+        lambda p: p if not hasattr(p, "dtype") or p.ndim == 0
+        else broadcast(p, root_rank), state)
+
+
+# ---------------------------------------------------------------------------
+# Drop-in parity shims (reference names whose underlying mechanism is
+# deleted-by-design or meaningless on immutable jax arrays)
+# ---------------------------------------------------------------------------
+
+def allreduce_(x, *, average: bool = True, name: Optional[str] = None):
+    """Reference in-place ``allreduce_`` — jax arrays are immutable, so this
+    is the functional op; rebind the result (``x = bf.allreduce_(x)``)."""
+    return allreduce(x, average=average, name=name)
+
+
+def allreduce_nonblocking_(x, *, average: bool = True,
+                           name: Optional[str] = None):
+    return allreduce_nonblocking(x, average=average, name=name)
+
+
+def broadcast_(x, root_rank: int, name: Optional[str] = None):
+    """Reference in-place ``broadcast_`` — see :func:`allreduce_`."""
+    return broadcast(x, root_rank, name)
+
+
+def broadcast_nonblocking_(x, root_rank: int, name: Optional[str] = None):
+    return broadcast_nonblocking(x, root_rank, name)
+
+
+def set_skip_negotiate_stage(value: bool) -> None:
+    """No-op: SPMD has no negotiation stage to skip (reference
+    ``basics.py:400-413``; the fast path is the permanent state here)."""
+
+
+def get_skip_negotiate_stage() -> bool:
+    return True  # permanently skipped by design
+
+
+def mpi_threads_supported() -> bool:
+    """Parity: always True — there is no MPI; JAX dispatch is thread-safe."""
+    return True
+
+
+def nccl_built() -> bool:
+    """Parity: False — there is no NCCL controller; XLA collectives over
+    ICI/DCN are the single (always-available) vendor."""
+    return False
+
+
+def unified_mpi_window_model_supported() -> bool:
+    """Parity: True — the window store has one memory model (the reference
+    probes MPI_WIN_UNIFIED, ``mpi_context.cc``)."""
+    return True
