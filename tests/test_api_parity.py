@@ -71,14 +71,23 @@ def test_machine_neighbor_queries():
 
 
 def test_broadcast_optimizer_state_pytree():
-    import optax
+    import jax
     import jax.numpy as jnp
+    import optax
     bf.init()
     n = bf.size()
     params = {"w": jnp.ones((n, 4))}
     state = optax.sgd(0.1, momentum=0.9).init(params)
-    out = bf.broadcast_optimizer_state(state, root_rank=0)
-    # same tree structure, momentum buffers broadcast
-    import jax
+    # Diverge the momentum buffers per rank, then broadcast rank 2's.
+    diverged = jax.tree_util.tree_map(
+        lambda b: b + jnp.arange(n, dtype=b.dtype)[:, None]
+        if hasattr(b, "ndim") and b.ndim == 2 else b, state)
+    out = bf.broadcast_optimizer_state(diverged, root_rank=2)
     assert jax.tree_util.tree_structure(out) == \
         jax.tree_util.tree_structure(state)
+    momenta = [np.asarray(b) for b in jax.tree_util.tree_leaves(out)
+               if hasattr(b, "ndim") and b.ndim == 2]
+    assert momenta, "expected a broadcast momentum buffer"
+    for buf in momenta:
+        # momentum starts at zeros; rank r's row became r; root 2 broadcast
+        np.testing.assert_allclose(buf, np.full((n, 4), 2.0))

@@ -234,3 +234,78 @@ def test_bfloat16_neighbor_allreduce():
     expected = _expected_neighbor_allreduce(rank_tensors((4,)), w)
     np.testing.assert_allclose(np.asarray(out, dtype=np.float32), expected,
                                atol=0.1)
+
+
+# ---------------------------------------------------------------------------
+# dtype grid (reference torch_ops_test.py runs every collective x dtype,
+# e.g. :136-209 allreduce over the self.dtypes list)
+# ---------------------------------------------------------------------------
+
+_FLOAT_DTYPES = [np.float32, np.float16, "bfloat16"]
+_INT_DTYPES = [np.int32, np.uint8]
+
+
+def _mk(dtype):
+    import jax.numpy as jnp
+    return jnp.asarray(rank_tensors((4,), np.float32)).astype(dtype)
+
+
+def _name(dtype) -> str:
+    return "bfloat16" if dtype == "bfloat16" else np.dtype(dtype).name
+
+
+@pytest.mark.parametrize("dtype", _FLOAT_DTYPES + _INT_DTYPES)
+def test_broadcast_dtype_grid(dtype):
+    x = _mk(dtype)
+    out = bf.broadcast(x, root_rank=3)
+    assert str(out.dtype) == _name(dtype)
+    got = np.asarray(out.astype("float32"))
+    np.testing.assert_allclose(got, np.full((N, 4), 3.0))
+
+
+@pytest.mark.parametrize("dtype", _FLOAT_DTYPES + _INT_DTYPES)
+def test_allreduce_sum_dtype_grid(dtype):
+    x = _mk(dtype)
+    out = bf.allreduce(x, average=False)
+    assert str(out.dtype) == _name(dtype)
+    got = np.asarray(out.astype("float32"))
+    np.testing.assert_allclose(got, np.full((N, 4), sum(range(N))))
+
+
+@pytest.mark.parametrize("dtype", _FLOAT_DTYPES + _INT_DTYPES)
+def test_allgather_dtype_grid(dtype):
+    x = _mk(dtype)
+    out = bf.allgather(x)
+    assert out.shape == (N, N * 4)
+    got = np.asarray(out.astype("float32"))
+    expected = np.repeat(np.arange(N, dtype=np.float32), 4)[None].repeat(N, 0)
+    np.testing.assert_allclose(got, expected)
+
+
+@pytest.mark.parametrize("dtype", _FLOAT_DTYPES)
+def test_neighbor_allreduce_dtype_grid(dtype):
+    """Weighted averaging: float dtypes only (as in the reference, where the
+    weighted path requires floating tensors, torch/mpi_ops.py:433-489)."""
+    x = _mk(dtype)
+    out = bf.neighbor_allreduce(x)
+    assert str(out.dtype) == _name(dtype)
+    got = np.asarray(out.astype("float32"))
+    x = rank_tensors((4,))
+    # default init: unweighted topology -> uniform 1/(indeg+1) combine
+    w = np.zeros((N, N))
+    for dst in range(N):
+        nbrs = bf.in_neighbor_ranks(dst) + [dst]
+        w[nbrs, dst] = 1.0 / len(nbrs)
+    expected = _expected_neighbor_allreduce(x, w)
+    tol = 5e-2 if dtype != np.float32 else 1e-5
+    np.testing.assert_allclose(got, expected, atol=tol)
+
+
+@pytest.mark.parametrize("dtype", _FLOAT_DTYPES)
+def test_pair_gossip_dtype_grid(dtype):
+    x = _mk(dtype)
+    targets = [(r + 1) % N if r % 2 == 0 else (r - 1) % N for r in range(N)]
+    out = bf.pair_gossip(x, targets)
+    got = np.asarray(out.astype("float32"))
+    expected = np.stack([np.full(4, (r + targets[r]) / 2.0) for r in range(N)])
+    np.testing.assert_allclose(got, expected, atol=2e-2)
