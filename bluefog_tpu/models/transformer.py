@@ -39,7 +39,7 @@ def local_attention(q, k, v, *, causal: bool = True):
 class TransformerConfig:
     def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
                  embed_dim=512, mlp_ratio=4, max_seq_len=2048,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, remat=False):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -47,6 +47,11 @@ class TransformerConfig:
         self.mlp_ratio = mlp_ratio
         self.max_seq_len = max_seq_len
         self.dtype = dtype
+        # jax.checkpoint per block: recompute activations in the backward
+        # instead of keeping every layer's live — trades ~1/3 more FLOPs
+        # for O(num_layers) less activation HBM, the standard long-context
+        # training knob (pairs with the O(S)-memory flash attention).
+        self.remat = remat
 
 
 class Block(nn.Module):
@@ -94,8 +99,9 @@ class TransformerLM(nn.Module):
         pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
                        dtype=cfg.dtype, name="wpe")(positions)
         x = x + pos
+        block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
-            x = Block(cfg, attn, name=f"block_{i}")(x)
+            x = block_cls(cfg, attn, name=f"block_{i}")(x)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x)

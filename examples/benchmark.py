@@ -48,6 +48,10 @@ def build_parser():
     ap.add_argument("--flash-attention", action="store_true",
                     help="transformer model: use the Pallas flash-attention "
                          "kernel (compiled Mosaic on TPU) instead of dense")
+    ap.add_argument("--remat", action="store_true",
+                    help="transformer model: jax.checkpoint each block "
+                         "(recompute activations in backward; long-context "
+                         "memory knob)")
     return ap
 
 
@@ -82,7 +86,8 @@ def measure(args, devices=None, quiet=False):
         labels = jnp.zeros((n, args.batch_size), jnp.int32)
         has_bn = False
     else:
-        cfg = models.TransformerConfig(max_seq_len=args.seq_len)
+        cfg = models.TransformerConfig(max_seq_len=args.seq_len,
+                                       remat=args.remat)
         attn = None
         if args.flash_attention:
             from bluefog_tpu.ops.flash_attention import flash_attention_impl

@@ -57,3 +57,32 @@ def test_transformer_forward():
     params = m.init(jax.random.PRNGKey(0), tokens)
     logits = m.apply(params, tokens)
     assert logits.shape == (2, 16, 100)
+
+
+def test_transformer_remat_matches_plain():
+    """cfg.remat=True (jax.checkpoint per block) must not change outputs or
+    gradients — only the backward's memory/recompute schedule."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+
+    kw = dict(vocab_size=64, num_layers=2, num_heads=4, embed_dim=32,
+              max_seq_len=16, dtype=jnp.float32)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
+    plain = TransformerLM(TransformerConfig(**kw))
+    remat = TransformerLM(TransformerConfig(remat=True, **kw))
+    params = plain.init(jax.random.PRNGKey(0), tokens)
+
+    out_p = plain.apply(params, tokens)
+    out_r = remat.apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_p),
+                               rtol=1e-6, atol=1e-6)
+
+    loss = lambda m: lambda p: jnp.sum(m.apply(p, tokens) ** 2)
+    g_p = jax.grad(loss(plain))(params)
+    g_r = jax.grad(loss(remat))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_p),
+                    jax.tree_util.tree_leaves(g_r)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-5)
