@@ -87,9 +87,14 @@ class TransformerLM(nn.Module):
     attn_impl: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, positions=None):
+    def __call__(self, tokens, train: bool = True, positions=None,
+                 return_hidden: bool = False):
         """``positions``: optional (B, S) global position ids — required when
-        the sequence axis is sharded (each shard must embed its own offset)."""
+        the sequence axis is sharded (each shard must embed its own offset).
+        ``return_hidden``: skip the lm-head and return the final normalized
+        activations (B, S, E) — pair with
+        ``ops.chunked_loss.chunked_softmax_cross_entropy`` so very long
+        sequences never materialize the (S, vocab) logits."""
         cfg = self.cfg
         attn = self.attn_impl or local_attention
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
@@ -103,6 +108,9 @@ class TransformerLM(nn.Module):
         for i in range(cfg.num_layers):
             x = block_cls(cfg, attn, name=f"block_{i}")(x)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-                          name="lm_head")(x)
-        return logits
+        head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")
+        if return_hidden:
+            head(x[:, :1])  # materialize the lm_head param without S x V
+            return x
+        return head(x)

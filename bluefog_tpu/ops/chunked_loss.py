@@ -1,0 +1,61 @@
+"""Chunked softmax cross-entropy: O(chunk x vocab) memory lm-head loss.
+
+Long-context training on a single chip is bounded by the lm-head logits, not
+attention (flash attention is O(S); the ``(S, vocab)`` f32 logits are not —
+8.4 GB at S=64k, vocab=32k).  This computes the standard next-token loss
+without ever materializing the full logits: a ``lax.scan`` over sequence
+chunks projects each chunk, reduces it to its per-row ``logsumexp`` and the
+correct-token logit, and drops the chunk logits immediately.
+``jax.checkpoint`` on the chunk body extends the same economy to the
+backward (each chunk's logits are recomputed, never stored).
+
+The result is bit-comparable to
+``optax.softmax_cross_entropy_with_integer_labels(h @ W, targets)`` up to
+f32 reduction order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["chunked_softmax_cross_entropy"]
+
+
+def chunked_softmax_cross_entropy(hidden, lm_head, targets, *,
+                                  chunk: int = 1024):
+    """Mean next-token cross-entropy over ``(B, S)`` without full logits.
+
+    ``hidden``: (B, S, E) final-layer activations; ``lm_head``: (E, V)
+    projection (pass ``params["lm_head"]["kernel"]``); ``targets``: (B, S)
+    int labels.  ``chunk`` rows of logits exist at a time (per batch row).
+    """
+    B, S, E = hidden.shape
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    # Largest divisor of S <= chunk, so awkward S (odd, prime factors) still
+    # gets the biggest legal chunk instead of degrading to 1 via halving.
+    c = min(chunk, S)
+    while S % c:
+        c -= 1
+    n_chunks = S // c
+
+    h = hidden.reshape(B, n_chunks, c, E).transpose(1, 0, 2, 3)  # (n,B,c,E)
+    t = targets.reshape(B, n_chunks, c).transpose(1, 0, 2)       # (n,B,c)
+
+    @jax.checkpoint
+    def chunk_loss(h_c, t_c):
+        logits = jnp.einsum("bce,ev->bcv", h_c.astype(jnp.float32),
+                            lm_head.astype(jnp.float32))
+        lse = jax.nn.logsumexp(logits, axis=-1)                  # (B, c)
+        correct = jnp.take_along_axis(logits, t_c[..., None],
+                                      axis=-1)[..., 0]
+        return jnp.sum(lse - correct)
+
+    def body(acc, xs):
+        h_c, t_c = xs
+        return acc + chunk_loss(h_c, t_c), None
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (h, t))
+    return total / (B * S)

@@ -52,6 +52,9 @@ def build_parser():
                     help="transformer model: jax.checkpoint each block "
                          "(recompute activations in backward; long-context "
                          "memory knob)")
+    ap.add_argument("--chunked-loss", action="store_true",
+                    help="transformer model: chunked lm-head cross-entropy "
+                         "(never materializes the S x vocab logits)")
     return ap
 
 
@@ -135,7 +138,18 @@ def measure(args, devices=None, quiet=False):
     else:
         params = rank_major(variables["params"] if "params" in variables
                             else variables)
-        if args.model == "transformer":
+        if args.model == "transformer" and args.chunked_loss:
+            from bluefog_tpu.ops.chunked_loss import \
+                chunked_softmax_cross_entropy
+
+            def loss_fn(p, x, _):
+                tree = {"params": p} if "params" in variables else p
+                h = model.apply(tree, x, return_hidden=True)
+                # p is the params mapping in either branch
+                kernel = p["lm_head"]["kernel"]
+                tgt = jnp.roll(x, -1, axis=1)
+                return chunked_softmax_cross_entropy(h, kernel, tgt)
+        elif args.model == "transformer":
             def loss_fn(p, x, _):
                 logits = model.apply(
                     {"params": p} if "params" in variables else p, x)

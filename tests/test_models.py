@@ -86,3 +86,53 @@ def test_transformer_remat_matches_plain():
                     jax.tree_util.tree_leaves(g_r)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_loss_matches_dense():
+    """chunked_softmax_cross_entropy == optax dense CE in value and grad,
+    through the model's return_hidden path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+    from bluefog_tpu.ops.chunked_loss import chunked_softmax_cross_entropy
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                            embed_dim=32, max_seq_len=16, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    tgt = jnp.roll(tokens, -1, axis=1)
+
+    def dense_loss(p):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            model.apply(p, tokens), tgt).mean()
+
+    def chunked_loss(p):
+        h = model.apply(p, tokens, return_hidden=True)
+        return chunked_softmax_cross_entropy(
+            h, p["params"]["lm_head"]["kernel"], tgt, chunk=4)
+
+    np.testing.assert_allclose(float(chunked_loss(params)),
+                               float(dense_loss(params)), rtol=1e-5)
+    g_d = jax.grad(dense_loss)(params)
+    g_c = jax.grad(chunked_loss)(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_d),
+                    jax.tree_util.tree_leaves(g_c)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_loss_uneven_chunk_fits_down():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.ops.chunked_loss import chunked_softmax_cross_entropy
+    h = jnp.asarray(np.random.RandomState(0).randn(1, 12, 8), jnp.float32)
+    W = jnp.asarray(np.random.RandomState(1).randn(8, 20), jnp.float32)
+    t = jnp.asarray(np.random.RandomState(2).randint(0, 20, (1, 12)))
+    # chunk=8 does not divide 12 -> fits down to 6 (largest divisor)
+    out = chunked_softmax_cross_entropy(h, W, t, chunk=8)
+    ref = chunked_softmax_cross_entropy(h, W, t, chunk=12)
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-6)
