@@ -123,3 +123,25 @@ print('CELL3', float(np.asarray(bf.allreduce(x)).mean()) >= 0)
     assert "rank(s) ready" in out.stdout, out.stdout
     for marker in ("CELL1 True", "CELL2 True", "CELL3 True"):
         assert marker in out.stdout, out.stdout
+
+
+def test_helloworld_notebook_cells_execute():
+    """The interactive helloworld notebook's code cells run top-to-bottom in
+    one namespace (what a kernel would do) and reach consensus."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+    nb = json.load(open(os.path.join(REPO, "examples",
+                                     "interactive_helloworld.ipynb")))
+    ns = {}
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        for cell in nb["cells"]:
+            if cell["cell_type"] == "code":
+                exec("".join(cell["source"]), ns)
+    out = buf.getvalue()
+    assert "ranks: 8" in out, out
+    assert "comm while suspended -> RuntimeError" in out, out
+    dev = float(out.split("max deviation from mean:")[1].split()[0])
+    assert dev < 1e-3, out
+    assert not ns["bf"].suspended()
