@@ -66,9 +66,14 @@ class Block(nn.Module):
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
         qkv = nn.Dense(3 * cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
                        name="qkv")(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        B, S = q.shape[0], q.shape[1]
-        q, k, v = (t.reshape(B, S, h, d) for t in (q, k, v))
+        B, S = qkv.shape[0], qkv.shape[1]
+        # Head-interleaved fused layout [q_h0 k_h0 v_h0 | q_h1 ...]: a pure
+        # relabeling of kernel columns that keeps tensor-parallel shard
+        # boundaries (tp_param_specs' column split) aligned to heads, so
+        # GSPMD runs attention head-parallel with one psum per block
+        # instead of per-activation resharding.
+        qkv = qkv.reshape(B, S, h, 3, d)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         attn = self.attn_impl(q, k, v, causal=True)
         attn = attn.reshape(B, S, cfg.embed_dim)
         x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,

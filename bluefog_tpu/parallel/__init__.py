@@ -6,6 +6,8 @@ framework's first-class long-context / distributed-scale machinery:
   * ``ring_attention`` — exact attention over sequence-sharded K/V rotating on
     a ``ppermute`` ring (memory O(S/n) per device).
   * ``ulysses_attention`` — all-to-all head-parallel sequence parallelism.
+  * ``tp_param_specs`` / ``tp_shard_params`` — Megatron-layout tensor
+    parallelism as GSPMD sharding specs (XLA places the collectives).
 """
 
 from bluefog_tpu.parallel.ring_attention import (  # noqa: F401
@@ -14,3 +16,5 @@ from bluefog_tpu.parallel.ring_attention import (  # noqa: F401
 from bluefog_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention, ulysses_attention_impl,
 )
+from bluefog_tpu.parallel.tensor_parallel import (  # noqa: F401
+    tp_param_specs, tp_shard_params)
