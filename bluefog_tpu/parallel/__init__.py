@@ -8,6 +8,8 @@ framework's first-class long-context / distributed-scale machinery:
   * ``ulysses_attention`` — all-to-all head-parallel sequence parallelism.
   * ``tp_param_specs`` / ``tp_shard_params`` — Megatron-layout tensor
     parallelism as GSPMD sharding specs (XLA places the collectives).
+  * ``pipeline_apply`` — GPipe microbatch pipelining as one
+    ``lax.scan`` + per-tick ``ppermute`` (differentiable end-to-end).
 """
 
 from bluefog_tpu.parallel.ring_attention import (  # noqa: F401
@@ -18,3 +20,4 @@ from bluefog_tpu.parallel.ulysses import (  # noqa: F401
 )
 from bluefog_tpu.parallel.tensor_parallel import (  # noqa: F401
     tp_param_specs, tp_shard_params)
+from bluefog_tpu.parallel.pipeline import pipeline_apply  # noqa: F401

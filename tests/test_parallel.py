@@ -206,3 +206,91 @@ def test_tensor_parallel_grad_step_matches(devices):
                     jax.tree_util.tree_leaves(out_grads)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-4, atol=1e-5)
+
+
+def _mlp_stage(w, x):
+    return jnp.tanh(x @ w)
+
+
+def test_pipeline_matches_sequential(devices):
+    """GPipe schedule over 4 stages: outputs equal applying the stages
+    sequentially; every rank receives the full result."""
+    from bluefog_tpu.parallel.pipeline import pipeline_apply
+    n_pp, M, mb, d = 4, 6, 3, 8
+    rng = np.random.RandomState(0)
+    Ws = jnp.asarray(rng.randn(n_pp, d, d) * 0.5, jnp.float32)
+    x = jnp.asarray(rng.randn(M, mb, d), jnp.float32)
+
+    ref = x
+    for i in range(n_pp):
+        ref = _mlp_stage(Ws[i], ref)
+
+    mesh = Mesh(np.asarray(devices[:n_pp]), ("pp",))
+    out = jax.jit(jax.shard_map(
+        lambda W, x: pipeline_apply(
+            lambda w, xb: _mlp_stage(w[0], xb), W, x, axis_name="pp"),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))(Ws, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_grads_match_sequential(devices):
+    """Reverse-mode AD through the scan+ppermute schedule equals sequential
+    backprop — training-capable pipelining with no hand-written backward."""
+    from bluefog_tpu.parallel.pipeline import pipeline_apply
+    n_pp, M, mb, d = 4, 5, 2, 6
+    rng = np.random.RandomState(1)
+    Ws = jnp.asarray(rng.randn(n_pp, d, d) * 0.5, jnp.float32)
+    x = jnp.asarray(rng.randn(M, mb, d), jnp.float32)
+    mesh = Mesh(np.asarray(devices[:n_pp]), ("pp",))
+
+    def loss_seq(Ws):
+        h = x
+        for i in range(n_pp):
+            h = _mlp_stage(Ws[i], h)
+        return jnp.sum(h ** 2)
+
+    def loss_pp(Ws):
+        out = jax.shard_map(
+            lambda W, xb: pipeline_apply(
+                lambda w, z: _mlp_stage(w[0], z), W, xb, axis_name="pp"),
+            mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+            check_vma=False)(Ws, x)
+        return jnp.sum(out ** 2)
+
+    g_ref = jax.grad(loss_seq)(Ws)
+    g_pp = jax.jit(jax.grad(loss_pp))(Ws)
+    np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_transformer_blocks(devices):
+    """Pipeline the TransformerLM's blocks across 2 stages: equals the
+    single-device model applied to the same microbatches."""
+    from bluefog_tpu.models.transformer import Block, local_attention
+    from bluefog_tpu.models import TransformerConfig
+    from bluefog_tpu.parallel.pipeline import pipeline_apply
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                            embed_dim=32, max_seq_len=8, dtype=jnp.float32)
+    block = Block(cfg, local_attention)
+    rng = np.random.RandomState(2)
+    M, mb, S = 4, 2, 8
+    x = jnp.asarray(rng.randn(M, mb, S, cfg.embed_dim), jnp.float32)
+    p0 = block.init(jax.random.PRNGKey(0), x[0])
+    p1 = block.init(jax.random.PRNGKey(1), x[0])
+
+    ref = jax.vmap(lambda xb: block.apply(
+        p1, block.apply(p0, xb)))(x)
+
+    stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]), p0, p1)
+    mesh = Mesh(np.asarray(devices[:2]), ("pp",))
+    out = jax.jit(jax.shard_map(
+        lambda W, xb: pipeline_apply(
+            lambda w, z: block.apply(jax.tree.map(lambda a: a[0], w), z),
+            W, xb, axis_name="pp"),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
