@@ -10,6 +10,8 @@ framework's first-class long-context / distributed-scale machinery:
     parallelism as GSPMD sharding specs (XLA places the collectives).
   * ``pipeline_apply`` — GPipe microbatch pipelining as one
     ``lax.scan`` + per-tick ``ppermute`` (differentiable end-to-end).
+  * ``moe_apply`` — switch-routed mixture-of-experts with expert
+    parallelism over a mesh axis (dense one-hot dispatch, one psum).
 """
 
 from bluefog_tpu.parallel.ring_attention import (  # noqa: F401
@@ -21,3 +23,4 @@ from bluefog_tpu.parallel.ulysses import (  # noqa: F401
 from bluefog_tpu.parallel.tensor_parallel import (  # noqa: F401
     tp_param_specs, tp_shard_params)
 from bluefog_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from bluefog_tpu.parallel.moe import moe_apply, switch_dispatch  # noqa: F401

@@ -1,0 +1,78 @@
+"""Expert parallelism: switch-routed mixture-of-experts over a mesh axis.
+
+Completes the framework's parallelism axes (dp / sp / tp / pp / **ep**) —
+all beyond the data-parallel-only reference (SURVEY §2.3).  One expert per
+``axis_name`` rank; routing is top-1 (Switch Transformer) with a static
+capacity so every shape is fixed under jit:
+
+* every rank evaluates the (replicated) router identically — SPMD means
+  there is nothing to negotiate, the dispatch plan is born globally
+  consistent (the same fact that deletes the reference's coordinator);
+* rank e gathers its tokens with its row of the dense one-hot dispatch
+  tensor (a matmul, MXU-friendly, no gather/scatter), applies its local
+  expert, and scatters results back with the transpose;
+* one ``psum`` over the axis recombines — overflow tokens (beyond
+  ``capacity``) drop to zero exactly as in Switch.
+
+Differentiable end-to-end (the straight-through is unnecessary: top-1
+selection is constant w.r.t. parameters at a point; router gradients flow
+through the combine weights as in the Switch paper).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["moe_apply", "switch_dispatch"]
+
+
+def switch_dispatch(router_logits, n_experts: int, capacity: int):
+    """Top-1 dispatch plan: ``(combine, dispatch)`` from (T, E) logits.
+
+    ``dispatch``: (E, C, T) one-hot — slot c of expert e takes token t.
+    ``combine``: (T, E, C) — same plan weighted by the router probability
+    (the gradient path to the router).  Tokens past ``capacity`` for their
+    expert are dropped (all-zero rows), per Switch semantics."""
+    T, E = router_logits.shape
+    if E != n_experts:
+        raise ValueError(
+            f"router emits {E} expert logits but the layer has "
+            f"{n_experts} experts")
+    probs = jax.nn.softmax(router_logits, axis=-1)          # (T, E)
+    expert = jnp.argmax(probs, axis=-1)                     # (T,)
+    onehot = jax.nn.one_hot(expert, E, dtype=probs.dtype)   # (T, E)
+    # Position of each token within its expert's queue.
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot    # (T, E)
+    keep = (pos < capacity) * onehot                        # (T, E)
+    slot = jax.nn.one_hot(pos.sum(-1), capacity,
+                          dtype=probs.dtype)                # (T, C)
+    dispatch = jnp.einsum("te,tc->ect", keep, slot)         # (E, C, T)
+    gate = (probs * keep).sum(-1)                           # (T,)
+    combine = jnp.einsum("t,ect->tec", gate, dispatch)      # (T, E, C)
+    return combine, dispatch
+
+
+def moe_apply(expert_fn, expert_params, x, router_logits, *,
+              axis_name: str = "ep", capacity: int | None = None):
+    """Apply this rank's expert within an ``axis_name``-wide MoE layer.
+
+    ``x``: (T, d) tokens, replicated over the axis; ``router_logits``:
+    (T, E) from a replicated router (E == axis size).  Returns (T, d) — the
+    gated sum of expert outputs, identical on every rank."""
+    E = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    T = x.shape[0]
+    if capacity is None:
+        capacity = max(1, (2 * T) // E)                     # factor-2 default
+
+    combine, dispatch = switch_dispatch(router_logits, E, capacity)
+    my_dispatch = lax.dynamic_index_in_dim(dispatch, me, 0,
+                                           keepdims=False)  # (C, T)
+    xe = my_dispatch @ x                                     # (C, d)
+    ye = expert_fn(expert_params, xe)                        # (C, d)
+    my_combine = lax.dynamic_index_in_dim(
+        combine, me, axis=1, keepdims=False)                 # (T, C)
+    y = my_combine @ ye                                      # (T, d)
+    return lax.psum(y, axis_name)

@@ -294,3 +294,55 @@ def test_pipeline_transformer_blocks(devices):
         check_vma=False))(stacked, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_moe_expert_parallel_matches_dense(devices):
+    """Switch-routed MoE over a 4-rank ep axis == the dense single-device
+    evaluation of the same routing plan (incl. capacity drops)."""
+    from bluefog_tpu.parallel.moe import moe_apply, switch_dispatch
+    E, T, d, C = 4, 12, 8, 4
+    rng = np.random.RandomState(0)
+    Ws = jnp.asarray(rng.randn(E, d, d) * 0.5, jnp.float32)
+    x = jnp.asarray(rng.randn(T, d), jnp.float32)
+    logits = jnp.asarray(rng.randn(T, E), jnp.float32)
+
+    # dense reference from the same dispatch plan
+    combine, dispatch = switch_dispatch(logits, E, C)
+    ref = jnp.zeros_like(x)
+    for e in range(E):
+        ye = jnp.tanh((dispatch[e] @ x) @ Ws[e])
+        ref = ref + jnp.moveaxis(combine, 1, 0)[e] @ ye
+
+    mesh = Mesh(np.asarray(devices[:E]), ("ep",))
+    out = jax.jit(jax.shard_map(
+        lambda W, x, lg: moe_apply(
+            lambda w, z: jnp.tanh(z @ w[0]), W, x, lg,
+            axis_name="ep", capacity=C),
+        mesh=mesh, in_specs=(P("ep"), P(), P()), out_specs=P(),
+        check_vma=False))(Ws, x, logits)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_moe_grads_flow_to_router_and_experts(devices):
+    """Router and expert parameters both receive nonzero gradients through
+    the gated combine (Switch-style differentiability)."""
+    from bluefog_tpu.parallel.moe import moe_apply
+    E, T, d = 4, 8, 6
+    rng = np.random.RandomState(1)
+    Ws = jnp.asarray(rng.randn(E, d, d) * 0.5, jnp.float32)
+    Wr = jnp.asarray(rng.randn(d, E) * 0.5, jnp.float32)
+    x = jnp.asarray(rng.randn(T, d), jnp.float32)
+    mesh = Mesh(np.asarray(devices[:E]), ("ep",))
+
+    def loss(Ws, Wr):
+        out = jax.shard_map(
+            lambda W, x, lg: moe_apply(
+                lambda w, z: jnp.tanh(z @ w[0]), W, x, lg, axis_name="ep"),
+            mesh=mesh, in_specs=(P("ep"), P(), P()), out_specs=P(),
+            check_vma=False)(Ws, x, x @ Wr)
+        return jnp.sum(out ** 2)
+
+    g_w, g_r = jax.jit(jax.grad(loss, argnums=(0, 1)))(Ws, Wr)
+    assert float(jnp.abs(g_w).max()) > 0
+    assert float(jnp.abs(g_r).max()) > 0
