@@ -347,11 +347,12 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 1024,
-                    block_k: int = 1024, interpret: bool = None):
+                    block_k: int = 1024, interpret: bool = None, vma=None):
     """Memory-O(S) exact attention; inputs/outputs ``(B, S, H, D)``.
 
     ``interpret`` defaults to True off-TPU (Pallas interpreter) and False on
-    TPU (compiled Mosaic kernel).
+    TPU (compiled Mosaic kernel).  ``vma``: frozenset of mesh axis names the
+    inputs vary over — required inside ``shard_map(..., check_vma=True)``.
 
     Block sizes default to 1024 (fitted down to divide S): with head dim 64
     the MXU's contraction is already starved, so tall tiles are what amortize
@@ -359,7 +360,7 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 1024,
     the forward ~20x and the backward ~12x faster than 128-blocks."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, block_q, block_k, interpret)[0]
+    return _flash(q, k, v, causal, block_q, block_k, interpret, vma)[0]
 
 
 def flash_attention_lse(q, k, v, *, causal: bool = True, block_q: int = 1024,

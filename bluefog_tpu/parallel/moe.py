@@ -35,6 +35,16 @@ def switch_dispatch(router_logits, n_experts: int, capacity: int):
     ``combine``: (T, E, C) — same plan weighted by the router probability
     (the gradient path to the router).  Tokens past ``capacity`` for their
     expert are dropped (all-zero rows), per Switch semantics."""
+    gate, keep, slot = _plan(router_logits, n_experts, capacity)
+    dispatch = jnp.einsum("te,tc->ect", keep, slot)         # (E, C, T)
+    combine = jnp.einsum("t,ect->tec", gate, dispatch)      # (T, E, C)
+    return combine, dispatch
+
+
+def _plan(router_logits, n_experts: int, capacity: int):
+    """O(T*(E+C)) routing plan: ``(gate, keep, slot)`` — ranks slice out
+    their own expert's column instead of materializing the dense (E, C, T)
+    tensors (which are O(T^2) at the default capacity)."""
     T, E = router_logits.shape
     if E != n_experts:
         raise ValueError(
@@ -48,10 +58,8 @@ def switch_dispatch(router_logits, n_experts: int, capacity: int):
     keep = (pos < capacity) * onehot                        # (T, E)
     slot = jax.nn.one_hot(pos.sum(-1), capacity,
                           dtype=probs.dtype)                # (T, C)
-    dispatch = jnp.einsum("te,tc->ect", keep, slot)         # (E, C, T)
     gate = (probs * keep).sum(-1)                           # (T,)
-    combine = jnp.einsum("t,ect->tec", gate, dispatch)      # (T, E, C)
-    return combine, dispatch
+    return gate, keep, slot
 
 
 def moe_apply(expert_fn, expert_params, x, router_logits, *,
@@ -67,12 +75,12 @@ def moe_apply(expert_fn, expert_params, x, router_logits, *,
     if capacity is None:
         capacity = max(1, (2 * T) // E)                     # factor-2 default
 
-    combine, dispatch = switch_dispatch(router_logits, E, capacity)
-    my_dispatch = lax.dynamic_index_in_dim(dispatch, me, 0,
-                                           keepdims=False)  # (C, T)
+    gate, keep, slot = _plan(router_logits, E, capacity)
+    my_keep = lax.dynamic_index_in_dim(keep, me, axis=1,
+                                       keepdims=False)       # (T,)
+    my_dispatch = slot.T * my_keep[None, :]                  # (C, T)
     xe = my_dispatch @ x                                     # (C, d)
     ye = expert_fn(expert_params, xe)                        # (C, d)
-    my_combine = lax.dynamic_index_in_dim(
-        combine, me, axis=1, keepdims=False)                 # (T, C)
+    my_combine = (gate * my_keep)[:, None] * slot            # (T, C)
     y = my_combine @ ye                                      # (T, d)
     return lax.psum(y, axis_name)
