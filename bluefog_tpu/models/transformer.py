@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -39,7 +40,8 @@ def local_attention(q, k, v, *, causal: bool = True):
 class TransformerConfig:
     def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
                  embed_dim=512, mlp_ratio=4, max_seq_len=2048,
-                 dtype=jnp.bfloat16, remat=False):
+                 dtype=jnp.bfloat16, remat=False, num_experts=0,
+                 expert_capacity_factor=2.0, router_group_size=4096):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -52,6 +54,66 @@ class TransformerConfig:
         # for O(num_layers) less activation HBM, the standard long-context
         # training knob (pairs with the O(S)-memory flash attention).
         self.remat = remat
+        # num_experts > 0 replaces each block's MLP with a switch-routed
+        # mixture of experts (top-1, static capacity).  Expert weights are
+        # stacked (E, ...) so ``parallel.tp_param_specs``-style expert
+        # sharding (P("ep")) runs them expert-parallel under GSPMD.
+        self.num_experts = num_experts
+        self.expert_capacity_factor = expert_capacity_factor
+        self.router_group_size = router_group_size
+
+
+class SwitchMlp(nn.Module):
+    """Top-1 routed mixture-of-experts MLP (Switch Transformer).
+
+    Tokens route within fixed-size groups (``cfg.router_group_size``), so the
+    one-hot dispatch tensors are O(T * group_size) — linear in sequence
+    length — instead of the O(T^2) a single global group would cost.  Every
+    shape is static under jit; expert weights are stacked ``(E, ...)`` so a
+    ``P("ep")`` sharding on them runs the einsums expert-parallel with
+    GSPMD-placed collectives — same layout-not-algorithm philosophy as
+    ``parallel.tensor_parallel``.
+
+    The standard load-balancing auxiliary loss (Switch eq. 4: E * sum_e
+    f_e p_e per group) is sown as ``intermediates/moe_aux_loss`` — add
+    ``aux_weight * sum(sown)`` to the training loss to keep the router from
+    collapsing onto one expert."""
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x):
+        from bluefog_tpu.parallel.moe import switch_dispatch
+        cfg = self.cfg
+        B, S, d = x.shape
+        E = cfg.num_experts
+        hidden = cfg.mlp_ratio * d
+        T = B * S
+        g = min(getattr(cfg, "router_group_size", 4096), T)
+        while T % g:
+            g -= 1
+        G = T // g
+        capacity = max(1, int(cfg.expert_capacity_factor * g / E))
+        xt = x.reshape(G, g, d)
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          name="router")(xt.astype(jnp.float32))
+        combine, dispatch = jax.vmap(
+            lambda lg: switch_dispatch(lg, E, capacity))(logits)
+        # Load balance (per group): E * sum_e (tokens_frac_e * mean_prob_e).
+        probs = jax.nn.softmax(logits, axis=-1)             # (G, g, E)
+        frac = dispatch.sum(axis=(2, 3)) / g                # (G, E)
+        aux = (E * (frac * probs.mean(axis=1)).sum(-1)).mean()
+        self.sow("intermediates", "moe_aux_loss", aux)
+        up = self.param("experts_up", nn.initializers.lecun_normal(),
+                        (E, d, hidden))
+        down = self.param("experts_down", nn.initializers.lecun_normal(),
+                          (E, hidden, d))
+        xe = jnp.einsum("gect,gtd->gecd", dispatch.astype(cfg.dtype),
+                        xt.astype(cfg.dtype))
+        ye = nn.gelu(jnp.einsum("gecd,edh->gech", xe,
+                                up.astype(cfg.dtype)))
+        ye = jnp.einsum("gech,ehd->gecd", ye, down.astype(cfg.dtype))
+        y = jnp.einsum("gtec,gecd->gtd", combine.astype(cfg.dtype), ye)
+        return y.reshape(B, S, d)
 
 
 class Block(nn.Module):
@@ -79,11 +141,14 @@ class Block(nn.Module):
         x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
                          name="proj")(attn)
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
-        y = nn.Dense(cfg.mlp_ratio * cfg.embed_dim, use_bias=False,
-                     dtype=cfg.dtype, name="up")(y)
-        y = nn.gelu(y)
-        x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
-                         name="down")(y)
+        if getattr(cfg, "num_experts", 0) > 0:
+            x = x + SwitchMlp(cfg, name="moe")(y)
+        else:
+            y = nn.Dense(cfg.mlp_ratio * cfg.embed_dim, use_bias=False,
+                         dtype=cfg.dtype, name="up")(y)
+            y = nn.gelu(y)
+            x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                             name="down")(y)
         return x
 
 

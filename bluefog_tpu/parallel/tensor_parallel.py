@@ -43,25 +43,31 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
-def tp_param_specs(params, *, axis: str = "tp"):
+def tp_param_specs(params, *, axis: str = "tp", ep_axis: str | None = None):
     """PartitionSpec pytree for a ``TransformerLM`` params tree.
 
     Embeddings, norms and biases replicate; every big matmul is sharded per
-    the Megatron column/row pattern above.  Unrecognized 2-D kernels
-    replicate (correct, just not sharded) — TP is a layout hint, never a
-    semantic change."""
+    the Megatron column/row pattern above.  With ``ep_axis`` set, stacked
+    MoE expert weights (``experts_up``/``experts_down``, leading dim = E)
+    shard expert-parallel over that axis.  Unrecognized kernels replicate
+    (correct, just not sharded) — parallelism here is a layout hint, never
+    a semantic change."""
     def spec_for(path, leaf):
         name = _path_str(path)
         if getattr(leaf, "ndim", 0) == 2:
             for suffix, build in _RULES:
                 if name.endswith(suffix):
                     return build(axis)
+        if (ep_axis and getattr(leaf, "ndim", 0) == 3
+                and name.endswith(("experts_up", "experts_down"))):
+            return P(ep_axis, None, None)
         return P()
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
-def tp_shard_params(params, mesh, *, axis: str = "tp"):
-    """Place ``params`` on ``mesh`` with the TP layout (device_put)."""
+def tp_shard_params(params, mesh, *, axis: str = "tp",
+                    ep_axis: str | None = None):
+    """Place ``params`` on ``mesh`` with the TP (+EP) layout (device_put)."""
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, tp_param_specs(params, axis=axis))
+        params, tp_param_specs(params, axis=axis, ep_axis=ep_axis))
