@@ -89,31 +89,41 @@ class SwitchMlp(nn.Module):
         hidden = cfg.mlp_ratio * d
         T = B * S
         g = min(getattr(cfg, "router_group_size", 4096), T)
-        while T % g:
-            g -= 1
-        G = T // g
+        # Pad to a whole number of groups (never silently shrink g — tiny
+        # groups disable the capacity guard and gut the balance statistic).
+        G = -(-T // g)
+        pad = G * g - T
+        xt = x.reshape(T, d)
+        if pad:
+            xt = jnp.concatenate(
+                [xt, jnp.zeros((pad, d), xt.dtype)], axis=0)
+        xt = xt.reshape(G, g, d)
         capacity = max(1, int(cfg.expert_capacity_factor * g / E))
-        xt = x.reshape(G, g, d)
         logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                           name="router")(xt.astype(jnp.float32))
         combine, dispatch = jax.vmap(
             lambda lg: switch_dispatch(lg, E, capacity))(logits)
-        # Load balance (per group): E * sum_e (tokens_frac_e * mean_prob_e).
+        # Load balance (Switch eq. 4 per group): E * sum_e f_e p_e with f_e
+        # the fraction of tokens ROUTED to e (pre-capacity argmax — the
+        # clipped dispatch would saturate the gradient exactly when an
+        # expert overflows).
         probs = jax.nn.softmax(logits, axis=-1)             # (G, g, E)
-        frac = dispatch.sum(axis=(2, 3)) / g                # (G, E)
+        routed = jax.nn.one_hot(jnp.argmax(probs, axis=-1), E,
+                                dtype=probs.dtype)
+        frac = routed.mean(axis=1)                          # (G, E)
         aux = (E * (frac * probs.mean(axis=1)).sum(-1)).mean()
         self.sow("intermediates", "moe_aux_loss", aux)
-        up = self.param("experts_up", nn.initializers.lecun_normal(),
-                        (E, d, hidden))
-        down = self.param("experts_down", nn.initializers.lecun_normal(),
-                          (E, hidden, d))
+        # batch_axis keeps fan_in per expert (= d / hidden), not E*d.
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        up = self.param("experts_up", init, (E, d, hidden))
+        down = self.param("experts_down", init, (E, hidden, d))
         xe = jnp.einsum("gect,gtd->gecd", dispatch.astype(cfg.dtype),
                         xt.astype(cfg.dtype))
         ye = nn.gelu(jnp.einsum("gecd,edh->gech", xe,
                                 up.astype(cfg.dtype)))
         ye = jnp.einsum("gech,ehd->gecd", ye, down.astype(cfg.dtype))
         y = jnp.einsum("gtec,gecd->gtd", combine.astype(cfg.dtype), ye)
-        return y.reshape(B, S, d)
+        return y.reshape(G * g, d)[:T].reshape(B, S, d)
 
 
 class Block(nn.Module):

@@ -55,6 +55,9 @@ def build_parser():
     ap.add_argument("--chunked-loss", action="store_true",
                     help="transformer model: chunked lm-head cross-entropy "
                          "(never materializes the S x vocab logits)")
+    ap.add_argument("--num-experts", type=int, default=0,
+                    help="transformer model: switch-MoE blocks with this "
+                         "many experts (0 = dense MLP)")
     return ap
 
 
@@ -90,7 +93,8 @@ def measure(args, devices=None, quiet=False):
         has_bn = False
     else:
         cfg = models.TransformerConfig(max_seq_len=args.seq_len,
-                                       remat=args.remat)
+                                       remat=args.remat,
+                                       num_experts=args.num_experts)
         attn = None
         if args.flash_attention:
             from bluefog_tpu.ops.flash_attention import flash_attention_impl

@@ -222,3 +222,21 @@ def test_switch_moe_expert_parallel_sharding_matches():
     out = jax.jit(model.apply)(p_sh, t_sh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_switch_moe_ragged_group_padding():
+    """T not divisible by router_group_size: tokens pad to whole groups and
+    the output slices back — no silent group-size collapse."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+    cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                            embed_dim=16, max_seq_len=13, dtype=jnp.float32,
+                            num_experts=2, router_group_size=5)
+    model = TransformerLM(cfg)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 32, (3, 13)))
+    params = model.init(jax.random.PRNGKey(0), tokens)  # T=39, g=5 -> pad 1
+    out = model.apply(params, tokens)
+    assert out.shape == (3, 13, 32)
+    assert np.isfinite(np.asarray(out)).all()
