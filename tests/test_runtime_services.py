@@ -238,3 +238,32 @@ def test_local_device_ownership_kwargs():
     # CPU testing mode forges private per-process devices
     env["BFTPU_LOCAL_DEVICES"] = "2"
     assert _local_device_kwargs(env) == {}
+
+
+def test_packaging_metadata():
+    """pyproject parity (reference setup.py console scripts): entry points
+    resolve to the real launcher mains and the version is importable."""
+    tomllib = pytest.importorskip("tomllib")  # stdlib from 3.11
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    scripts = meta["project"]["scripts"]
+    assert scripts["bfrun"] == "bluefog_tpu.run.run:main"
+    assert scripts["ibfrun"] == "bluefog_tpu.run.interactive:main"
+    import importlib
+    for target in scripts.values():
+        mod, fn = target.split(":")
+        assert callable(getattr(importlib.import_module(mod), fn))
+    # every declared package imports (the torch frontend needs the optional
+    # `torch` extra — skip it when absent)
+    for pkg in meta["tool"]["setuptools"]["packages"]:
+        if pkg == "bluefog_tpu.torch":
+            try:
+                import torch  # noqa: F401
+            except ImportError:
+                continue
+        importlib.import_module(pkg)
+    from bluefog_tpu.version import __version__
+    assert meta["tool"]["setuptools"]["dynamic"]["version"]["attr"] == \
+        "bluefog_tpu.version.__version__"
+    assert __version__
