@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable
 
 import numpy as np
 

@@ -21,7 +21,7 @@ nonblocking RMA + finalizer threads.  The local "adapt" math is still jitted
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

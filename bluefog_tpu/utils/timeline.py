@@ -21,7 +21,7 @@ import queue
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict
 
 import jax.profiler
 

@@ -10,7 +10,6 @@ the per-machine mean (``mpi_ops.py:92-104``).
 """
 
 import numpy as np
-import pytest
 
 import bluefog_tpu as bf
 from bluefog_tpu import topology as topo

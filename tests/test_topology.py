@@ -5,9 +5,7 @@ Case inventory mirrors the reference's ``test/torch_basics_test.py:95-126``
 weights and the dynamic schedules.
 """
 
-import math
 
-import networkx as nx
 import numpy as np
 import pytest
 
