@@ -16,3 +16,6 @@ from bluefog_tpu.models.simple import (  # noqa: F401
 from bluefog_tpu.models.transformer import (  # noqa: F401
     TransformerLM, TransformerConfig, local_attention,
 )
+from bluefog_tpu.models.vgg import (  # noqa: F401
+    VGG, VGG11, VGG16, VGG19,
+)

@@ -1,0 +1,64 @@
+"""VGG family (flax) — the reference's second benchmark CNN class.
+
+The reference benchmarks any torchvision model by name, VGG-16 being the
+standard bandwidth-heavy second datapoint next to ResNet-50
+(``examples/pytorch_benchmark.py:57-70``).  TPU-idiomatic choices match the
+ResNet implementation: NHWC layout, bfloat16 compute / float32 params, and
+plain 3x3 convs that XLA tiles straight onto the MXU.  BatchNorm is omitted
+(classic VGG predates it; torchvision's default ``vgg16`` likewise) — each
+conv carries a bias instead.  torchvision's classifier ``Dropout(0.5)``
+layers are ALSO omitted (they would need a dropout rng threaded through
+every benchmark/train call for a regularizer that does not change the
+throughput-parity question); the ``train`` flag is accepted for API
+symmetry with the ResNet family but currently has no effect.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["VGG", "VGG11", "VGG16", "VGG19"]
+
+# Numbers = conv output channels, "M" = 2x2 max pool (torchvision cfgs).
+_CFGS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Any]
+    num_classes: int = 1000
+    hidden: int = 4096
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def VGG11(**kw) -> VGG:
+    return VGG(_CFGS[11], **kw)
+
+
+def VGG16(**kw) -> VGG:
+    return VGG(_CFGS[16], **kw)
+
+
+def VGG19(**kw) -> VGG:
+    return VGG(_CFGS[19], **kw)

@@ -27,7 +27,8 @@ def build_parser():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet18", "resnet34", "resnet50", "resnet101",
-                             "resnet152", "lenet", "transformer"])
+                             "resnet152", "vgg11", "vgg16", "vgg19",
+                             "lenet", "transformer"])
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--num-warmup-batches", type=int, default=10)
     ap.add_argument("--num-iters", type=int, default=10)
@@ -79,13 +80,13 @@ def measure(args, devices=None, quiet=False):
     bf.init(devices=devices, local_size=local_size)
     n = bf.size()
 
-    if args.model.startswith("resnet"):
-        model = getattr(models, args.model.replace("resnet", "ResNet"))(
-            num_classes=1000, dtype=jnp.bfloat16)
+    if args.model.startswith(("resnet", "vgg")):
+        name = args.model.replace("resnet", "ResNet").replace("vgg", "VGG")
+        model = getattr(models, name)(num_classes=1000, dtype=jnp.bfloat16)
         data = jnp.zeros((n, args.batch_size, args.image_size,
                           args.image_size, 3), jnp.bfloat16)
         labels = jnp.zeros((n, args.batch_size), jnp.int32)
-        has_bn = True
+        has_bn = args.model.startswith("resnet")  # classic VGG has no BN
     elif args.model == "lenet":
         model = models.LeNet5()
         data = jnp.zeros((n, args.batch_size, 28, 28, 1))

@@ -240,3 +240,19 @@ def test_switch_moe_ragged_group_padding():
     out = model.apply(params, tokens)
     assert out.shape == (3, 13, 32)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_vgg16_forward_and_grad():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.models import VGG16
+    model = VGG16(num_classes=10, hidden=64, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                    jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (2, 10)
+    g = jax.grad(lambda p: jnp.sum(model.apply(p, x) ** 2))(params)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
