@@ -17,6 +17,10 @@
 // = in-degree of this host, small by construction — Exp2 gives log2 n).
 // Inbound queue is bounded; when full the reader blocks, which backpressures
 // the sender's TCP stream rather than dropping gossip messages.
+// Connections that close (peer restart, stall-probe liveness pings that
+// connect and immediately disconnect) are reaped: the acceptor joins
+// finished readers on each new connection, so dead threads and closed fds
+// never accumulate and shutdown never touches a recycled fd number.
 
 #include "bluefog_native.h"
 
@@ -27,9 +31,11 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <condition_variable>
 #include <cstring>
 #include <deque>
+#include <list>
 #include <map>
 #include <mutex>
 #include <string>
@@ -79,10 +85,16 @@ struct bf_winsvc {
   bool stopping = false;
   std::thread acceptor;
   std::mutex conn_m;
-  std::vector<std::thread> readers;
-  std::vector<int> conn_fds;
+  struct Slot {
+    std::thread t;
+    int fd = -1;
+    bool closed = false;           // guarded by conn_m
+    std::atomic<bool> done{false}; // set last; safe to join once true
+  };
+  std::list<Slot> slots;  // stable addresses; guarded by conn_m
 
-  void Reader(int fd) {
+  void Reader(Slot* slot) {
+    const int fd = slot->fd;
     for (;;) {
       uint32_t magic;
       if (!ReadFull(fd, &magic, 4) || magic != kMagic) break;
@@ -108,7 +120,26 @@ struct bf_winsvc {
       if (stopping) break;
       q.push_back(std::move(in));
     }
-    ::close(fd);
+    {
+      // Close under conn_m so bf_winsvc_stop never calls shutdown() on an
+      // fd number the kernel has already recycled for another socket.
+      std::lock_guard<std::mutex> lk(conn_m);
+      ::close(fd);
+      slot->closed = true;
+    }
+    slot->done.store(true, std::memory_order_release);
+  }
+
+  void Reap() {  // acceptor thread only
+    std::lock_guard<std::mutex> lk(conn_m);
+    for (auto it = slots.begin(); it != slots.end();) {
+      if (it->done.load(std::memory_order_acquire)) {
+        it->t.join();  // already past its conn_m use: join cannot deadlock
+        it = slots.erase(it);
+      } else {
+        ++it;
+      }
+    }
   }
 
   void Accept() {
@@ -117,9 +148,12 @@ struct bf_winsvc {
       if (fd < 0) break;  // listen_fd closed => shutdown
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      Reap();
       std::lock_guard<std::mutex> lk(conn_m);
-      conn_fds.push_back(fd);
-      readers.emplace_back([this, fd] { Reader(fd); });
+      slots.emplace_back();
+      Slot* slot = &slots.back();
+      slot->fd = fd;
+      slot->t = std::thread([this, slot] { Reader(slot); });
     }
   }
 };
@@ -242,12 +276,14 @@ void bf_winsvc_stop(bf_winsvc_t* s) {
   s->cv_space.notify_all();
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
-  s->acceptor.join();
+  s->acceptor.join();  // after this, no new slots can appear
   {
     std::lock_guard<std::mutex> lk(s->conn_m);
-    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);  // unblock recv()
-    for (auto& t : s->readers) t.join();
+    for (auto& sl : s->slots)
+      if (!sl.closed) ::shutdown(sl.fd, SHUT_RDWR);  // unblock recv()
   }
+  // Join without conn_m: exiting readers need it to close their fds.
+  for (auto& sl : s->slots) sl.t.join();
   delete s;
 }
 

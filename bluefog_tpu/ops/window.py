@@ -204,6 +204,8 @@ def _shutdown_transport() -> None:
     d = _store.distrib
     _store.distrib = None
     if d is not None:
+        from bluefog_tpu.utils import stall
+        stall.set_peer_probe(None)
         d.transport.stop()
 
 
@@ -297,7 +299,42 @@ def init_transport() -> bool:
         pending, _store.preinit_msgs = _store.preinit_msgs, []
         for msg in pending:
             _apply_inbound(*msg)
+    # Stall warnings can now name unreachable peers (reference
+    # ``operations.cc:417-429`` lists missing ranks per stalled tensor).
+    from bluefog_tpu.utils import stall
+    stall.set_peer_probe(_probe_missing_ranks)
     return True
+
+
+def _probe_missing_ranks(timeout: float = 1.0) -> List[int]:
+    """Ranks whose owning process's transport endpoint does not accept a TCP
+    connection — the liveness source for stall warnings.  Peers are probed
+    concurrently so a sweep costs max(timeout), not sum over dead hosts."""
+    import socket
+    d = _store.distrib
+    if d is None:
+        return []
+
+    def reachable(addr) -> bool:
+        try:
+            socket.create_connection(addr, timeout=timeout).close()
+            return True
+        except OSError:
+            return False
+
+    peers = [(p, addr) for p, addr in sorted(d.proc_addr.items())
+             if p != d.my_proc]
+    if not peers:
+        return []
+    with ThreadPoolExecutor(max_workers=min(16, len(peers)),
+                            thread_name_prefix="bf-stall-probe") as pool:
+        alive = list(pool.map(lambda pa: reachable(pa[1]), peers))
+    missing: List[int] = []
+    for (p, _), ok in zip(peers, alive):
+        if not ok:
+            missing.extend(r for r, owner in d.rank_owner.items()
+                           if owner == p)
+    return sorted(missing)
 
 
 def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,

@@ -10,6 +10,14 @@ warning with the op name once the threshold passes — same observability
 contract, adapted to the architecture.
 
 Threshold: ``BLUEFOG_TPU_STALL_WARNING_SEC`` (0 disables; default 60).
+
+The reference's warning *names the missing ranks* (it lists which ranks never
+submitted the stalled tensor, ``operations.cc:417-429``).  SPMD has no
+per-tensor submission table, but multi-process runs have a rank directory
+(the DCN window transport's ``proc_addr``): a registered *peer probe* checks
+which peers' transports are reachable when a wait stalls, so the warning can
+say "unreachable peer ranks: [...]" — the same diagnostic, derived from
+liveness instead of submission bookkeeping.
 """
 
 from __future__ import annotations
@@ -17,11 +25,23 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
+from typing import Callable, List, Optional
 
 from bluefog_tpu.utils import config
 from bluefog_tpu.utils.logging import get_logger
 
-__all__ = ["watch", "StallMonitor"]
+__all__ = ["watch", "StallMonitor", "set_peer_probe"]
+
+# Installed by ops.window.init_transport(); returns the sorted list of ranks
+# whose owning process is unreachable (empty list = all peers answered).
+_peer_probe: Optional[Callable[[], List[int]]] = None
+
+
+def set_peer_probe(probe: Optional[Callable[[], List[int]]]) -> None:
+    """Register (or clear, with ``None``) the liveness probe used to name
+    missing peers in stall warnings."""
+    global _peer_probe
+    _peer_probe = probe
 
 
 class StallMonitor:
@@ -52,17 +72,36 @@ class StallMonitor:
             now = time.monotonic()
             with self._lock:
                 items = list(self._outstanding.items())
+            peers = None  # probed at most once per sweep (it does real I/O)
             for key, (name, start, warned) in items:
                 overdue = now - start
                 if overdue > threshold * (warned + 1):
+                    if peers is None:
+                        peers = self._probe_peers()
                     get_logger().warning(
                         "One or more operations appear stalled: %r has been "
                         "waiting %.0f s (threshold %.0f s). A missing peer "
-                        "process or a hung collective is the usual cause.",
-                        name, overdue, threshold)
+                        "process or a hung collective is the usual cause.%s",
+                        name, overdue, threshold, peers)
                     with self._lock:
                         if key in self._outstanding:
                             self._outstanding[key] = (name, start, warned + 1)
+
+    @staticmethod
+    def _probe_peers() -> str:
+        """Render the missing-rank suffix for a stall warning (reference
+        format: ``Missing ranks: 0, 2`` per stalled tensor)."""
+        probe = _peer_probe
+        if probe is None:
+            return ""
+        try:
+            missing = probe()
+        except Exception:  # probe failure must never kill the monitor
+            return ""
+        if missing:
+            return (" Unreachable peer ranks: "
+                    + ", ".join(str(r) for r in missing) + ".")
+        return " All peer transports are reachable (hung device op?)."
 
     def begin(self, name: str) -> int:
         if config.get().stall_warning_sec <= 0:

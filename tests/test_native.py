@@ -131,6 +131,37 @@ def test_window_transport_loopback():
         server.stop()
 
 
+def test_peer_probe_names_dead_ranks():
+    """_probe_missing_ranks reports ranks owned by a process whose transport
+    endpoint is gone, and nothing for live peers."""
+    import socket
+
+    from bluefog_tpu.ops import window
+    from bluefog_tpu.ops.transport import WindowTransport
+
+    live = WindowTransport(lambda *a: None)
+    # Bound but never listen()ing: connects are refused, and holding the
+    # socket open keeps the port from being rebound by a concurrent process.
+    dead_sock = socket.socket()
+    dead_sock.bind(("127.0.0.1", 0))
+    dead_port = dead_sock.getsockname()[1]
+    distrib = window._Distrib(
+        live,
+        rank_owner={0: 0, 1: 1, 2: 2, 3: 2},
+        proc_addr={0: ("127.0.0.1", 1),  # self: never probed
+                   1: ("127.0.0.1", live.port),
+                   2: ("127.0.0.1", dead_port)},
+        my_proc=0)
+    saved = window._store.distrib
+    window._store.distrib = distrib
+    try:
+        assert window._probe_missing_ranks(timeout=2.0) == [2, 3]
+    finally:
+        window._store.distrib = saved
+        dead_sock.close()
+        live.stop()
+
+
 def test_window_transport_large_payload():
     """Payload bigger than the initial drain buffer (forces regrow)."""
     from bluefog_tpu.ops.transport import OP_PUT, WindowTransport

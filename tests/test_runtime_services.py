@@ -54,6 +54,29 @@ def test_stall_monitor_warns(monkeypatch, caplog):
         config.reload()
 
 
+def test_stall_warning_names_missing_ranks(monkeypatch, caplog):
+    """With a peer probe installed, the warning lists unreachable ranks
+    (reference: CheckForStalledTensors prints missing-rank lists,
+    operations.cc:417-429)."""
+    monkeypatch.setenv("BLUEFOG_TPU_STALL_WARNING_SEC", "0.3")
+    config.reload()
+    log = get_logger()
+    log.addHandler(caplog.handler)
+    stall.set_peer_probe(lambda: [2, 3])
+    try:
+        with caplog.at_level(logging.WARNING, logger="bluefog_tpu"):
+            with stall.watch("probe-op"):
+                time.sleep(1.2)
+        assert any("probe-op" in r.message
+                   and "Unreachable peer ranks: 2, 3" in r.message
+                   for r in caplog.records)
+    finally:
+        stall.set_peer_probe(None)
+        log.removeHandler(caplog.handler)
+        monkeypatch.delenv("BLUEFOG_TPU_STALL_WARNING_SEC")
+        config.reload()
+
+
 def test_stall_monitor_quiet_when_fast(monkeypatch, caplog):
     monkeypatch.setenv("BLUEFOG_TPU_STALL_WARNING_SEC", "5")
     config.reload()
