@@ -106,6 +106,7 @@ from bluefog_tpu.ops.window import (  # noqa: F401
     turn_off_win_ops_with_associated_p,
 )
 
+from bluefog_tpu import data  # noqa: F401  (DistributedSampler, ShardedLoader)
 from bluefog_tpu import optim  # noqa: F401  (Distributed*Optimizer family)
 
 from bluefog_tpu.utils.timeline import (  # noqa: F401

@@ -83,15 +83,18 @@ def main():
         logits = jax.vmap(model.apply)(params, x)
         return (logits.argmax(-1) == y).mean()
 
-    steps_per_epoch = args.per_rank_samples // args.batch_size
-    rng = np.random.RandomState(1)
+    # Framework input pipeline: rank-partitioned sampling + host-async
+    # device prefetch (the reference's DistributedSampler+DataLoader role,
+    # ``examples/pytorch_mnist.py:100-120``).  static_shards keeps each
+    # rank's data fixed across epochs — the heterogeneous decentralized-DP
+    # setting this example demonstrates (shuffling happens within shards).
+    loader = bf.data.ShardedLoader(
+        {"x": xs.reshape(-1, 28, 28, 1), "y": ys.reshape(-1)},
+        batch_size=args.batch_size, seed=1, static_shards=True)
     for epoch in range(args.epochs):
-        perm = rng.permutation(args.per_rank_samples)
-        for s in range(steps_per_epoch):
-            idx = perm[s * args.batch_size:(s + 1) * args.batch_size]
-            bx = jnp.asarray(xs[:, idx])
-            by = jnp.asarray(ys[:, idx])
-            grads = grad_all(params, bx, by)
+        loader.set_epoch(epoch)
+        for batch in loader:
+            grads = grad_all(params, batch["x"], batch["y"])
             params, state = opt.step(params, grads, state)
         acc = float(accuracy(params, jnp.asarray(xt), jnp.asarray(yt)))
         print(f"epoch {epoch}  held-out accuracy {acc:.4f}")
