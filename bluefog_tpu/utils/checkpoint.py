@@ -23,8 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["save", "restore", "latest_step", "broadcast_to_ranks",
-           "consensus_average"]
+__all__ = ["save", "restore", "latest_step", "list_steps",
+           "broadcast_to_ranks", "consensus_average"]
 
 
 def _checkpointer():
@@ -84,10 +84,15 @@ def restore(path: str, *, step: Optional[int] = None,
                               jax.tree.leaves(restored))
 
 
+def list_steps(path: str) -> list:
+    """Sorted step numbers of the ``step_*`` checkpoints under ``path``."""
+    if not os.path.isdir(path):
+        return []
+    return sorted(int(d.split("_")[1]) for d in os.listdir(path)
+                  if d.startswith("step_") and d.split("_")[1].isdigit())
+
+
 def latest_step(path: str) -> Optional[int]:
     """Newest ``step_*`` subdirectory under ``path``, or None."""
-    if not os.path.isdir(path):
-        return None
-    steps = [int(d.split("_")[1]) for d in os.listdir(path)
-             if d.startswith("step_") and d.split("_")[1].isdigit()]
-    return max(steps) if steps else None
+    steps = list_steps(path)
+    return steps[-1] if steps else None

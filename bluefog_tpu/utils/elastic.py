@@ -1,0 +1,155 @@
+"""Elastic training: a preemption-tolerant, restartable run loop.
+
+The reference *claims* fault tolerance as a goal (``README.rst:19``) but
+implements none (SURVEY §5.3): a dead rank triggers a coordinator-driven
+shutdown (``operations.cc:883-910``) and the job is simply gone.  Here the
+run loop itself is restartable:
+
+  * periodic checkpoints every ``save_every`` steps through
+    ``utils.checkpoint`` (pruned to the newest ``keep``),
+  * a SIGTERM handler (the cloud-preemption notice) that finishes the
+    in-flight step, saves, and raises :class:`Preempted`,
+  * on (re)start, the newest checkpoint is restored into the caller's state
+    structure and the loop continues from that step — a crash between
+    checkpoints replays at most ``save_every - 1`` steps and, with a
+    deterministic ``step_fn``, reproduces the uninterrupted run bit-exactly.
+
+Multi-process runs pass ``per_process=True``: each process writes its own
+directory (its addressable shards), and on restart the resume step is agreed
+as the newest step *every* process has durably saved (set intersection, not
+``min(latest)`` — pruning or save skew may have deleted a slow process's
+frontier elsewhere), so a crash that interleaves with a save cannot resume
+ranks from different steps or name a step someone is missing.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+
+from bluefog_tpu.utils import checkpoint
+from bluefog_tpu.utils.logging import get_logger
+
+__all__ = ["run_elastic", "Preempted"]
+
+
+class Preempted(RuntimeError):
+    """Raised after a SIGTERM-triggered save; ``.step`` is the saved step."""
+
+    def __init__(self, step: int):
+        super().__init__(f"preempted; checkpoint saved at step {step}")
+        self.step = step
+
+
+def _prune(ckpt_dir: str, keep: int) -> None:
+    if keep <= 0:
+        return
+    for s in checkpoint.list_steps(ckpt_dir)[:-keep]:
+        shutil.rmtree(os.path.join(ckpt_dir, f"step_{s:010d}"),
+                      ignore_errors=True)
+
+
+# How many of each process's newest checkpoints enter the resume agreement.
+_AGREE_WINDOW = 16
+
+
+def _max_common_step(per_process_steps) -> int:
+    """Newest step every process has durably saved, or 0 for a fresh start.
+
+    Resuming from ``min(latest)`` would break whenever pruning (or save
+    skew) removed that step on a faster process; intersecting the available
+    sets cannot name a step anyone is missing."""
+    common = None
+    for steps in per_process_steps:
+        s = set(int(x) for x in steps if x > 0)
+        common = s if common is None else (common & s)
+    return max(common) if common else 0
+
+
+def _agreed_start(ckpt_dir: str, per_process: bool) -> int:
+    mine = checkpoint.list_steps(ckpt_dir)
+    if not per_process or jax.process_count() == 1:
+        return mine[-1] if mine else 0
+    import numpy as np
+    from jax.experimental import multihost_utils
+    padded = np.zeros((_AGREE_WINDOW,), np.int64)
+    tail = mine[-_AGREE_WINDOW:]
+    padded[:len(tail)] = tail
+    return _max_common_step(
+        np.asarray(multihost_utils.process_allgather(padded)))
+
+
+def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
+                ckpt_dir: str, num_steps: int, save_every: int = 100,
+                keep: int = 3, per_process: bool = False,
+                on_step: Optional[Callable[[Any, int], None]] = None) -> Any:
+    """Run ``state = step_fn(state, step)`` for ``num_steps`` steps with
+    automatic checkpoint/resume.  Returns the final state.
+
+    ``state`` is any pytree of (device or host) arrays; its structure is the
+    restore target, so NamedTuples/optax states round-trip intact.
+    ``step_fn`` must be deterministic in ``(state, step)`` for bit-exact
+    resume (fold the step into your PRNG key; data order via
+    ``data.DistributedSampler.set_epoch`` is already step-derivable).
+    ``on_step`` runs after every step (logging, eval); it is not
+    exactly-once — after a crash, replayed steps invoke it again.
+    """
+    if jax.process_count() > 1:
+        if not per_process:
+            raise ValueError(
+                "run_elastic in a multi-process run requires "
+                "per_process=True: each process must write its own "
+                "checkpoint directory (concurrent writes to one orbax path "
+                "race), and resume must be agreed across processes")
+        ckpt_dir = os.path.join(ckpt_dir, f"proc{jax.process_index()}")
+    start = _agreed_start(ckpt_dir, per_process)
+    if start:
+        state = checkpoint.restore(ckpt_dir, step=start, target=state)
+        get_logger().info("elastic: resumed from step %d (%s)", start,
+                          ckpt_dir)
+    if start >= num_steps:
+        return state
+
+    preempt = threading.Event()
+    prev_handler = None
+    installed = False
+    try:  # signals only work on the main thread; degrade gracefully off it
+        prev_handler = signal.signal(
+            signal.SIGTERM, lambda signum, frame: preempt.set())
+        installed = True
+    except ValueError:
+        pass
+
+    def save(tree, step: int) -> None:
+        jax.block_until_ready(tree)
+        checkpoint.save(ckpt_dir, tree, step=step)
+        _prune(ckpt_dir, keep)
+
+    try:
+        for step in range(start, num_steps):
+            state = step_fn(state, step)
+            if on_step is not None:
+                on_step(state, step)
+            done = step + 1
+            if preempt.is_set() and done < num_steps:
+                # (a preemption during the FINAL step falls through to the
+                # normal completion save/return — the work is already done)
+                save(state, done)
+                raise Preempted(done)
+            if save_every and done % save_every == 0 and done < num_steps:
+                save(state, done)
+        save(state, num_steps)
+        return state
+    finally:
+        if installed:
+            # prev_handler is None when the prior handler was installed
+            # outside Python — unrepresentable, so fall back to the default
+            # disposition rather than leaving our stale lambda in place.
+            signal.signal(signal.SIGTERM,
+                          prev_handler if prev_handler is not None
+                          else signal.SIG_DFL)

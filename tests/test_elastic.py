@@ -1,0 +1,202 @@
+"""Elastic run-loop tests: crash/resume bit-exactness, SIGTERM preemption,
+checkpoint pruning, multi-process agreed resume (SURVEY §5.3 — the
+reference claims fault tolerance but implements only shutdown)."""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import bluefog_tpu as bf
+from bluefog_tpu.utils import checkpoint
+from bluefog_tpu.utils.elastic import Preempted, run_elastic
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    if not bf.initialized():
+        bf.init()
+    yield
+
+
+def _make_step():
+    """Deterministic decentralized step: neighbor-average + step-keyed
+    noise (a real collective, so resume exactness covers the comm path)."""
+    n = bf.size()
+    bf.set_topology(bf.topology_util.RingGraph(n))
+
+    def step_fn(state, step):
+        x = bf.neighbor_allreduce(state["x"])
+        key = jax.random.PRNGKey(step)
+        return {"x": x + 0.01 * jax.random.normal(key, x.shape),
+                "count": state["count"] + 1}
+
+    x0 = np.random.RandomState(0).randn(n, 4).astype(np.float32)
+    return step_fn, {"x": jnp.asarray(x0),
+                     "count": jnp.zeros((), jnp.int32)}
+
+
+def test_uninterrupted_vs_crash_resume_bit_exact(tmp_path):
+    step_fn, state0 = _make_step()
+    straight = state0
+    for s in range(10):
+        straight = step_fn(straight, s)
+
+    # crash: run 6 steps with saves every 4, "die" (no final save) ...
+    crash_dir = str(tmp_path / "ck")
+    partial = state0
+    for s in range(6):
+        partial = step_fn(partial, s)
+        if (s + 1) % 4 == 0:
+            checkpoint.save(crash_dir, partial, step=s + 1)
+    # ... then run_elastic resumes from step 4 and replays to 10
+    resumed = run_elastic(step_fn, state0, ckpt_dir=crash_dir, num_steps=10,
+                          save_every=4)
+    np.testing.assert_array_equal(np.asarray(straight["x"]),
+                                  np.asarray(resumed["x"]))
+    assert int(resumed["count"]) == 10
+    assert checkpoint.latest_step(crash_dir) == 10
+
+
+def test_fresh_run_saves_and_final_state(tmp_path):
+    step_fn, state0 = _make_step()
+    out = run_elastic(step_fn, state0, ckpt_dir=str(tmp_path / "a"),
+                      num_steps=5, save_every=2)
+    assert int(out["count"]) == 5
+    assert checkpoint.latest_step(str(tmp_path / "a")) == 5
+
+
+def test_pruning_keeps_newest(tmp_path):
+    step_fn, state0 = _make_step()
+    d = str(tmp_path / "p")
+    run_elastic(step_fn, state0, ckpt_dir=d, num_steps=10, save_every=1,
+                keep=3)
+    steps = sorted(int(x.split("_")[1]) for x in os.listdir(d)
+                   if x.startswith("step_"))
+    assert steps == [8, 9, 10]
+
+
+def test_resume_past_end_returns_restored(tmp_path):
+    step_fn, state0 = _make_step()
+    d = str(tmp_path / "done")
+    final = run_elastic(step_fn, state0, ckpt_dir=d, num_steps=3,
+                        save_every=10)
+    again = run_elastic(step_fn, state0, ckpt_dir=d, num_steps=3)
+    np.testing.assert_array_equal(np.asarray(final["x"]),
+                                  np.asarray(again["x"]))
+
+
+def test_sigterm_saves_and_raises_preempted(tmp_path):
+    step_fn, state0 = _make_step()
+    d = str(tmp_path / "pre")
+
+    def poke(_state, step):
+        if step == 2:  # preemption notice arrives mid-run
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with pytest.raises(Preempted) as ei:
+        run_elastic(step_fn, state0, ckpt_dir=d, num_steps=100,
+                    save_every=50, on_step=poke)
+    assert ei.value.step == 3
+    assert checkpoint.latest_step(d) == 3
+    # default SIGTERM disposition restored
+    assert signal.getsignal(signal.SIGTERM) in (signal.SIG_DFL,
+                                                signal.Handlers.SIG_DFL)
+    # resume completes the run from the preemption point
+    out = run_elastic(step_fn, state0, ckpt_dir=d, num_steps=5, save_every=50)
+    assert int(out["count"]) == 5
+
+
+def test_sigterm_during_final_step_completes_normally(tmp_path):
+    """A preemption notice landing on the last step must not turn a finished
+    run into a Preempted restart."""
+    step_fn, state0 = _make_step()
+    d = str(tmp_path / "fin")
+
+    def poke(_state, step):
+        if step == 4:  # the final step (num_steps=5)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    out = run_elastic(step_fn, state0, ckpt_dir=d, num_steps=5,
+                      save_every=50, on_step=poke)
+    assert int(out["count"]) == 5
+    assert checkpoint.latest_step(d) == 5
+
+
+def test_max_common_step_survives_pruned_frontiers():
+    """Agreement is the newest COMMON step: min(latest) would name step 3,
+    which the fast process already pruned."""
+    from bluefog_tpu.utils.elastic import _max_common_step
+    fast = [9, 12, 15]      # pruned everything below 9
+    slow = [3, 6, 9]        # died mid-save of 12
+    assert _max_common_step([fast, slow]) == 9
+    assert _max_common_step([[0, 0, 0], [3]]) == 0     # fresh process
+    assert _max_common_step([[5], [7]]) == 0           # nothing in common
+
+
+def test_multiprocess_requires_per_process(monkeypatch, tmp_path):
+    step_fn, state0 = _make_step()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="per_process=True"):
+        run_elastic(step_fn, state0, ckpt_dir=str(tmp_path / "x"),
+                    num_steps=1)
+
+
+_MULTIPROC_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, "@REPO@")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import bluefog_tpu as bf
+from bluefog_tpu.utils.elastic import run_elastic
+
+bf.init_distributed()
+n = bf.size()
+
+def step_fn(state, step):
+    return {"x": state["x"] * 1.5 + step}
+
+state0 = {"x": jnp.ones((4,), jnp.float32)}
+crash_at = int(os.environ.get("CRASH_AT", "0"))
+
+def poke(_s, step):
+    if crash_at and step + 1 == crash_at:
+        os._exit(17)  # hard crash: no final save
+
+out = run_elastic(step_fn, state0, ckpt_dir=os.environ["CKDIR"],
+                  num_steps=8, save_every=3, per_process=True, on_step=poke)
+expect = jnp.ones((4,), jnp.float32)
+for s in range(8):
+    expect = expect * 1.5 + s
+np.testing.assert_allclose(np.asarray(out["x"]), np.asarray(expect))
+print("ELASTIC-OK", jax.process_index())
+"""
+
+
+@pytest.mark.slow
+def test_multiprocess_crash_and_resume(tmp_path):
+    """Two processes crash hard at step 5 (after the step-3 saves), restart,
+    agree on the resume step, and finish with the exact uninterrupted
+    result."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "elastic_mp.py"
+    script.write_text(_MULTIPROC_SCRIPT.replace("@REPO@", repo))
+    env = dict(os.environ, CKDIR=str(tmp_path / "ck"))
+
+    run = [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",
+           "--devices-per-proc", "2", sys.executable, str(script)]
+    first = subprocess.run(run, capture_output=True, text=True, timeout=600,
+                           cwd=repo, env=dict(env, CRASH_AT="5"))
+    assert "ELASTIC-OK" not in first.stdout  # both died before finishing
+    second = subprocess.run(run, capture_output=True, text=True, timeout=600,
+                            cwd=repo, env=env)
+    assert second.returncode == 0, (
+        f"stdout={second.stdout}\nstderr={second.stderr}")
+    assert second.stdout.count("ELASTIC-OK") == 2, second.stdout
