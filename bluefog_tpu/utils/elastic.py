@@ -71,6 +71,20 @@ def _max_common_step(per_process_steps) -> int:
     return max(common) if common else 0
 
 
+def _discard_steps_above(ckpt_dir: str, start: int) -> None:
+    """Drop local checkpoints newer than the agreed resume step.
+
+    A process restarting below its own frontier (e.g. a veteran paired with
+    a replacement whose directory is empty) must not keep the stale newer
+    dirs: ``_prune`` would treat them as the newest and delete every new
+    save, and they would keep poisoning the next agreement — the run would
+    never checkpoint durably again."""
+    for s in checkpoint.list_steps(ckpt_dir):
+        if s > start:
+            shutil.rmtree(os.path.join(ckpt_dir, f"step_{s:010d}"),
+                          ignore_errors=True)
+
+
 def _agreed_start(ckpt_dir: str, per_process: bool) -> int:
     mine = checkpoint.list_steps(ckpt_dir)
     if not per_process or jax.process_count() == 1:
@@ -108,6 +122,7 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
                 "race), and resume must be agreed across processes")
         ckpt_dir = os.path.join(ckpt_dir, f"proc{jax.process_index()}")
     start = _agreed_start(ckpt_dir, per_process)
+    _discard_steps_above(ckpt_dir, start)
     if start:
         state = checkpoint.restore(ckpt_dir, step=start, target=state)
         get_logger().info("elastic: resumed from step %d (%s)", start,

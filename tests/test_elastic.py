@@ -139,6 +139,23 @@ def test_max_common_step_survives_pruned_frontiers():
     assert _max_common_step([[5], [7]]) == 0           # nothing in common
 
 
+def test_restart_below_frontier_discards_stale_checkpoints(monkeypatch,
+                                                           tmp_path):
+    """A veteran forced to restart at step 0 (replacement peer had nothing
+    in common) must drop its stale newer dirs, or pruning would delete
+    every new save and the job would never checkpoint durably again."""
+    from bluefog_tpu.utils import elastic
+    step_fn, state0 = _make_step()
+    d = str(tmp_path / "vet")
+    for s in (98, 99, 100):  # veteran frontier from a previous life
+        checkpoint.save(d, state0, step=s)
+    monkeypatch.setattr(elastic, "_agreed_start", lambda *a: 0)
+    out = run_elastic(step_fn, state0, ckpt_dir=d, num_steps=5,
+                      save_every=2, keep=2)
+    assert int(out["count"]) == 5
+    assert checkpoint.list_steps(d) == [4, 5]  # stale 98-100 gone, run saved
+
+
 def test_multiprocess_requires_per_process(monkeypatch, tmp_path):
     step_fn, state0 = _make_step()
     monkeypatch.setattr(jax, "process_count", lambda: 2)

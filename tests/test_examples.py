@@ -47,6 +47,21 @@ def test_mnist_lenet_short():
                  "--batch-size", "64"])
 
 
+def test_elastic_training_preempt_then_resume(tmp_path, capsys):
+    """The elastic example self-preempts mid-run, then a second invocation
+    resumes from the checkpoint and finishes."""
+    d = str(tmp_path / "ck")
+    with pytest.raises(SystemExit) as ei:
+        run_example(f"{EXAMPLES}/elastic_training.py",
+                    ["--ckpt-dir", d, "--steps", "20", "--save-every", "5",
+                     "--preempt-at-step", "12"])
+    assert ei.value.code == 75
+    assert "preempted; checkpoint saved at step 12" in capsys.readouterr().out
+    run_example(f"{EXAMPLES}/elastic_training.py",
+                ["--ckpt-dir", d, "--steps", "20", "--save-every", "5"])
+    assert "done: 20 steps" in capsys.readouterr().out
+
+
 def test_benchmark_harness_tiny():
     run_example(f"{EXAMPLES}/benchmark.py",
                 ["--model", "lenet", "--batch-size", "4",
