@@ -113,6 +113,37 @@ def test_neighbor_beats_local():
     assert global_mse(p_nbr["w"], A, y) < global_mse(p_loc["w"], A, y)
 
 
+@pytest.mark.parametrize("order", ["awc", "atc"])
+@pytest.mark.parametrize("dynamic", [False, True])
+def test_fusion_matches_unfused(order, dynamic):
+    """Fused single-buffer communication must be numerically identical to
+    per-parameter communication (reference fusion oracle tests,
+    ``torch_ops_test.py:210-284,962``) — over a multi-leaf pytree so the
+    ravel actually concatenates."""
+    bf.init(lambda: topo.ExponentialTwoGraph(N))
+    rng = np.random.RandomState(3)
+    params0 = {"a": jnp.asarray(rng.randn(N, DIM, 1)),
+               "b": jnp.asarray(rng.randn(N, 3)),
+               "c": jnp.asarray(rng.randn(N, 2, 2))}
+    grads = {k: jnp.asarray(rng.randn(*np.asarray(v).shape))
+             for k, v in params0.items()}
+
+    outs = {}
+    for fusion in (True, False):
+        opt = bf.optim.DistributedOptimizer(
+            optax.sgd(0.05, momentum=0.9),
+            CommunicationType.neighbor_allreduce, order=order,
+            use_dynamic_topology=dynamic, fusion=fusion)
+        p, s = params0, opt.init(params0)
+        for _ in range(3):
+            p, s = opt.step(p, grads, s)
+        outs[fusion] = p
+    for k in params0:
+        np.testing.assert_allclose(np.asarray(outs[True][k]),
+                                   np.asarray(outs[False][k]),
+                                   rtol=1e-6, atol=1e-7)
+
+
 def test_dynamic_topology_optimizer():
     bf.init(lambda: topo.ExponentialGraph(N))
     A, y, _ = make_problem()
