@@ -26,10 +26,11 @@ from __future__ import annotations
 import argparse
 import os
 import shlex
-import signal
 import socket
 import subprocess
 import sys
+import time
+import uuid
 
 __all__ = ["main", "build_parser", "parse_hosts", "virtual_mesh_env"]
 
@@ -108,6 +109,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coordinator-port", type=int, default=None)
     p.add_argument("--devices-per-proc", type=int, default=None,
                    help="virtual CPU devices per process (testing)")
+    p.add_argument("--restarts", type=int, default=0,
+                   help="gang-restart budget: when any process exits "
+                        "nonzero, kill the rest and relaunch ALL processes "
+                        "(pair with utils.elastic.run_elastic in the "
+                        "program so the job resumes from its newest "
+                        "checkpoint)")
     p.add_argument("--timeline", default=None,
                    help="timeline file prefix (sets BLUEFOG_TIMELINE)")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -142,7 +149,6 @@ def main(argv=None) -> int:
         print("bfrun: -np must be >= 1", file=sys.stderr)
         return 2
 
-    port = args.coordinator_port or _free_port()
     if args.hosts:
         try:
             placement = parse_hosts(args.hosts, args.num_proc)
@@ -151,36 +157,111 @@ def main(argv=None) -> int:
             return 2
     else:
         placement = [("127.0.0.1", i) for i in range(args.num_proc)]
-    coord = f"{placement[0][0]}:{port}"
 
     host_slots = {}
     for host, _ in placement:
         host_slots[host] = host_slots.get(host, 0) + 1
 
-    procs = []
-    try:
-        for rank, (host, local_rank) in enumerate(placement):
-            env = _child_env(args, coord, rank, local_rank, host_slots[host])
-            if host in ("127.0.0.1", "localhost", socket.gethostname()):
-                procs.append(subprocess.Popen(cmd, env=env))
-            else:
-                exports = " ".join(
-                    f"{k}={shlex.quote(v)}" for k, v in env.items()
-                    if k.startswith(("BFTPU_", "XLA_", "JAX_", "BLUEFOG")))
-                remote = f"cd {shlex.quote(os.getcwd())} && {exports} " \
-                         + " ".join(shlex.quote(c) for c in cmd)
-                procs.append(subprocess.Popen(
-                    ["ssh", "-p", str(args.ssh_port), host, remote]))
-        rc = 0
-        for p in procs:
-            rc = p.wait() or rc
-        return rc
-    except KeyboardInterrupt:
-        for p in procs:
-            p.send_signal(signal.SIGINT)
-        for p in procs:
-            p.wait()
-        return 130
+    attempt = 0
+    while True:
+        # Fresh coordinator port per incarnation (unless pinned): the old
+        # coordinator died with rank 0 and its port may sit in TIME_WAIT.
+        port = args.coordinator_port or _free_port()
+        coord = f"{placement[0][0]}:{port}"
+        # Unique per-incarnation tag: exported into every child env, so it
+        # appears on remote command lines and `pkill -f <tag>` can reach
+        # ranks whose local ssh client we can only disconnect, not signal.
+        tag = f"bfrun-gang-{uuid.uuid4().hex[:12]}"
+        entries = []  # (Popen, host, is_remote)
+        try:
+            for rank, (host, local_rank) in enumerate(placement):
+                env = _child_env(args, coord, rank, local_rank,
+                                 host_slots[host])
+                env["BFTPU_GANG_TAG"] = tag
+                if host in ("127.0.0.1", "localhost", socket.gethostname()):
+                    entries.append((subprocess.Popen(cmd, env=env), host,
+                                    False))
+                else:
+                    exports = " ".join(
+                        f"{k}={shlex.quote(v)}" for k, v in env.items()
+                        if k.startswith(("BFTPU_", "XLA_", "JAX_",
+                                         "BLUEFOG")))
+                    remote = f"cd {shlex.quote(os.getcwd())} && {exports} " \
+                             + " ".join(shlex.quote(c) for c in cmd)
+                    entries.append((subprocess.Popen(
+                        ["ssh", "-p", str(args.ssh_port), host, remote]),
+                        host, True))
+            rc = _wait_gang(entries, args.ssh_port, tag)
+        except KeyboardInterrupt:
+            print("bfrun: interrupted; stopping the gang", file=sys.stderr)
+            _kill_gang(entries, args.ssh_port, tag)
+            return 130
+        if rc == 0 or attempt >= args.restarts:
+            return rc
+        attempt += 1
+        # Backoff so a deterministically-failing command (bad flag, missing
+        # module, pinned port in TIME_WAIT) cannot burn the budget in a
+        # tight loop.
+        delay = min(10.0, 2.0 ** (attempt - 1))
+        print(f"bfrun: process failed (exit {rc}); restarting the gang "
+              f"in {delay:.0f}s (attempt {attempt}/{args.restarts})",
+              file=sys.stderr)
+        time.sleep(delay)
+
+
+def _remote_signal(host: str, ssh_port: int, tag: str, sig: str) -> None:
+    """Signal every remote process carrying this gang tag (killing the
+    local ssh client only drops the connection; without a TTY the remote
+    command keeps running)."""
+    subprocess.run(
+        ["ssh", "-p", str(ssh_port), host,
+         f"pkill -{sig} -f {shlex.quote(tag)} || true"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, timeout=30,
+        check=False)
+
+
+def _kill_gang(entries, ssh_port: int, tag: str,
+               kill_grace: float = 10.0) -> None:
+    """TERM the whole gang (local + remote), escalate to KILL after
+    ``kill_grace`` — a peer blocked in a collective against a dead rank
+    with ``run_elastic``'s SIGTERM handler installed can never reach a step
+    boundary to honor TERM."""
+    remote_hosts = sorted({h for _, h, r in entries if r})
+    for p, _, _ in entries:
+        if p.poll() is None:
+            p.terminate()
+    for h in remote_hosts:
+        _remote_signal(h, ssh_port, tag, "TERM")
+    deadline = time.monotonic() + kill_grace
+    pending = [p for p, _, _ in entries]
+    for p in pending:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for h in remote_hosts:
+        _remote_signal(h, ssh_port, tag, "KILL")
+    for p in pending:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+    return
+
+
+def _wait_gang(entries, ssh_port: int, tag: str) -> int:
+    """Wait for all processes; any nonzero exit kills the survivors."""
+    procs = [p for p, _, _ in entries]
+    while True:
+        rcs = [p.poll() for p in procs]
+        bad = next((r for r in rcs if r not in (None, 0)), None)
+        if bad is None:
+            if all(r is not None for r in rcs):
+                return 0
+            time.sleep(0.2)
+            continue
+        _kill_gang(entries, ssh_port, tag)
+        return bad
 
 
 if __name__ == "__main__":

@@ -197,6 +197,64 @@ print("ELASTIC-OK", jax.process_index())
 """
 
 
+_GANG_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, "@REPO@")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import bluefog_tpu as bf
+from bluefog_tpu.utils.elastic import run_elastic
+
+bf.init_distributed()
+
+def step_fn(state, step):
+    return {"x": state["x"] * 2.0 + step}
+
+marker = os.environ["MARKER"]
+
+def poke(_s, step):
+    # First incarnation only: rank 0 dies hard at step 5 (after the step-3
+    # saves) while rank 1 keeps running — bfrun must reap the gang.
+    if step + 1 == 5 and jax.process_index() == 0 \
+            and not os.path.exists(marker):
+        open(marker, "w").close()
+        os._exit(1)
+
+out = run_elastic(step_fn, {"x": jnp.ones((2,), jnp.float32)},
+                  ckpt_dir=os.environ["CKDIR"], num_steps=9, save_every=3,
+                  per_process=True, on_step=poke)
+expect = jnp.ones((2,), jnp.float32)
+for s in range(9):
+    expect = expect * 2.0 + s
+np.testing.assert_allclose(np.asarray(out["x"]), np.asarray(expect))
+print("GANG-OK", jax.process_index())
+"""
+
+
+@pytest.mark.slow
+def test_bfrun_gang_restart_completes_job(tmp_path):
+    """Full-stack fault tolerance: a rank crashes, bfrun --restarts kills
+    the survivor, relaunches the gang, and run_elastic resumes to the exact
+    uninterrupted result."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "gang.py"
+    script.write_text(_GANG_SCRIPT.replace("@REPO@", repo))
+    env = dict(os.environ, CKDIR=str(tmp_path / "ck"),
+               MARKER=str(tmp_path / "crashed-once"))
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",
+         "--devices-per-proc", "2", "--restarts", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=600, cwd=repo, env=env)
+    assert out.returncode == 0, (
+        f"stdout={out.stdout}\nstderr={out.stderr}")
+    assert "restarting the gang" in out.stderr
+    assert "(attempt 1/2)" in out.stderr
+    assert out.stdout.count("GANG-OK") == 2, out.stdout
+
+
 @pytest.mark.slow
 def test_multiprocess_crash_and_resume(tmp_path):
     """Two processes crash hard at step 5 (after the step-3 saves), restart,
