@@ -3,4 +3,5 @@ checkpointing (reference SURVEY §5 inventory)."""
 
 from bluefog_tpu.utils import config  # noqa: F401
 from bluefog_tpu.utils import elastic  # noqa: F401
+from bluefog_tpu.utils import metrics  # noqa: F401
 from bluefog_tpu.utils import timeline  # noqa: F401

@@ -1,0 +1,119 @@
+"""Metrics: cross-rank averaging meters + JSONL scalar series.
+
+The reference has no in-framework metrics (SURVEY §5.5) — its examples
+hand-roll an allreduce-averaging ``Metric`` class
+(``examples/pytorch_resnet.py:395-407``) and ``metric_average``
+(``examples/pytorch_mnist.py:268-271``).  Here both are framework API, plus
+a structured series writer so training curves survive the run:
+
+  * :func:`metric_average` / :class:`Metric` — consensus averages of
+    per-rank scalars, through the real collective path (so they are correct
+    in multi-process runs where no process holds all rows).
+  * :class:`MetricsWriter` — append-only JSONL (`{"ts", "step", ...}`), one
+    file per process (same convention as the timeline), trivially parseable
+    by pandas/jq.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["metric_average", "Metric", "MetricsWriter"]
+
+
+def metric_average(values, name: Optional[str] = None) -> float:
+    """Average per-rank scalars into one float (reference
+    ``metric_average``, ``pytorch_mnist.py:268-271``).
+
+    ``values`` is rank-major ``(size,)`` (row ``r`` = rank ``r``'s value).
+    The mean rides the allreduce collective, so multi-process runs (where
+    rows live on other hosts) get the true global mean.  ``name`` is
+    accepted for reference-API compatibility (there it keyed negotiation;
+    SPMD needs no name matching).
+    """
+    del name
+    from bluefog_tpu import basics
+    arr = jnp.asarray(values, jnp.float32)
+    if arr.ndim == 0:  # already a global scalar
+        return float(arr)
+    out = basics.allreduce(arr, average=True)
+    return float(np.asarray(basics.to_numpy(out)).reshape(-1)[0])
+
+
+class Metric:
+    """Running cross-rank average (reference ``pytorch_resnet.py:395-407``):
+    each ``update`` consensus-averages the per-rank values and accumulates;
+    ``avg`` is the mean over updates."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.sum = 0.0
+        self.n = 0
+
+    def update(self, values) -> None:
+        self.sum += metric_average(values, self.name)
+        self.n += 1
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(1, self.n)
+
+
+def _process_count() -> int:
+    env = os.environ.get("BFTPU_NUM_PROCESSES")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:  # backend not initializable here: assume single
+        return 1
+
+
+class MetricsWriter:
+    """Append scalar series as JSON lines: ``{"ts": ..., "step": ..., **kv}``.
+
+    One file per process — ``path`` is suffixed with the process index in
+    multi-process runs (same convention as the timeline's per-rank files).
+    """
+
+    def __init__(self, path: str):
+        from bluefog_tpu.utils.timeline import _process_index
+        proc = _process_index()
+        # Suffix whenever the run is multi-process — including rank 0, so
+        # the file set is uniform (m.0.jsonl..m.N.jsonl) under any launcher.
+        if _process_count() > 1 or proc != 0:
+            root, ext = os.path.splitext(path)
+            path = f"{root}.{proc}{ext or '.jsonl'}"
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a", buffering=1)  # line-buffered
+
+    def log(self, step: Optional[int] = None, **scalars) -> None:
+        rec = {"ts": round(time.time(), 3)}
+        if step is not None:
+            rec["step"] = int(step)
+        for k, v in scalars.items():
+            rec[k] = float(v) if isinstance(v, (np.generic, jnp.ndarray,
+                                                np.ndarray)) else v
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

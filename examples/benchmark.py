@@ -43,6 +43,9 @@ def build_parser():
                     help="dynamic one-peer Exp2 topology")
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--metrics-file", default=None,
+                    help="append per-iter throughput as JSONL "
+                         "(utils.metrics.MetricsWriter)")
     ap.add_argument("--efficiency", action="store_true",
                     help="also measure 1-device throughput and report "
                          "n-device scaling efficiency")
@@ -187,6 +190,10 @@ def measure(args, devices=None, quiet=False):
     sync(params)
 
     rates = []
+    writer = None
+    if args.metrics_file and not quiet:
+        from bluefog_tpu.utils.metrics import MetricsWriter
+        writer = MetricsWriter(args.metrics_file)
     for i in range(args.num_iters):
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
@@ -195,8 +202,13 @@ def measure(args, devices=None, quiet=False):
         dt = time.perf_counter() - t0
         rate = n * args.batch_size * args.num_batches_per_iter / dt
         rates.append(rate)
+        if writer is not None:
+            writer.log(step=i, imgs_per_sec=rate, model=args.model,
+                       n_devices=n)
         if not quiet:
             print(f"iter {i}: {rate:.1f} img/sec across {n} devices")
+    if writer is not None:
+        writer.close()
 
     return float(np.mean(rates)), 1.96 * float(np.std(rates)), n
 

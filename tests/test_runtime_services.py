@@ -90,6 +90,78 @@ def test_stall_monitor_quiet_when_fast(monkeypatch, caplog):
         config.reload()
 
 
+def test_metric_average_and_meter():
+    import bluefog_tpu as bf
+    from bluefog_tpu.utils.metrics import Metric, metric_average
+    if not bf.initialized():
+        bf.init()
+    n = bf.size()
+    vals = np.arange(n, dtype=np.float32)
+    assert metric_average(vals) == pytest.approx(vals.mean())
+    assert metric_average(3.5) == 3.5  # scalar passthrough
+    m = Metric("acc")
+    m.update(vals)            # mean = (n-1)/2
+    m.update(vals + 2.0)      # mean = (n-1)/2 + 2
+    assert m.avg == pytest.approx(vals.mean() + 1.0)
+
+
+def test_metrics_writer_jsonl(tmp_path):
+    import json as _json
+    from bluefog_tpu.utils.metrics import MetricsWriter
+    path = str(tmp_path / "series.jsonl")
+    with MetricsWriter(path) as w:
+        w.log(step=0, loss=1.5, tag="warmup")
+        w.log(step=1, loss=np.float32(0.75))
+    recs = [_json.loads(line) for line in open(path)]
+    assert [r["step"] for r in recs] == [0, 1]
+    assert recs[0]["loss"] == 1.5 and recs[0]["tag"] == "warmup"
+    assert recs[1]["loss"] == 0.75  # numpy scalar serialized as float
+    assert all("ts" in r for r in recs)
+
+
+def test_metrics_writer_per_process_suffix(tmp_path, monkeypatch):
+    from bluefog_tpu.utils.metrics import MetricsWriter
+    monkeypatch.setenv("BFTPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("BFTPU_PROCESS_ID", "1")
+    w = MetricsWriter(str(tmp_path / "m.jsonl"))
+    w.log(step=0, v=1)
+    w.close()
+    assert w.path.endswith("m.1.jsonl")
+
+
+def test_metrics_writer_rank0_suffixed_without_bfrun_env(tmp_path,
+                                                        monkeypatch):
+    """Rank 0 of a multi-process run launched WITHOUT bfrun (no BFTPU_*)
+    must still get a suffix, so the file set is uniform across launchers."""
+    import jax
+    from bluefog_tpu.utils.metrics import MetricsWriter
+    monkeypatch.delenv("BFTPU_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("BFTPU_PROCESS_ID", raising=False)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    w = MetricsWriter(str(tmp_path / "m.jsonl"))
+    w.close()
+    assert w.path.endswith("m.0.jsonl")
+
+
+def test_benchmark_metrics_file(tmp_path):
+    import json as _json
+    import runpy
+    import sys as _sys
+    path = str(tmp_path / "bench.jsonl")
+    argv = ["examples/benchmark.py", "--model", "lenet", "--batch-size", "2",
+            "--num-warmup-batches", "1", "--num-iters", "2",
+            "--num-batches-per-iter", "1", "--metrics-file", path]
+    old = _sys.argv
+    _sys.argv = argv
+    try:
+        runpy.run_path("examples/benchmark.py", run_name="__main__")
+    finally:
+        _sys.argv = old
+    recs = [_json.loads(line) for line in open(path)]
+    assert len(recs) == 2 and all(r["imgs_per_sec"] > 0 for r in recs)
+
+
 def test_checkpoint_roundtrip(tmp_path):
     tree = {"w": jnp.arange(24, dtype=jnp.float32).reshape(8, 3),
             "b": jnp.ones((8, 1))}
