@@ -202,8 +202,8 @@ import bluefog_tpu as bf
 from bluefog_tpu import topology as topo
 
 bf.init_distributed()
-assert jax.process_count() == 2, jax.process_count()
-n = bf.size(); assert n == 4, n
+assert jax.process_count() > 1, jax.process_count()
+n = bf.size(); assert n == int(os.environ.get("BFTPU_EXPECT_RANKS", "4")), n
 bf.set_topology(topo.RingGraph(n))  # bidirectional ring: indeg 2
 owned = [i for i, d in enumerate(jax.devices())
          if d.process_index == jax.process_index()]
@@ -262,7 +262,7 @@ y = np.random.RandomState(7).randn(n, 3).astype(np.float32)
 target = y.mean(axis=0)
 bf.win_create(y, "ps", zero_init=True)
 cur = y.copy()
-for _ in range(40):
+for _ in range(20 * n):  # directed-ring mixing slows with n
     bf.win_accumulate(cur, "ps", self_weight=0.5,
                       dst_weights={(r, (r + 1) % n): 0.5 for r in range(n)})
     bf.win_fence()
@@ -277,10 +277,11 @@ print("MULTIPROC-WIN-OK", jax.process_index())
 
 
 @pytest.mark.slow
-def test_multiprocess_windows(tmp_path):
-    """Two processes, four ranks: the one-sided family over the DCN TCP
-    transport reproduces the single-process oracles on owned ranks
-    (VERDICT round-1 missing #1)."""
+@pytest.mark.parametrize("n_proc,devs_per_proc", [(2, 2), (4, 2)])
+def test_multiprocess_windows(tmp_path, n_proc, devs_per_proc):
+    """The one-sided family over the DCN TCP transport reproduces the
+    single-process oracles on owned ranks (VERDICT round-1 missing #1) —
+    at 2x2 (4 ranks) and 4x2 (8 ranks, each process owning a minority)."""
     import os
     import subprocess
     import sys
@@ -290,10 +291,13 @@ def test_multiprocess_windows(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "win_multiproc.py"
     script.write_text(_MULTIPROC_SCRIPT.replace("@REPO@", repo))
+    env = dict(os.environ,
+               BFTPU_EXPECT_RANKS=str(n_proc * devs_per_proc))
     out = subprocess.run(
-        [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",
-         "--devices-per-proc", "2", sys.executable, str(script)],
-        capture_output=True, text=True, timeout=600, cwd=repo)
+        [sys.executable, "-m", "bluefog_tpu.run", "-np", str(n_proc),
+         "--devices-per-proc", str(devs_per_proc), sys.executable,
+         str(script)],
+        capture_output=True, text=True, timeout=900, cwd=repo, env=env)
     assert out.returncode == 0, f"stdout={out.stdout}\nstderr={out.stderr}"
     # processes share stdout; lines can interleave — count occurrences
-    assert out.stdout.count("MULTIPROC-WIN-OK") == 2, out.stdout
+    assert out.stdout.count("MULTIPROC-WIN-OK") == n_proc, out.stdout
