@@ -69,6 +69,14 @@ def test_benchmark_harness_tiny():
                  "--num-batches-per-iter", "2"])
 
 
+def test_benchmark_host_data_feed():
+    """Batches fed from host RAM through the prefetching pipeline."""
+    run_example(f"{EXAMPLES}/benchmark.py",
+                ["--model", "lenet", "--batch-size", "4",
+                 "--num-warmup-batches", "1", "--num-iters", "2",
+                 "--num-batches-per-iter", "1", "--host-data"])
+
+
 def test_benchmark_scaling_efficiency(capsys):
     """--efficiency measures 1-device vs n-device throughput and prints the
     efficiency ratio (reference protocol pytorch_benchmark.py:228-256)."""
