@@ -41,10 +41,19 @@ class TransformerConfig:
     def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
                  embed_dim=512, mlp_ratio=4, max_seq_len=2048,
                  dtype=jnp.bfloat16, remat=False, num_experts=0,
-                 expert_capacity_factor=2.0, router_group_size=4096):
+                 expert_capacity_factor=2.0, router_group_size=4096,
+                 num_kv_heads=None):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
+        # Grouped-query attention (GQA; num_kv_heads=1 is MQA): fewer K/V
+        # projection heads, repeated across query groups before attention,
+        # so every attn_impl (local / flash / ring / Ulysses) sees uniform
+        # (B, S, H, D) heads unchanged.  None = classic MHA.
+        if num_kv_heads is not None and num_heads % num_kv_heads:
+            raise ValueError(f"num_heads ({num_heads}) must be divisible "
+                             f"by num_kv_heads ({num_kv_heads})")
+        self.num_kv_heads = num_kv_heads
         self.embed_dim = embed_dim
         self.mlp_ratio = mlp_ratio
         self.max_seq_len = max_seq_len
@@ -135,17 +144,31 @@ class Block(nn.Module):
         cfg = self.cfg
         h = cfg.num_heads
         d = cfg.embed_dim // h
+        kv_h = cfg.num_kv_heads or h
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
-        qkv = nn.Dense(3 * cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
-                       name="qkv")(y)
-        B, S = qkv.shape[0], qkv.shape[1]
-        # Head-interleaved fused layout [q_h0 k_h0 v_h0 | q_h1 ...]: a pure
-        # relabeling of kernel columns that keeps tensor-parallel shard
-        # boundaries (tp_param_specs' column split) aligned to heads, so
-        # GSPMD runs attention head-parallel with one psum per block
-        # instead of per-activation resharding.
-        qkv = qkv.reshape(B, S, h, 3, d)
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        B, S = y.shape[0], y.shape[1]
+        if kv_h == h:
+            qkv = nn.Dense(3 * cfg.embed_dim, use_bias=False,
+                           dtype=cfg.dtype, name="qkv")(y)
+            # Head-interleaved fused layout [q_h0 k_h0 v_h0 | q_h1 ...]: a
+            # pure relabeling of kernel columns that keeps tensor-parallel
+            # shard boundaries (tp_param_specs' column split) aligned to
+            # heads, so GSPMD runs attention head-parallel with one psum
+            # per block instead of per-activation resharding.
+            qkv = qkv.reshape(B, S, h, 3, d)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        else:
+            # GQA: h query heads, kv_h shared K/V heads (same interleaved
+            # column layout per projection; head-aligned TP only up to
+            # kv_h ways — beyond that GSPMD re-gathers K/V per block,
+            # acceptable since the kv kernel is the small one).
+            q = nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                         name="q")(y).reshape(B, S, h, d)
+            kv = nn.Dense(2 * kv_h * d, use_bias=False, dtype=cfg.dtype,
+                          name="kv")(y).reshape(B, S, kv_h, 2, d)
+            rep = h // kv_h
+            k = jnp.repeat(kv[..., 0, :], rep, axis=2)
+            v = jnp.repeat(kv[..., 1, :], rep, axis=2)
         attn = self.attn_impl(q, k, v, causal=True)
         attn = attn.reshape(B, S, cfg.embed_dim)
         x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,

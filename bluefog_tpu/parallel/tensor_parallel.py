@@ -29,6 +29,11 @@ __all__ = ["tp_param_specs", "tp_shard_params"]
 # (suffix of the flattened param path, spec builder)
 _RULES = (
     ("qkv/kernel", lambda ax: P(None, ax)),      # column parallel: heads
+    ("/q/kernel", lambda ax: P(None, ax)),       # GQA query heads
+    ("/kv/kernel", lambda ax: P(None, ax)),      # GQA K/V heads: head-
+    # aligned only while tp <= num_kv_heads; past that GSPMD re-gathers
+    # K/V activations per block (the kv kernel is small, so the hint is
+    # still net-positive at the tp degrees GQA is used with)
     ("up/kernel", lambda ax: P(None, ax)),       # column parallel: mlp hidden
     ("proj/kernel", lambda ax: P(ax, None)),     # row parallel (psum after)
     ("down/kernel", lambda ax: P(ax, None)),     # row parallel (psum after)

@@ -68,6 +68,9 @@ def build_parser():
     ap.add_argument("--num-experts", type=int, default=0,
                     help="transformer model: switch-MoE blocks with this "
                          "many experts (0 = dense MLP)")
+    ap.add_argument("--num-kv-heads", type=int, default=0,
+                    help="transformer model: grouped-query attention with "
+                         "this many K/V heads (0 = MHA, 1 = MQA)")
     return ap
 
 
@@ -104,7 +107,8 @@ def measure(args, devices=None, quiet=False):
     else:
         cfg = models.TransformerConfig(max_seq_len=args.seq_len,
                                        remat=args.remat,
-                                       num_experts=args.num_experts)
+                                       num_experts=args.num_experts,
+                                       num_kv_heads=args.num_kv_heads or None)
         attn = None
         if args.flash_attention:
             from bluefog_tpu.ops.flash_attention import flash_attention_impl

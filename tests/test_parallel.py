@@ -176,6 +176,36 @@ def test_tensor_parallel_sharded_forward_matches(devices):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_tensor_parallel_gqa_sharded_forward_matches(devices):
+    """GQA's separate q/kv projections get column-parallel specs and the
+    sharded forward still equals the single-device one."""
+    from jax.sharding import NamedSharding
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+    from bluefog_tpu.parallel.tensor_parallel import (tp_param_specs,
+                                                      tp_shard_params)
+
+    cfg = TransformerConfig(vocab_size=128, num_layers=2, num_heads=4,
+                            num_kv_heads=2, embed_dim=32, max_seq_len=16,
+                            dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 128, (4, 16)))
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    ref = model.apply(params, tokens)
+
+    specs = tp_param_specs(params, axis="tp")
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path): s
+            for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]}
+    assert flat["params/block_0/q/kernel"] == P(None, "tp")
+    assert flat["params/block_0/kv/kernel"] == P(None, "tp")
+
+    mesh = Mesh(np.asarray(devices).reshape(2, 4), ("dp", "tp"))
+    p_sh = tp_shard_params(params, mesh, axis="tp")
+    t_sh = jax.device_put(tokens, NamedSharding(mesh, P("dp")))
+    out = jax.jit(model.apply)(p_sh, t_sh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_tensor_parallel_grad_step_matches(devices):
     """TP + batch-DP sharded loss/grad equals the unsharded computation —
     one jit, layouts only."""
