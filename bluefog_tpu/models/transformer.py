@@ -42,7 +42,8 @@ class TransformerConfig:
                  embed_dim=512, mlp_ratio=4, max_seq_len=2048,
                  dtype=jnp.bfloat16, remat=False, num_experts=0,
                  expert_capacity_factor=2.0, router_group_size=4096,
-                 num_kv_heads=None):
+                 num_kv_heads=None, pos_encoding="learned",
+                 rope_theta=10000.0, mlp="gelu"):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -54,6 +55,26 @@ class TransformerConfig:
             raise ValueError(f"num_heads ({num_heads}) must be divisible "
                              f"by num_kv_heads ({num_kv_heads})")
         self.num_kv_heads = num_kv_heads
+        # "learned" = absolute wpe table (default); "rope" = rotary applied
+        # to q/k inside each block — positions flow in explicitly, so
+        # sequence-parallel shards (ring/Ulysses) embed their own offsets
+        # and the attention impl itself stays position-agnostic.
+        if pos_encoding not in ("learned", "rope"):
+            raise ValueError(f"pos_encoding {pos_encoding!r} not in "
+                             "('learned', 'rope')")
+        if pos_encoding == "rope" and (embed_dim // num_heads) % 2:
+            raise ValueError(
+                f"rope needs an even head dim; got embed_dim {embed_dim} / "
+                f"num_heads {num_heads} = {embed_dim // num_heads}")
+        self.pos_encoding = pos_encoding
+        self.rope_theta = rope_theta
+        if mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"mlp {mlp!r} not in ('gelu', 'swiglu')")
+        if mlp == "swiglu" and num_experts:
+            raise ValueError(
+                "mlp='swiglu' with num_experts > 0 is contradictory: MoE "
+                "blocks replace the MLP with GELU experts")
+        self.mlp = mlp
         self.embed_dim = embed_dim
         self.mlp_ratio = mlp_ratio
         self.max_seq_len = max_seq_len
@@ -135,16 +156,33 @@ class SwitchMlp(nn.Module):
         return y.reshape(G * g, d)[:T].reshape(B, S, d)
 
 
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary position embedding on ``(B, S, H, D)`` q or k.
+
+    Pairs dimension ``i`` with ``i + D/2`` (the standard half-split layout)
+    and rotates by ``pos * theta^(-2i/D)``; angles computed in f32, result
+    cast back to the input dtype."""
+    d2 = x.shape[-1] // 2
+    freq = theta ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
+    ang = positions[..., None].astype(jnp.float32) * freq  # (B, S, d2)
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
 class Block(nn.Module):
     cfg: Any
     attn_impl: Callable
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         cfg = self.cfg
         h = cfg.num_heads
         d = cfg.embed_dim // h
         kv_h = cfg.num_kv_heads or h
+        rope = getattr(cfg, "pos_encoding", "learned") == "rope"
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
         B, S = y.shape[0], y.shape[1]
         if kv_h == h:
@@ -157,6 +195,9 @@ class Block(nn.Module):
             # per block instead of per-activation resharding.
             qkv = qkv.reshape(B, S, h, 3, d)
             q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            if rope:
+                q = apply_rope(q, positions, cfg.rope_theta)
+                k = apply_rope(k, positions, cfg.rope_theta)
         else:
             # GQA: h query heads, kv_h shared K/V heads (same interleaved
             # column layout per projection; head-aligned TP only up to
@@ -167,7 +208,12 @@ class Block(nn.Module):
             kv = nn.Dense(2 * kv_h * d, use_bias=False, dtype=cfg.dtype,
                           name="kv")(y).reshape(B, S, kv_h, 2, d)
             rep = h // kv_h
-            k = jnp.repeat(kv[..., 0, :], rep, axis=2)
+            k1 = kv[..., 0, :]
+            if rope:
+                # rotate the kv_h shared heads ONCE, before fan-out to h
+                q = apply_rope(q, positions, cfg.rope_theta)
+                k1 = apply_rope(k1, positions, cfg.rope_theta)
+            k = jnp.repeat(k1, rep, axis=2)
             v = jnp.repeat(kv[..., 1, :], rep, axis=2)
         attn = self.attn_impl(q, k, v, causal=True)
         attn = attn.reshape(B, S, cfg.embed_dim)
@@ -176,6 +222,14 @@ class Block(nn.Module):
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
         if getattr(cfg, "num_experts", 0) > 0:
             x = x + SwitchMlp(cfg, name="moe")(y)
+        elif getattr(cfg, "mlp", "gelu") == "swiglu":
+            hidden = cfg.mlp_ratio * cfg.embed_dim
+            gate = nn.Dense(hidden, use_bias=False, dtype=cfg.dtype,
+                            name="gate")(y)
+            up = nn.Dense(hidden, use_bias=False, dtype=cfg.dtype,
+                          name="up")(y)
+            x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                             name="down")(nn.silu(gate) * up)
         else:
             y = nn.Dense(cfg.mlp_ratio * cfg.embed_dim, use_bias=False,
                          dtype=cfg.dtype, name="up")(y)
@@ -204,12 +258,17 @@ class TransformerLM(nn.Module):
                      dtype=cfg.dtype, name="wte")(tokens)
         if positions is None:
             positions = jnp.arange(tokens.shape[1])[None, :]
-        pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
-                       dtype=cfg.dtype, name="wpe")(positions)
-        x = x + pos
+        rope = getattr(cfg, "pos_encoding", "learned") == "rope"
+        if not rope:
+            pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
+                           dtype=cfg.dtype, name="wpe")(positions)
+            x = x + pos
+        positions = jnp.broadcast_to(positions,
+                                     (tokens.shape[0], tokens.shape[1]))
         block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, attn, name=f"block_{i}")(x)
+            blk = block_cls(cfg, attn, name=f"block_{i}")
+            x = blk(x, positions) if rope else blk(x)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
         head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")

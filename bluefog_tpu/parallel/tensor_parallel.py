@@ -35,6 +35,7 @@ _RULES = (
     # K/V activations per block (the kv kernel is small, so the hint is
     # still net-positive at the tp degrees GQA is used with)
     ("up/kernel", lambda ax: P(None, ax)),       # column parallel: mlp hidden
+    ("gate/kernel", lambda ax: P(None, ax)),     # SwiGLU gate: column
     ("proj/kernel", lambda ax: P(ax, None)),     # row parallel (psum after)
     ("down/kernel", lambda ax: P(ax, None)),     # row parallel (psum after)
     ("lm_head/kernel", lambda ax: P(None, ax)),  # vocab parallel

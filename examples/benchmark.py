@@ -71,6 +71,11 @@ def build_parser():
     ap.add_argument("--num-kv-heads", type=int, default=0,
                     help="transformer model: grouped-query attention with "
                          "this many K/V heads (0 = MHA, 1 = MQA)")
+    ap.add_argument("--rope", action="store_true",
+                    help="transformer model: rotary position embeddings "
+                         "instead of a learned table")
+    ap.add_argument("--swiglu", action="store_true",
+                    help="transformer model: SwiGLU MLP instead of GELU")
     return ap
 
 
@@ -105,10 +110,12 @@ def measure(args, devices=None, quiet=False):
         labels = jnp.zeros((n, args.batch_size), jnp.int32)
         has_bn = False
     else:
-        cfg = models.TransformerConfig(max_seq_len=args.seq_len,
-                                       remat=args.remat,
-                                       num_experts=args.num_experts,
-                                       num_kv_heads=args.num_kv_heads or None)
+        cfg = models.TransformerConfig(
+            max_seq_len=args.seq_len, remat=args.remat,
+            num_experts=args.num_experts,
+            num_kv_heads=args.num_kv_heads or None,
+            pos_encoding="rope" if args.rope else "learned",
+            mlp="swiglu" if args.swiglu else "gelu")
         attn = None
         if args.flash_attention:
             from bluefog_tpu.ops.flash_attention import flash_attention_impl

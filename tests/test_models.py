@@ -100,11 +100,101 @@ def test_transformer_gqa_and_mqa():
                                np.asarray(logits), rtol=2e-3, atol=2e-3)
 
 
+def test_transformer_rope_relative_shift_invariance():
+    """RoPE attends by relative position: shifting every position id by a
+    constant must leave the logits unchanged (learned-wpe would not)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+    from bluefog_tpu.models.transformer import apply_rope
+
+    # unit: position 0 is the identity rotation
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 3, 2, 8), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(apply_rope(x, jnp.zeros((1, 3)))), np.asarray(x),
+        rtol=1e-6)
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                            embed_dim=64, max_seq_len=512,
+                            pos_encoding="rope", dtype=jnp.float32)
+    m = TransformerLM(cfg)
+    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 64, (2, 16)))
+    params = m.init(jax.random.PRNGKey(0), tokens)
+    assert not any("wpe" in "/".join(map(str, p)) for p, _ in
+                   jax.tree_util.tree_flatten_with_path(params)[0])
+    base = m.apply(params, tokens,
+                   positions=jnp.arange(16)[None, :])
+    shifted = m.apply(params, tokens,
+                      positions=jnp.arange(16)[None, :] + 100)
+    np.testing.assert_allclose(np.asarray(shifted), np.asarray(base),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_transformer_rope_flash_matches_dense():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+    from bluefog_tpu.ops.flash_attention import flash_attention_impl
+
+    kw = dict(vocab_size=64, num_layers=2, num_heads=4, embed_dim=64,
+              max_seq_len=64, pos_encoding="rope", num_kv_heads=2,
+              dtype=jnp.float32)
+    tokens = jnp.asarray(np.random.RandomState(2).randint(0, 64, (2, 32)))
+    dense = TransformerLM(TransformerConfig(**kw))
+    params = dense.init(jax.random.PRNGKey(0), tokens)
+    flash = TransformerLM(TransformerConfig(**kw),
+                          attn_impl=flash_attention_impl(block_q=16,
+                                                         block_k=16))
+    np.testing.assert_allclose(np.asarray(flash.apply(params, tokens)),
+                               np.asarray(dense.apply(params, tokens)),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_transformer_swiglu_trains():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                            embed_dim=32, max_seq_len=16, mlp="swiglu",
+                            dtype=jnp.float32)
+    m = TransformerLM(cfg)
+    tokens = jnp.asarray(np.random.RandomState(3).randint(0, 64, (4, 16)))
+    params = m.init(jax.random.PRNGKey(0), tokens)
+    names = {"/".join(str(getattr(k, "key", k)) for k in p)
+             for p, _ in jax.tree_util.tree_flatten_with_path(params)[0]}
+    assert "params/block_0/gate/kernel" in names
+
+    opt = optax.adam(1e-3)
+
+    def loss(p):
+        logits = m.apply(p, tokens)
+        tgt = jnp.roll(tokens, -1, axis=1)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    state = opt.init(params)
+    l0 = float(loss(params))
+    for _ in range(30):
+        g = jax.grad(loss)(params)
+        upd, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, upd)
+    assert float(loss(params)) < l0
+
+
 def test_transformer_gqa_validates_divisibility():
     import pytest as _pytest
     from bluefog_tpu.models import TransformerConfig
     with _pytest.raises(ValueError, match="divisible"):
         TransformerConfig(num_heads=4, num_kv_heads=3)
+    with _pytest.raises(ValueError, match="even head dim"):
+        TransformerConfig(embed_dim=90, num_heads=6, pos_encoding="rope")
+    with _pytest.raises(ValueError, match="contradictory"):
+        TransformerConfig(mlp="swiglu", num_experts=4)
 
 
 def test_transformer_remat_matches_plain():
