@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["TransformerLM", "TransformerConfig", "local_attention"]
+__all__ = ["TransformerLM", "TransformerConfig", "local_attention",
+           "init_cache", "generate"]
 
 
 def local_attention(q, k, v, *, causal: bool = True):
@@ -177,7 +178,13 @@ class Block(nn.Module):
     attn_impl: Callable
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, cache=None):
+        """Training/prefill path when ``cache is None``; with ``cache =
+        (k_cache, v_cache)`` (shapes ``(B, L, kv_h, d)``) the input is ONE
+        new token per sequence (S == 1) written at position ``positions``
+        and attended against the cache — returns ``(x, new_cache)``.  The
+        cache stores the kv_h *shared* heads, so GQA shrinks it by
+        ``h / kv_h`` (the reason GQA exists)."""
         cfg = self.cfg
         h = cfg.num_heads
         d = cfg.embed_dim // h
@@ -194,10 +201,7 @@ class Block(nn.Module):
             # heads, so GSPMD runs attention head-parallel with one psum
             # per block instead of per-activation resharding.
             qkv = qkv.reshape(B, S, h, 3, d)
-            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-            if rope:
-                q = apply_rope(q, positions, cfg.rope_theta)
-                k = apply_rope(k, positions, cfg.rope_theta)
+            q, k1, v1 = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         else:
             # GQA: h query heads, kv_h shared K/V heads (same interleaved
             # column layout per projection; head-aligned TP only up to
@@ -207,15 +211,41 @@ class Block(nn.Module):
                          name="q")(y).reshape(B, S, h, d)
             kv = nn.Dense(2 * kv_h * d, use_bias=False, dtype=cfg.dtype,
                           name="kv")(y).reshape(B, S, kv_h, 2, d)
-            rep = h // kv_h
-            k1 = kv[..., 0, :]
-            if rope:
-                # rotate the kv_h shared heads ONCE, before fan-out to h
-                q = apply_rope(q, positions, cfg.rope_theta)
-                k1 = apply_rope(k1, positions, cfg.rope_theta)
-            k = jnp.repeat(k1, rep, axis=2)
-            v = jnp.repeat(kv[..., 1, :], rep, axis=2)
-        attn = self.attn_impl(q, k, v, causal=True)
+            k1, v1 = kv[..., 0, :], kv[..., 1, :]
+        if rope:
+            # rotate the kv_h shared heads ONCE, before any fan-out to h
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k1 = apply_rope(k1, positions, cfg.rope_theta)
+        rep = h // kv_h
+        if cache is None:
+            if (self.is_mutable_collection("kv_cache")
+                    and not self.is_initializing()):
+                # prefill: expose the per-position shared-head K/V so
+                # ``generate`` can fill its decode cache in ONE forward.
+                # Gated out of init(), which would otherwise bake a stale
+                # entry into the variables users carry around.
+                self.sow("kv_cache", "kv_entries", (k1, v1))
+            k = jnp.repeat(k1, rep, axis=2) if rep > 1 else k1
+            v = jnp.repeat(v1, rep, axis=2) if rep > 1 else v1
+            attn = self.attn_impl(q, k, v, causal=True)
+        else:
+            ck, cv = cache
+            idx = positions[0, 0]  # decode positions are batch-uniform
+            ck = jax.lax.dynamic_update_slice(
+                ck, k1.astype(ck.dtype), (0, idx, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v1.astype(cv.dtype), (0, idx, 0, 0))
+            cache = (ck, cv)
+            # grouped attention of the single query over the cache — never
+            # materializes h-head K/V
+            L = ck.shape[1]
+            qg = q.reshape(B, S, kv_h, rep, d)
+            logits = jnp.einsum("bqgrd,blgd->bgrql", qg, ck) / np.sqrt(d)
+            mask = (jnp.arange(L) <= idx)[None, None, None, None, :]
+            logits = jnp.where(mask, logits.astype(jnp.float32),
+                               jnp.finfo(jnp.float32).min)
+            probs = nn.softmax(logits, axis=-1).astype(cfg.dtype)
+            attn = jnp.einsum("bgrql,blgd->bqgrd", probs, cv)
         attn = attn.reshape(B, S, cfg.embed_dim)
         x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
                          name="proj")(attn)
@@ -236,7 +266,7 @@ class Block(nn.Module):
             y = nn.gelu(y)
             x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
                              name="down")(y)
-        return x
+        return x if cache is None else (x, cache)
 
 
 class TransformerLM(nn.Module):
@@ -245,15 +275,32 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, positions=None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, cache=None):
         """``positions``: optional (B, S) global position ids — required when
         the sequence axis is sharded (each shard must embed its own offset).
         ``return_hidden``: skip the lm-head and return the final normalized
         activations (B, S, E) — pair with
         ``ops.chunked_loss.chunked_softmax_cross_entropy`` so very long
-        sequences never materialize the (S, vocab) logits."""
+        sequences never materialize the (S, vocab) logits.
+        ``cache``: list of per-block ``(k, v)`` caches (``init_cache``) for
+        single-token incremental decoding — tokens must be (B, 1) at
+        position ``positions``; returns ``(logits, new_cache)``."""
         cfg = self.cfg
         attn = self.attn_impl or local_attention
+        if cache is not None:
+            if getattr(cfg, "num_experts", 0) > 0:
+                raise NotImplementedError(
+                    "KV-cache decoding with MoE blocks is not supported")
+            if tokens.shape[1] != 1:
+                raise ValueError(
+                    f"cache decoding takes ONE token per step; got "
+                    f"tokens of shape {tokens.shape} (prefill a prompt "
+                    f"with a normal forward — see generate())")
+            if positions is None:
+                raise ValueError(
+                    "cache decoding requires explicit positions (the "
+                    "cache write index); defaulting to 0 would overwrite "
+                    "slot 0 every step")
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
                      dtype=cfg.dtype, name="wte")(tokens)
         if positions is None:
@@ -265,14 +312,96 @@ class TransformerLM(nn.Module):
             x = x + pos
         positions = jnp.broadcast_to(positions,
                                      (tokens.shape[0], tokens.shape[1]))
-        block_cls = nn.remat(Block) if cfg.remat else Block
+        block_cls = Block if cache is not None or not cfg.remat \
+            else nn.remat(Block)
+        new_cache = []
         for i in range(cfg.num_layers):
             blk = block_cls(cfg, attn, name=f"block_{i}")
-            x = blk(x, positions) if rope else blk(x)
+            if cache is not None:
+                x, blk_cache = blk(x, positions, cache[i])
+                new_cache.append(blk_cache)
+            elif rope:
+                x = blk(x, positions)
+            else:
+                x = blk(x)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
         head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")
         if return_hidden:
             head(x[:, :1])  # materialize the lm_head param without S x V
             return x
+        if cache is not None:
+            return head(x), new_cache
         return head(x)
+
+
+def init_cache(cfg, batch: int, max_len: int):
+    """Per-block ``(k, v)`` KV caches for incremental decoding: shapes
+    ``(batch, max_len, kv_heads, head_dim)`` — kv_heads, not num_heads, so
+    GQA/MQA caches are ``num_heads / num_kv_heads`` times smaller."""
+    h = cfg.num_heads
+    d = cfg.embed_dim // h
+    kv_h = cfg.num_kv_heads or h
+    z = jnp.zeros((batch, max_len, kv_h, d), cfg.dtype)
+    return [(z, z) for _ in range(cfg.num_layers)]
+
+
+def generate(model, variables, prompt, max_new_tokens: int, *,
+             temperature: float = 0.0, rng=None):
+    """Autoregressive decoding with the KV cache.
+
+    ``prompt``: (B, P) int tokens.  Returns (B, max_new_tokens).
+    ``temperature == 0`` is greedy; otherwise pass ``rng`` for sampling.
+    Prefill is ONE batched forward (the per-block shared-head K/V are sown
+    into a ``kv_cache`` collection and copied into the decode cache), then
+    new tokens stream through a single fused ``lax.scan`` of one-token
+    decode steps.  Decode logits match the training forward's to numerical
+    tolerance (different contraction order; tested at 1e-4 in f32).
+    """
+    cfg = model.cfg
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    if getattr(cfg, "pos_encoding", "learned") == "learned" \
+            and total > cfg.max_seq_len:
+        raise ValueError(f"prompt + max_new_tokens = {total} exceeds "
+                         f"max_seq_len {cfg.max_seq_len}")
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        return nxt.astype(prompt.dtype), key
+
+    # Prefill: one forward over the whole prompt; blocks sow (k1, v1).
+    # (drop any stale kv_cache collection an old init may have stored)
+    variables = {k: v for k, v in variables.items() if k != "kv_cache"}
+    logits, sown = model.apply(
+        variables, prompt, positions=jnp.arange(P)[None, :],
+        mutable=["kv_cache"])
+    cache = []
+    for i, (ck, cv) in enumerate(init_cache(cfg, B, total)):
+        (k1, v1), = sown["kv_cache"][f"block_{i}"]["kv_entries"]
+        cache.append((jax.lax.dynamic_update_slice(
+                          ck, k1.astype(ck.dtype), (0, 0, 0, 0)),
+                      jax.lax.dynamic_update_slice(
+                          cv, v1.astype(cv.dtype), (0, 0, 0, 0))))
+    first, rng = pick(logits[:, -1, :], rng)
+
+    def step(carry, t):
+        cache, prev, key = carry
+        logits, cache = model.apply(
+            variables, prev[:, None],
+            positions=jnp.broadcast_to(t, (B, 1)), cache=cache)
+        nxt, key = pick(logits[:, 0, :], key)
+        return (cache, nxt, key), nxt
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    (_, _, _), outs = jax.lax.scan(
+        step, (cache, first, rng), jnp.arange(P, total - 1))
+    return jnp.concatenate([first[:, None], outs.swapaxes(0, 1)], axis=1)

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from bluefog_tpu import models
 
@@ -186,14 +187,87 @@ def test_transformer_swiglu_trains():
     assert float(loss(params)) < l0
 
 
+@pytest.mark.parametrize("variant", ["mha", "gqa_rope_swiglu"])
+def test_transformer_kv_cache_decode_matches_forward(variant):
+    """Teacher-forced single-token decoding through the KV cache must
+    reproduce the full training forward's logits position by position."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+    from bluefog_tpu.models.transformer import init_cache
+
+    kw = dict(vocab_size=64, num_layers=2, num_heads=4, embed_dim=32,
+              max_seq_len=16, dtype=jnp.float32)
+    if variant == "gqa_rope_swiglu":
+        kw.update(num_kv_heads=2, pos_encoding="rope", mlp="swiglu")
+    m = TransformerLM(TransformerConfig(**kw))
+    tokens = jnp.asarray(np.random.RandomState(5).randint(0, 64, (2, 10)))
+    params = m.init(jax.random.PRNGKey(0), tokens)
+    full = m.apply(params, tokens)  # (2, 10, 64)
+
+    cache = init_cache(m.cfg, 2, 10)
+    # GQA cache is kv_h-headed: h/kv_h smaller than num_heads
+    kv_h = m.cfg.num_kv_heads or m.cfg.num_heads
+    assert cache[0][0].shape == (2, 10, kv_h, 32 // 4)
+    got = []
+    for t in range(10):
+        logits, cache = m.apply(
+            params, tokens[:, t:t + 1],
+            positions=jnp.broadcast_to(jnp.asarray(t), (2, 1)), cache=cache)
+        got.append(logits[:, 0])
+    np.testing.assert_allclose(np.asarray(jnp.stack(got, 1)),
+                               np.asarray(full), rtol=1e-4, atol=1e-4)
+
+
+def test_transformer_generate_greedy_and_sampled():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+    from bluefog_tpu.models.transformer import generate
+
+    cfg = TransformerConfig(vocab_size=32, num_layers=2, num_heads=2,
+                            embed_dim=32, max_seq_len=24,
+                            dtype=jnp.float32)
+    m = TransformerLM(cfg)
+    prompt = jnp.asarray(np.random.RandomState(6).randint(0, 32, (2, 5)))
+    params = m.init(jax.random.PRNGKey(0), prompt)
+
+    out = generate(m, params, prompt, 6)
+    assert out.shape == (2, 6) and out.dtype == prompt.dtype
+    # greedy decoding is deterministic
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(generate(m, params, prompt, 6)))
+    # greedy first token == argmax of the forward's last-prompt logits
+    full = m.apply(params, prompt)
+    np.testing.assert_array_equal(np.asarray(out[:, 0]),
+                                  np.asarray(jnp.argmax(full[:, -1], -1)))
+    sampled = generate(m, params, prompt, 6, temperature=1.0,
+                       rng=jax.random.PRNGKey(1))
+    assert sampled.shape == (2, 6)
+    assert generate(m, params, prompt, 1).shape == (2, 1)
+    with pytest.raises(ValueError, match="needs rng"):
+        generate(m, params, prompt, 2, temperature=0.5)
+    with pytest.raises(ValueError, match="exceeds"):
+        generate(m, params, prompt, 100)
+    # decode-contract violations are loud, not silently corrupting
+    from bluefog_tpu.models.transformer import init_cache
+    cache = init_cache(cfg, 2, 8)
+    with pytest.raises(ValueError, match="ONE token"):
+        m.apply(params, prompt[:, :3],
+                positions=jnp.zeros((2, 3), jnp.int32), cache=cache)
+    with pytest.raises(ValueError, match="explicit positions"):
+        m.apply(params, prompt[:, :1], cache=cache)
+
+
 def test_transformer_gqa_validates_divisibility():
-    import pytest as _pytest
     from bluefog_tpu.models import TransformerConfig
-    with _pytest.raises(ValueError, match="divisible"):
+    with pytest.raises(ValueError, match="divisible"):
         TransformerConfig(num_heads=4, num_kv_heads=3)
-    with _pytest.raises(ValueError, match="even head dim"):
+    with pytest.raises(ValueError, match="even head dim"):
         TransformerConfig(embed_dim=90, num_heads=6, pos_encoding="rope")
-    with _pytest.raises(ValueError, match="contradictory"):
+    with pytest.raises(ValueError, match="contradictory"):
         TransformerConfig(mlp="swiglu", num_experts=4)
 
 
