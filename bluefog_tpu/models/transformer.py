@@ -360,6 +360,8 @@ def generate(model, variables, prompt, max_new_tokens: int, *,
     """
     cfg = model.cfg
     B, P = prompt.shape
+    if max_new_tokens <= 0:
+        raise ValueError(f"max_new_tokens must be >= 1; got {max_new_tokens}")
     total = P + max_new_tokens
     if getattr(cfg, "pos_encoding", "learned") == "learned" \
             and total > cfg.max_seq_len:

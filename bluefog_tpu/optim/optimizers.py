@@ -81,9 +81,13 @@ class DistributedOptimizer:
                  *, order: str = "awc",
                  num_steps_per_communication: int = 1,
                  use_dynamic_topology: bool = False,
-                 phases=None, fusion: bool = True):
+                 phases=None, fusion: bool = True,
+                 compression: str = "none"):
         if isinstance(communication_type, str):
             communication_type = CommunicationType(communication_type)
+        if compression not in ("none", "bf16"):
+            raise ValueError(f"unknown compression {compression!r}; "
+                             "expected 'none' or 'bf16'")
         self.base = base
         self.communication_type = communication_type
         self.order = order
@@ -92,6 +96,9 @@ class DistributedOptimizer:
         self.phases = phases
         # Fused single-buffer communication (reference FusionBufferManager).
         self.fusion = fusion
+        # "bf16": halve the wire bytes per round (functional.
+        # compress_combiner — the reference family's fp16 compression role).
+        self.compression = compression
         self._jitted = {}
 
     # -- schedule resolution ------------------------------------------------
@@ -138,7 +145,7 @@ class DistributedOptimizer:
         inner = F.step_fn(self.order, self.base, combine,
                           axis_name=RANK_AXIS,
                           steps_per_comm=self.num_steps_per_communication,
-                          fuse=self.fusion)
+                          fuse=self.fusion, compression=self.compression)
         mesh = ctx.hier_mesh if hier else ctx.mesh
         spec = P((MACHINE_AXIS, LOCAL_AXIS)) if hier else P(RANK_AXIS)
 
@@ -202,62 +209,68 @@ class DistributedOptimizer:
 # ---------------------------------------------------------------------------
 
 def DistributedGradientAllreduceOptimizer(
-        base, *, num_steps_per_communication: int = 1) -> DistributedOptimizer:
+        base, *, num_steps_per_communication: int = 1,
+        **kw) -> DistributedOptimizer:
     """Horovod-equivalent synchronous gradient averaging
     (reference ``:1376``)."""
     return DistributedOptimizer(
         base, CommunicationType.allreduce, order="gradient_allreduce",
-        num_steps_per_communication=num_steps_per_communication)
+        num_steps_per_communication=num_steps_per_communication, **kw)
 
 
 def DistributedAllreduceOptimizer(
-        base, *, num_steps_per_communication: int = 1) -> DistributedOptimizer:
+        base, *, num_steps_per_communication: int = 1,
+        **kw) -> DistributedOptimizer:
     """Synchronous parameter consensus via global averaging
     (reference ``:1301``)."""
     return DistributedOptimizer(
         base, CommunicationType.allreduce, order="awc",
-        num_steps_per_communication=num_steps_per_communication)
+        num_steps_per_communication=num_steps_per_communication, **kw)
 
 
 def DistributedNeighborAllreduceOptimizer(
         base, *, num_steps_per_communication: int = 1,
-        use_dynamic_topology: bool = False, phases=None) -> DistributedOptimizer:
+        use_dynamic_topology: bool = False, phases=None,
+        **kw) -> DistributedOptimizer:
     """The flagship: AWC neighbor averaging over the active topology
     (reference ``:1326``)."""
     return DistributedOptimizer(
         base, CommunicationType.neighbor_allreduce, order="awc",
         num_steps_per_communication=num_steps_per_communication,
-        use_dynamic_topology=use_dynamic_topology, phases=phases)
+        use_dynamic_topology=use_dynamic_topology, phases=phases, **kw)
 
 
 def DistributedHierarchicalNeighborAllreduceOptimizer(
         base, *, num_steps_per_communication: int = 1,
-        use_dynamic_topology: bool = False, phases=None) -> DistributedOptimizer:
+        use_dynamic_topology: bool = False, phases=None,
+        **kw) -> DistributedOptimizer:
     """Machine-level neighbor averaging: local ICI allreduce fused with
     machine-graph exchange (reference ``:1352``)."""
     return DistributedOptimizer(
         base, CommunicationType.hierarchical_neighbor_allreduce, order="awc",
         num_steps_per_communication=num_steps_per_communication,
-        use_dynamic_topology=use_dynamic_topology, phases=phases)
+        use_dynamic_topology=use_dynamic_topology, phases=phases, **kw)
 
 
 def DistributedAdaptWithCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         *, num_steps_per_communication: int = 1,
-        use_dynamic_topology: bool = False, phases=None) -> DistributedOptimizer:
+        use_dynamic_topology: bool = False, phases=None,
+        **kw) -> DistributedOptimizer:
     """AWC with a chosen communication type (reference ``:1497``)."""
     return DistributedOptimizer(
         base, communication_type, order="awc",
         num_steps_per_communication=num_steps_per_communication,
-        use_dynamic_topology=use_dynamic_topology, phases=phases)
+        use_dynamic_topology=use_dynamic_topology, phases=phases, **kw)
 
 
 def DistributedAdaptThenCombineOptimizer(
         base, communication_type=CommunicationType.neighbor_allreduce,
         *, num_steps_per_communication: int = 1,
-        use_dynamic_topology: bool = False, phases=None) -> DistributedOptimizer:
+        use_dynamic_topology: bool = False, phases=None,
+        **kw) -> DistributedOptimizer:
     """ATC with a chosen communication type (reference ``:1426``)."""
     return DistributedOptimizer(
         base, communication_type, order="atc",
         num_steps_per_communication=num_steps_per_communication,
-        use_dynamic_topology=use_dynamic_topology, phases=phases)
+        use_dynamic_topology=use_dynamic_topology, phases=phases, **kw)

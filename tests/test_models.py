@@ -251,6 +251,8 @@ def test_transformer_generate_greedy_and_sampled():
         generate(m, params, prompt, 2, temperature=0.5)
     with pytest.raises(ValueError, match="exceeds"):
         generate(m, params, prompt, 100)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(m, params, prompt, 0)
     # decode-contract violations are loud, not silently corrupting
     from bluefog_tpu.models.transformer import init_cache
     cache = init_cache(cfg, 2, 8)

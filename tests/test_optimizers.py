@@ -144,6 +144,58 @@ def test_fusion_matches_unfused(order, dynamic):
                                    rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.parametrize("factory,kind", [
+    (lambda b: bf.optim.DistributedNeighborAllreduceOptimizer(
+        b, compression="bf16"), "neighbor"),
+    (lambda b: bf.optim.DistributedGradientAllreduceOptimizer(
+        b, compression="bf16"), "gradient"),
+])
+def test_bf16_compression_converges_and_compresses(factory, kind):
+    """compression='bf16' halves the wire payload (the reference family's
+    fp16 compression role) without breaking convergence, and the lowered
+    program really carries bf16 over the collective."""
+    bf.init(lambda: topo.ExponentialTwoGraph(N))
+    A, y, _ = make_problem()
+    opt = factory(optax.sgd(0.05))
+    params, state = run_training(opt, A, y,
+                                 broadcast_init=(kind == "gradient"))
+    assert global_mse(params["w"], A, y) < 0.05
+
+    # the compiled program carries bf16 (this problem is f32 end-to-end, so
+    # any bf16 in the lowering comes from the compression casts around the
+    # collective); the uncompressed control has none
+    grads = {"w": jnp.zeros_like(params["w"])}
+    lowered = opt._step_callable(False).lower(params, grads, state).as_text()
+    assert "collective_permute" in lowered or "all_reduce" in lowered
+    assert "bf16" in lowered
+    plain = factory(optax.sgd(0.05))
+    plain.compression = "none"
+    st0 = plain.init(params)
+    assert "bf16" not in plain._step_callable(False).lower(
+        params, grads, st0).as_text()
+
+
+def test_unknown_compression_rejected():
+    with pytest.raises(ValueError, match="compression"):
+        bf.optim.DistributedOptimizer(optax.sgd(0.1), compression="fp8")
+
+
+def test_compress_combiner_residual_exact_for_identity():
+    """Difference compression: with combine=identity the wrapper is exact
+    (a rank's own master weights are never truncated by its own rounds);
+    without the residual it quantizes."""
+    from bluefog_tpu.optim.functional import compress_combiner
+    x = jnp.asarray(np.random.RandomState(0).randn(64).astype(np.float32))
+    ident = lambda v, **kw: v  # noqa: E731
+    with_res = compress_combiner(ident, "bf16", residual=True)
+    np.testing.assert_array_equal(np.asarray(with_res(x)), np.asarray(x))
+    no_res = compress_combiner(ident, "bf16", residual=False)
+    assert not np.array_equal(np.asarray(no_res(x)), np.asarray(x))
+    np.testing.assert_array_equal(
+        np.asarray(no_res(x)),
+        np.asarray(x.astype(jnp.bfloat16).astype(jnp.float32)))
+
+
 def test_dynamic_topology_optimizer():
     bf.init(lambda: topo.ExponentialGraph(N))
     A, y, _ = make_problem()
