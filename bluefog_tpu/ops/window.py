@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bluefog_tpu.utils import config
+
 __all__ = [
     "win_create", "win_free", "win_put", "win_put_nonblocking",
     "win_get", "win_get_nonblocking", "win_accumulate",
@@ -337,14 +339,24 @@ def _probe_missing_ranks(timeout: float = 1.0) -> List[int]:
     return sorted(missing)
 
 
+_BF16 = np.dtype(jnp.bfloat16)
+
+
 def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
                   weight: float, p_weight: float = 0.0,
                   payload: Optional[np.ndarray] = None) -> None:
     d = _store.distrib
     host, port = d.proc_addr[proc]
-    d.transport.send(host, port, op, name, src, dst, weight,
-                     payload if payload is not None
-                     else np.empty(0, np.uint8), p_weight)
+    if payload is None:
+        payload = np.empty(0, np.uint8)
+    elif (payload.size and payload.dtype == np.float32
+          and config.get().win_compression == "bf16"):
+        # Halve the DCN bytes per gossip edge.  No wire flag needed: an
+        # f32 window's payload at half the expected length can only be
+        # bf16, so the receiver detects it from the size (_payload_row).
+        payload = payload.astype(_BF16)
+    d.transport.send(host, port, op, name, src, dst, weight, payload,
+                     p_weight)
 
 
 def _send_to_rank_owner(rank: int, op: int, name: str, src: int, dst: int,
@@ -355,6 +367,11 @@ def _send_to_rank_owner(rank: int, op: int, name: str, src: int, dst: int,
 
 
 def _payload_row(win: _Window, payload: bytes) -> np.ndarray:
+    expected = int(np.prod(win.shape)) * win.dtype.itemsize
+    if (len(payload) * 2 == expected and win.dtype == np.float32):
+        # bf16-compressed edge (sender had BLUEFOG_TPU_WIN_COMPRESSION=bf16)
+        return np.frombuffer(payload, dtype=_BF16).astype(
+            win.dtype).reshape(win.shape)
     return np.frombuffer(payload, dtype=win.dtype).reshape(win.shape).copy()
 
 

@@ -15,6 +15,7 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_STALL_WARNING_SEC | 60    | stall-detector threshold (0=off) |
 | BLUEFOG_TPU_WIN_PORT          | 0     | DCN window-service port (0=ephemeral) |
 | BLUEFOG_TPU_WIN_MAX_PENDING   | 4096  | inbound window-message queue bound |
+| BLUEFOG_TPU_WIN_COMPRESSION   | none  | bf16: halve cross-host window payloads |
 | BFTPU_COORDINATOR             | unset | set by bfrun: coordinator host:port |
 | BFTPU_NUM_PROCESSES           | unset | set by bfrun |
 | BFTPU_PROCESS_ID              | unset | set by bfrun |
@@ -38,6 +39,15 @@ from typing import Optional
 __all__ = ["Config", "get", "reload"]
 
 
+def _validated_compression(value: str) -> str:
+    if value not in ("none", "bf16"):
+        raise ValueError(
+            f"BLUEFOG_TPU_WIN_COMPRESSION={value!r} is not supported; "
+            "expected 'none' or 'bf16' (a typo here would otherwise "
+            "silently disable compression)")
+    return value
+
+
 def _flag(name: str, default: bool = False) -> bool:
     return os.environ.get(name, "1" if default else "0") in ("1", "true",
                                                              "True", "yes")
@@ -53,6 +63,7 @@ class Config:
     stall_warning_sec: float
     win_port: int
     win_max_pending: int
+    win_compression: str
 
     @staticmethod
     def from_env() -> "Config":
@@ -67,6 +78,8 @@ class Config:
             win_port=int(os.environ.get("BLUEFOG_TPU_WIN_PORT", "0")),
             win_max_pending=int(
                 os.environ.get("BLUEFOG_TPU_WIN_MAX_PENDING", "4096")),
+            win_compression=_validated_compression(os.environ.get(
+                "BLUEFOG_TPU_WIN_COMPRESSION", "none").lower()),
         )
 
 

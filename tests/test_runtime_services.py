@@ -90,6 +90,17 @@ def test_stall_monitor_quiet_when_fast(monkeypatch, caplog):
         config.reload()
 
 
+def test_win_compression_env_validated(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_COMPRESSION", "fp16")
+    with pytest.raises(ValueError, match="WIN_COMPRESSION"):
+        config.reload()
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_COMPRESSION", "bf16")
+    config.reload()
+    assert config.get().win_compression == "bf16"
+    monkeypatch.delenv("BLUEFOG_TPU_WIN_COMPRESSION")
+    config.reload()
+
+
 def test_metric_average_and_meter():
     import bluefog_tpu as bf
     from bluefog_tpu.utils.metrics import Metric, metric_average

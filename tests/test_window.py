@@ -276,6 +276,75 @@ print("MULTIPROC-WIN-OK", jax.process_index())
 """
 
 
+def test_payload_row_autodetects_bf16():
+    """An f32 window's payload at half the expected byte length can only be
+    bf16 — the receiver upcasts without any wire flag."""
+    import jax.numpy as jnp
+    from bluefog_tpu.ops import window as W
+    bf.init()
+    bf.set_topology(topo.RingGraph(bf.size()))
+    x = np.random.RandomState(0).randn(bf.size(), 6).astype(np.float32)
+    assert bf.win_create(x, "pw")
+    win = W._store.get("pw")
+    row = x[1]
+    plain = W._payload_row(win, row.tobytes())
+    np.testing.assert_array_equal(plain, row)
+    comp = W._payload_row(win, row.astype(jnp.bfloat16).tobytes())
+    np.testing.assert_allclose(comp, row, rtol=1e-2)
+    assert comp.dtype == np.float32
+    bf.win_free("pw")
+
+
+_COMPRESS_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, "@REPO@")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import bluefog_tpu as bf
+from bluefog_tpu import topology as topo
+
+bf.init_distributed()
+n = bf.size()
+bf.set_topology(topo.RingGraph(n))
+owned = [i for i, d in enumerate(jax.devices())
+         if d.process_index == jax.process_index()]
+x = (np.arange(n, dtype=np.float32)[:, None] + 1.0).repeat(3, 1)
+assert bf.win_create(x, "w", zero_init=True)
+bf.win_put(2.0 * x, "w")
+bf.win_fence()
+u = np.asarray(bf.win_update("w"))
+main = x.copy()
+for r in range(n):
+    main[r] = (x[r] + 2.0 * x[(r - 1) % n] + 2.0 * x[(r + 1) % n]) / 3.0
+for r in owned:
+    np.testing.assert_allclose(u[r], main[r], rtol=1e-2)  # bf16 edges
+print("COMPRESSED-WIN-OK", jax.process_index())
+"""
+
+
+@pytest.mark.slow
+def test_multiprocess_windows_bf16_compression(tmp_path):
+    """Cross-process window gossip with BLUEFOG_TPU_WIN_COMPRESSION=bf16:
+    half the DCN bytes, results correct to bf16 tolerance."""
+    import os
+    import subprocess
+    import sys
+    from bluefog_tpu import native
+    if not native.available():
+        pytest.skip("native transport not built")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "win_compress.py"
+    script.write_text(_COMPRESS_SCRIPT.replace("@REPO@", repo))
+    env = dict(os.environ, BLUEFOG_TPU_WIN_COMPRESSION="bf16")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",
+         "--devices-per-proc", "2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=600, cwd=repo, env=env)
+    assert out.returncode == 0, f"stdout={out.stdout}\nstderr={out.stderr}"
+    assert out.stdout.count("COMPRESSED-WIN-OK") == 2, out.stdout
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("n_proc,devs_per_proc", [(2, 2), (4, 2)])
 def test_multiprocess_windows(tmp_path, n_proc, devs_per_proc):
