@@ -1,0 +1,122 @@
+"""Sequence-parallel long-context LM training over a device mesh.
+
+No reference counterpart (SURVEY §5.7: BlueFog predates LLM-era sequence
+scaling).  This example trains a TransformerLM whose SEQUENCE axis is
+sharded across the mesh: each device holds ``seq_len / n`` tokens, ring
+attention (``parallel.ring_attention``) streams K/V blocks around the mesh
+so no device ever materializes full-sequence logits or K/V, and the data-
+parallel axis is dropped in favor of one long stream — the configuration
+for contexts that do not fit a single chip.
+
+    # 8 virtual devices, 8k tokens, each device holds 1k
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/long_context_training.py --seq-len 8192
+
+On a real pod, the same code with `--attention ulysses` uses all-to-all
+head parallelism instead; both compose with `--rope` (positions flow
+explicitly, so every shard embeds its own offsets).
+"""
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=4096)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--attention", choices=["ring", "ulysses"],
+                    default="ring")
+    ap.add_argument("--rope", action="store_true")
+    args = ap.parse_args()
+    if args.steps < 2:
+        ap.error("--steps must be >= 2 (the run asserts the loss fell)")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bluefog_tpu import models
+    from bluefog_tpu.parallel import ring_attention_impl, ulysses_attention_impl
+
+    devs = jax.devices()
+    n = len(devs)
+    S = args.seq_len
+    assert S % n == 0, f"seq-len {S} must divide over {n} devices"
+    mesh = Mesh(np.asarray(devs), ("sp",))
+
+    cfg = models.TransformerConfig(
+        vocab_size=args.vocab, num_layers=2, num_heads=8, embed_dim=128,
+        max_seq_len=S, dtype=jnp.float32,
+        pos_encoding="rope" if args.rope else "learned")
+    impl = (ring_attention_impl("sp") if args.attention == "ring"
+            else ulysses_attention_impl("sp"))
+    model = models.TransformerLM(cfg, attn_impl=impl)
+
+    # A learnable synthetic language: next token = (cur * 3 + 1) % vocab,
+    # with occasional noise — perplexity falls fast if training works.
+    rng = np.random.RandomState(0)
+    toks = np.zeros(S + 1, np.int32)
+    for i in range(S):
+        toks[i + 1] = (toks[i] * 3 + 1) % args.vocab \
+            if rng.rand() > 0.05 else rng.randint(args.vocab)
+    tokens = jnp.asarray(toks[:S])[None, :]
+    targets = jnp.asarray(toks[1:S + 1])[None, :]
+    positions = jnp.arange(S)[None, :]
+
+    # init with the dense twin — attn_impl does not change the params
+    params = models.TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), tokens[:, :16])
+    opt = optax.adam(args.lr)
+    state = opt.init(params)
+
+    # The whole forward runs INSIDE shard_map: every array the model sees
+    # is its sequence shard, ring/Ulysses collectives ride the "sp" axis,
+    # and params (spec P()) replicate.
+    seq_sharding = NamedSharding(mesh, P(None, "sp"))
+    tokens = jax.device_put(tokens, seq_sharding)
+    targets = jax.device_put(targets, seq_sharding)
+    positions = jax.device_put(positions, seq_sharding)
+
+    def local_loss(p, tok, pos, tgt):
+        logits = model.apply(p, tok, positions=pos)
+        local_sum = optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).sum()
+        return jax.lax.psum(local_sum, "sp") / S
+
+    sharded_loss = jax.shard_map(
+        local_loss, mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(), check_vma=False)
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(
+            lambda p: sharded_loss(p, tokens, positions, targets))(p)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, l
+
+    l0 = None
+    for i in range(args.steps):
+        params, state, loss = step(params, state)
+        if i == 0:
+            l0 = float(loss)
+        if (i + 1) % 10 == 0:
+            print(f"step {i + 1}  loss {float(loss):.4f} "
+                  f"({S} tokens over {n} devices, {args.attention})")
+    lf = float(loss)
+    assert lf < l0, (l0, lf)
+    how = (f"ring attention streamed K/V around the mesh — no device "
+           f"materialized the {S}x{S} score matrix"
+           if args.attention == "ring" else
+           f"Ulysses all-to-all gave each device all {S} tokens for "
+           f"{cfg.num_heads}/{n} of the heads")
+    print(f"done: loss {l0:.4f} -> {lf:.4f}; per-device sequence shard "
+          f"{S // n} tokens; {how}")
+
+
+if __name__ == "__main__":
+    main()
