@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["save", "restore", "latest_step", "list_steps",
-           "broadcast_to_ranks", "consensus_average"]
+           "broadcast_to_ranks", "consensus_average", "AsyncSaver"]
 
 
 def _checkpointer():
@@ -90,6 +90,49 @@ def list_steps(path: str) -> list:
         return []
     return sorted(int(d.split("_")[1]) for d in os.listdir(path)
                   if d.startswith("step_") and d.split("_")[1].isdigit())
+
+
+class AsyncSaver:
+    """Background checkpoint writer: at most one write in flight.
+
+    ``save`` copies the tree to host SYNCHRONOUSLY (callers may donate or
+    overwrite device buffers on the next step), then hands the file write
+    to a single worker thread.  The previous write is always joined before
+    a new one starts, so step order on disk is preserved; ``flush`` joins
+    the outstanding write and surfaces its error on the calling thread —
+    and clears it either way, so a failed write raises exactly once.
+    """
+
+    def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="bf-ckpt-save")
+        self._pending = None
+
+    def save(self, path: str, tree: Any, *, step: Optional[int] = None,
+             wait: bool = False, after=None) -> None:
+        host = jax.tree.map(np.asarray, tree)
+
+        def write():
+            save(path, host, step=step)
+            if after is not None:
+                after()
+
+        self.flush()
+        self._pending = self._pool.submit(write)
+        if wait:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._pending is not None:
+            fut, self._pending = self._pending, None
+            fut.result()
+
+    def shutdown(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self._pool.shutdown(wait=True)
 
 
 def latest_step(path: str) -> Optional[int]:

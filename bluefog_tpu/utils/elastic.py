@@ -101,17 +101,22 @@ def _agreed_start(ckpt_dir: str, per_process: bool) -> int:
 def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
                 ckpt_dir: str, num_steps: int, save_every: int = 100,
                 keep: int = 3, per_process: bool = False,
-                on_step: Optional[Callable[[Any, int], None]] = None) -> Any:
+                on_step: Optional[Callable[[Any, int], None]] = None,
+                async_save: bool = True) -> Any:
     """Run ``state = step_fn(state, step)`` for ``num_steps`` steps with
     automatic checkpoint/resume.  Returns the final state.
 
     ``state`` is any pytree of (device or host) arrays; its structure is the
-    restore target, so NamedTuples/optax states round-trip intact.
+    restore target, so NamedTuples/custom states round-trip intact.
     ``step_fn`` must be deterministic in ``(state, step)`` for bit-exact
     resume (fold the step into your PRNG key; data order via
     ``data.DistributedSampler.set_epoch`` is already step-derivable).
     ``on_step`` runs after every step (logging, eval); it is not
     exactly-once — after a crash, replayed steps invoke it again.
+    ``async_save=True`` copies the state to host synchronously but writes
+    the file on a background worker, so training overlaps the disk write;
+    at most one write is in flight, and the preemption/final saves join it
+    before returning (the "checkpoint saved" promise stays durable).
     """
     if jax.process_count() > 1:
         if not per_process:
@@ -140,10 +145,16 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
     except ValueError:
         pass
 
-    def save(tree, step: int) -> None:
-        jax.block_until_ready(tree)
-        checkpoint.save(ckpt_dir, tree, step=step)
-        _prune(ckpt_dir, keep)
+    saver = checkpoint.AsyncSaver() if async_save else None
+
+    def save(tree, step: int, *, wait: bool) -> None:
+        if saver is None:
+            jax.block_until_ready(tree)
+            checkpoint.save(ckpt_dir, tree, step=step)
+            _prune(ckpt_dir, keep)
+            return
+        saver.save(ckpt_dir, tree, step=step, wait=wait,
+                   after=lambda: _prune(ckpt_dir, keep))
 
     try:
         for step in range(start, num_steps):
@@ -154,17 +165,31 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
             if preempt.is_set() and done < num_steps:
                 # (a preemption during the FINAL step falls through to the
                 # normal completion save/return — the work is already done)
-                save(state, done)
+                save(state, done, wait=True)
                 raise Preempted(done)
             if save_every and done % save_every == 0 and done < num_steps:
-                save(state, done)
-        save(state, num_steps)
+                save(state, done, wait=False)
+        save(state, num_steps, wait=True)
         return state
     finally:
+        if saver is not None:
+            import sys
+            propagating = sys.exc_info()[0] is not None
+            try:
+                saver.shutdown()
+            except Exception:
+                # Another exception is already propagating (step_fn error,
+                # Ctrl-C): don't let a stale background-write failure
+                # replace it — log and let the real error through.
+                if not propagating:
+                    raise
+                get_logger().exception(
+                    "elastic: background checkpoint write failed")
         if installed:
             # prev_handler is None when the prior handler was installed
-            # outside Python — unrepresentable, so fall back to the default
-            # disposition rather than leaving our stale lambda in place.
+            # outside Python — unrepresentable, so fall back to the
+            # default disposition rather than leaving our stale lambda
+            # in place.
             signal.signal(signal.SIGTERM,
                           prev_handler if prev_handler is not None
                           else signal.SIG_DFL)

@@ -112,6 +112,63 @@ def test_sigterm_saves_and_raises_preempted(tmp_path):
     assert int(out["count"]) == 5
 
 
+def test_sync_save_mode_matches_async(tmp_path):
+    step_fn, state0 = _make_step()
+    a = run_elastic(step_fn, state0, ckpt_dir=str(tmp_path / "a"),
+                    num_steps=6, save_every=2, async_save=True)
+    b = run_elastic(step_fn, state0, ckpt_dir=str(tmp_path / "b"),
+                    num_steps=6, save_every=2, async_save=False)
+    np.testing.assert_array_equal(np.asarray(a["x"]), np.asarray(b["x"]))
+    assert checkpoint.latest_step(str(tmp_path / "a")) == 6
+    assert checkpoint.latest_step(str(tmp_path / "b")) == 6
+
+
+def test_async_save_errors_surface_on_main_thread(tmp_path, monkeypatch):
+    """A failing background write must fail the run, not vanish into the
+    worker thread."""
+    from bluefog_tpu.utils import elastic
+    step_fn, state0 = _make_step()
+    calls = {"n": 0}
+    real_save = checkpoint.save
+
+    def flaky(path, tree, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise OSError("disk full")
+        return real_save(path, tree, **kw)
+
+    monkeypatch.setattr(elastic.checkpoint, "save", flaky)
+    with pytest.raises(OSError, match="disk full"):
+        run_elastic(step_fn, state0, ckpt_dir=str(tmp_path / "f"),
+                    num_steps=10, save_every=2)
+
+
+def test_background_write_error_does_not_mask_step_error(tmp_path,
+                                                         monkeypatch):
+    """A pending background-write failure must not replace a real step_fn
+    exception during unwinding."""
+    from bluefog_tpu.utils import elastic
+    step_fn, state0 = _make_step()
+    real_save = checkpoint.save
+    calls = {"n": 0}
+
+    def flaky(path, tree, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("disk full")
+        return real_save(path, tree, **kw)
+
+    monkeypatch.setattr(elastic.checkpoint, "save", flaky)
+
+    def poke(_s, step):
+        if step == 3:  # after the step-2 save was submitted (and failed)
+            raise RuntimeError("model blew up")
+
+    with pytest.raises(RuntimeError, match="model blew up"):
+        run_elastic(step_fn, state0, ckpt_dir=str(tmp_path / "m"),
+                    num_steps=10, save_every=2, on_step=poke)
+
+
 def test_sigterm_during_final_step_completes_normally(tmp_path):
     """A preemption notice landing on the last step must not turn a finished
     run into a Preempted restart."""
