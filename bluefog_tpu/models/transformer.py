@@ -190,6 +190,9 @@ class Block(nn.Module):
         d = cfg.embed_dim // h
         kv_h = cfg.num_kv_heads or h
         rope = getattr(cfg, "pos_encoding", "learned") == "rope"
+        if rope and positions is None and cache is None:
+            # standalone Block use (e.g. pipeline stages): local positions
+            positions = jnp.arange(x.shape[1])[None, :]
         y = nn.RMSNorm(dtype=cfg.dtype)(x)
         B, S = y.shape[0], y.shape[1]
         if kv_h == h:

@@ -1,0 +1,82 @@
+"""Train a tiny character LM and generate text with the KV cache.
+
+No reference counterpart (BlueFog predates LLM workloads).  Demonstrates
+the inference path: a Llama-style TransformerLM (GQA + RoPE + SwiGLU)
+memorizes a pangram, then ``models.transformer.generate`` continues a
+prompt through one batched prefill + a fused single-token decode scan —
+the KV cache stores the shared kv heads, so GQA shrinks it 4x here.
+
+    python examples/text_generation.py
+    python examples/text_generation.py --temperature 0.8   # sampled
+"""
+
+import argparse
+
+TEXT = ("the quick brown fox jumps over the lazy dog. "
+        "pack my box with five dozen liquor jugs. ")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--prompt", default="the quick brown ")
+    ap.add_argument("--max-new-tokens", type=int, default=48)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from bluefog_tpu.models import TransformerLM, TransformerConfig
+    from bluefog_tpu.models.transformer import generate
+
+    vocab = sorted(set(TEXT))
+    stoi = {c: i for i, c in enumerate(vocab)}
+    data = jnp.asarray([stoi[c] for c in TEXT * 4])[None, :]
+
+    cfg = TransformerConfig(
+        vocab_size=len(vocab), num_layers=2, num_heads=8, num_kv_heads=2,
+        embed_dim=128, max_seq_len=int(data.shape[1]),
+        pos_encoding="rope", mlp="swiglu", dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), data[:, :8])
+    opt = optax.adam(args.lr)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        def loss(p):
+            logits = model.apply(p, data[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, data[:, 1:]).mean()
+        l, g = jax.value_and_grad(loss)(p)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, l
+
+    for i in range(args.steps):
+        params, state, loss = step(params, state)
+        if (i + 1) % 100 == 0:
+            print(f"step {i + 1}  loss {float(loss):.4f}")
+
+    unknown = [c for c in args.prompt if c not in stoi]
+    if unknown:
+        raise SystemExit(f"prompt contains unseen characters: {unknown}")
+    prompt = jnp.asarray([stoi[c] for c in args.prompt])[None, :]
+    out = generate(model, params, prompt, args.max_new_tokens,
+                   temperature=args.temperature,
+                   rng=jax.random.PRNGKey(0))
+    text = "".join(vocab[int(t)] for t in np.asarray(out[0]))
+    print(f"prompt:    {args.prompt!r}")
+    print(f"generated: {text!r}")
+    if args.temperature == 0.0:
+        need = len(args.prompt) + args.max_new_tokens
+        want = (TEXT * (need // len(TEXT) + 2))[len(args.prompt):need]
+        assert text == want, (text, want)
+        print("greedy continuation matches the training text exactly")
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,14 @@ def test_benchmark_harness_tiny():
                  "--num-batches-per-iter", "2"])
 
 
+def test_text_generation_example(capsys):
+    """Train-then-generate round trip: greedy decoding reproduces the
+    memorized text exactly through the KV cache."""
+    run_example(f"{EXAMPLES}/text_generation.py",
+                ["--steps", "300", "--max-new-tokens", "32"])
+    assert "matches the training text exactly" in capsys.readouterr().out
+
+
 @pytest.mark.parametrize("attn", ["ring", "ulysses"])
 def test_long_context_training_example(attn, capsys):
     """Sequence-parallel LM training: loss falls with the sequence sharded
