@@ -39,6 +39,9 @@ def build_parser():
                              "win_put", "empty"])
     ap.add_argument("--atc", action="store_true",
                     help="adapt-then-combine order (default AWC)")
+    ap.add_argument("--compression", default="none",
+                    choices=["none", "bf16"],
+                    help="wire compression for the optimizer's collectives")
     ap.add_argument("--dynamic", action="store_true",
                     help="dynamic one-peer Exp2 topology")
     ap.add_argument("--image-size", type=int, default=224)
@@ -136,13 +139,21 @@ def measure(args, devices=None, quiet=False):
             "empty": CommunicationType.empty}.get(args.dist_optimizer)
     base = optax.sgd(0.0125 * n, momentum=0.9)
     if args.dist_optimizer == "gradient_allreduce":
-        opt = bf.optim.DistributedGradientAllreduceOptimizer(base)
+        opt = bf.optim.DistributedGradientAllreduceOptimizer(
+            base, compression=args.compression)
     elif args.dist_optimizer == "win_put":
+        if args.compression != "none":
+            # window payloads compress through the transport knob
+            import os
+            from bluefog_tpu.utils import config as _config
+            os.environ["BLUEFOG_TPU_WIN_COMPRESSION"] = args.compression
+            _config.reload()
         opt = bf.optim.DistributedWinPutOptimizer(base)
     else:
         cls = (bf.optim.DistributedAdaptThenCombineOptimizer if args.atc
                else bf.optim.DistributedAdaptWithCombineOptimizer)
-        opt = cls(base, comm, use_dynamic_topology=args.dynamic)
+        opt = cls(base, comm, use_dynamic_topology=args.dynamic,
+                  compression=args.compression)
 
     if has_bn:
         params = rank_major(variables["params"])

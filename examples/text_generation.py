@@ -35,6 +35,9 @@ def main():
 
     vocab = sorted(set(TEXT))
     stoi = {c: i for i, c in enumerate(vocab)}
+    unknown = [c for c in args.prompt if c not in stoi]
+    if unknown:  # fail before the expensive training loop
+        raise SystemExit(f"prompt contains unseen characters: {unknown}")
     data = jnp.asarray([stoi[c] for c in TEXT * 4])[None, :]
 
     cfg = TransformerConfig(
@@ -61,9 +64,6 @@ def main():
         if (i + 1) % 100 == 0:
             print(f"step {i + 1}  loss {float(loss):.4f}")
 
-    unknown = [c for c in args.prompt if c not in stoi]
-    if unknown:
-        raise SystemExit(f"prompt contains unseen characters: {unknown}")
     prompt = jnp.asarray([stoi[c] for c in args.prompt])[None, :]
     out = generate(model, params, prompt, args.max_new_tokens,
                    temperature=args.temperature,
@@ -71,7 +71,10 @@ def main():
     text = "".join(vocab[int(t)] for t in np.asarray(out[0]))
     print(f"prompt:    {args.prompt!r}")
     print(f"generated: {text!r}")
-    if args.temperature == 0.0:
+    if args.temperature == 0.0 and TEXT.startswith(args.prompt):
+        # Exact-match is only guaranteed for training-PREFIX prompts: a
+        # mid-text prompt starts generation from a zero-context boundary
+        # the model never trained on, so its first tokens drift.
         need = len(args.prompt) + args.max_new_tokens
         want = (TEXT * (need // len(TEXT) + 2))[len(args.prompt):need]
         assert text == want, (text, want)
