@@ -142,12 +142,17 @@ def measure(args, devices=None, quiet=False):
         opt = bf.optim.DistributedGradientAllreduceOptimizer(
             base, compression=args.compression)
     elif args.dist_optimizer == "win_put":
-        if args.compression != "none":
-            # window payloads compress through the transport knob
-            import os
-            from bluefog_tpu.utils import config as _config
-            os.environ["BLUEFOG_TPU_WIN_COMPRESSION"] = args.compression
-            _config.reload()
+        # Window payloads compress through the transport knob.  Set it
+        # unconditionally so "--compression none" overrides a pre-set env
+        # var and repeated in-process measure() calls stay self-consistent.
+        import os
+        from bluefog_tpu.utils import config as _config
+        os.environ["BLUEFOG_TPU_WIN_COMPRESSION"] = args.compression
+        _config.reload()
+        if args.compression != "none" and jax.process_count() == 1:
+            print("note: window compression applies to CROSS-PROCESS edges "
+                  "only; this single-process run sends nothing over the "
+                  "transport, so the flag does not change the measurement")
         opt = bf.optim.DistributedWinPutOptimizer(base)
     else:
         cls = (bf.optim.DistributedAdaptThenCombineOptimizer if args.atc

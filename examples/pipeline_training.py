@@ -1,0 +1,101 @@
+"""Pipeline-parallel training: a deep net split into one stage per device.
+
+Beyond the reference (data-parallel only, SURVEY §2.3).  Each device holds
+ONE layer of an n-layer tanh MLP; GPipe microbatches stream through the
+``parallel.pipeline_apply`` schedule (one ``lax.scan`` of
+``M + n - 1`` ticks, stage handoff = one ``ppermute`` hop per tick) and
+reverse-mode AD flows straight through it — no hand-written backward
+schedule.  The example trains a regression, checks the pipelined forward
+against running the layers sequentially, and asserts the loss fell.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/pipeline_training.py
+"""
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--microbatches", type=int, default=8)
+    ap.add_argument("--microbatch-size", type=int, default=16)
+    ap.add_argument("--width", type=int, default=32)
+    args = ap.parse_args()
+    if args.steps < 2:
+        ap.error("--steps must be >= 2 (the run asserts the loss fell)")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bluefog_tpu.parallel import pipeline_apply
+
+    devs = jax.devices()
+    n = len(devs)  # one pipeline stage per device
+    M, mb, d = args.microbatches, args.microbatch_size, args.width
+    mesh = Mesh(np.asarray(devs), ("pp",))
+
+    rng = np.random.RandomState(0)
+    # Stage i's parameters: stacked (n, d, d) weights + (n, d) biases,
+    # sharded P("pp") so each device holds exactly its own layer.
+    Ws = jnp.asarray(rng.randn(n, d, d) * (1.0 / np.sqrt(d)), jnp.float32)
+    bs = jnp.zeros((n, d), jnp.float32)
+    x = jnp.asarray(rng.randn(M, mb, d), jnp.float32)
+    w_true = jnp.asarray(rng.randn(d, d) * 0.3, jnp.float32)
+    y = jnp.tanh(x @ w_true)  # learnable target
+
+    def stage_fn(p, xb):
+        W, b = p
+        return jnp.tanh(xb @ W[0] + b[0])
+
+    def pp_forward(params, x):
+        return jax.shard_map(
+            lambda p, xb: pipeline_apply(stage_fn, p, xb, axis_name="pp"),
+            mesh=mesh, in_specs=((P("pp"), P("pp")), P()), out_specs=P(),
+            check_vma=False)(params, x)
+
+    def loss_fn(params):
+        return jnp.mean((pp_forward(params, x) - y) ** 2)
+
+    opt = optax.adam(args.lr)
+    params = (jax.device_put(Ws, NamedSharding(mesh, P("pp"))),
+              jax.device_put(bs, NamedSharding(mesh, P("pp"))))
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(loss_fn)(p)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, l
+
+    l0 = None
+    for i in range(args.steps):
+        params, state, loss = step(params, state)
+        if i == 0:
+            l0 = float(loss)
+        if (i + 1) % 50 == 0:
+            print(f"step {i + 1}  loss {float(loss):.5f} "
+                  f"({n} stages x {M} microbatches)")
+    lf = float(loss)
+
+    # correctness: the pipelined forward equals running layers sequentially
+    # (reference computed on-device too, so both use the backend's native
+    # matmul precision — TPU matmuls are bf16 by default)
+    Wd, bd = params
+    ref = x
+    for i in range(n):
+        ref = jnp.tanh(ref @ Wd[i] + bd[i])
+    got = np.asarray(pp_forward(params, x))
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    assert lf < l0, (l0, lf)
+    print(f"done: loss {l0:.5f} -> {lf:.5f}; pipelined forward matches the "
+          f"sequential stack (schedule depth {M + n - 1} ticks)")
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,14 @@ def test_benchmark_harness_tiny():
                  "--num-batches-per-iter", "2"])
 
 
+def test_pipeline_training_example(capsys):
+    """GPipe training: one stage per device, loss falls, pipelined forward
+    equals the sequential stack."""
+    run_example(f"{EXAMPLES}/pipeline_training.py", ["--steps", "60"])
+    out = capsys.readouterr().out
+    assert "matches the sequential stack" in out
+
+
 def test_text_generation_example(capsys):
     """Train-then-generate round trip: greedy decoding reproduces the
     memorized text exactly through the KV cache."""
