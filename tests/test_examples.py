@@ -69,6 +69,16 @@ def test_benchmark_harness_tiny():
                  "--num-batches-per-iter", "2"])
 
 
+def test_tensor_parallel_training_example(capsys):
+    """2-way dp x 4-way tp training: loss falls and the qkv kernel really
+    carries a tp-sharded layout."""
+    run_example(f"{EXAMPLES}/tensor_parallel_training.py",
+                ["--steps", "40"])
+    out = capsys.readouterr().out
+    assert "done: loss" in out
+    assert "kernel sharding PartitionSpec(None, 'tp')" in out
+
+
 def test_pipeline_training_example(capsys):
     """GPipe training: one stage per device, loss falls, pipelined forward
     equals the sequential stack."""
