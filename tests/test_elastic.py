@@ -270,13 +270,19 @@ def step_fn(state, step):
     return {"x": state["x"] * 2.0 + step}
 
 marker = os.environ["MARKER"]
+crashes = int(os.environ.get("CRASHES", "1"))
+# Captured ONCE at startup: re-reading inside poke would let the surviving
+# rank observe the crasher's fresh marker mid-incarnation and self-crash
+# in the same incarnation, collapsing two planned crashes into one.
+inc = len(open(marker).read()) if os.path.exists(marker) else 0
 
 def poke(_s, step):
-    # First incarnation only: rank 0 dies hard at step 5 (after the step-3
-    # saves) while rank 1 keeps running — bfrun must reap the gang.
-    if step + 1 == 5 and jax.process_index() == 0 \
-            and not os.path.exists(marker):
-        open(marker, "w").close()
+    # The first `crashes` incarnations die hard (alternating which rank) a
+    # couple of steps past a save boundary; survivors must be reaped.
+    if inc < crashes and step + 1 == 5 + inc \
+            and jax.process_index() == inc % 2:
+        with open(marker, "a") as f:
+            f.write("x")
         os._exit(1)
 
 out = run_elastic(step_fn, {"x": jnp.ones((2,), jnp.float32)},
@@ -291,24 +297,27 @@ print("GANG-OK", jax.process_index())
 
 
 @pytest.mark.slow
-def test_bfrun_gang_restart_completes_job(tmp_path):
-    """Full-stack fault tolerance: a rank crashes, bfrun --restarts kills
-    the survivor, relaunches the gang, and run_elastic resumes to the exact
-    uninterrupted result."""
+@pytest.mark.parametrize("crashes", [1, 2])
+def test_bfrun_gang_restart_completes_job(tmp_path, crashes):
+    """Full-stack fault tolerance: ranks crash (in successive incarnations,
+    alternating which rank dies), bfrun --restarts reaps the survivors,
+    relaunches the gang, and run_elastic resumes to the exact uninterrupted
+    result."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "gang.py"
     script.write_text(_GANG_SCRIPT.replace("@REPO@", repo))
     env = dict(os.environ, CKDIR=str(tmp_path / "ck"),
-               MARKER=str(tmp_path / "crashed-once"))
+               MARKER=str(tmp_path / "crash-count"),
+               CRASHES=str(crashes))
     out = subprocess.run(
         [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",
-         "--devices-per-proc", "2", "--restarts", "2",
+         "--devices-per-proc", "2", "--restarts", str(crashes),
          sys.executable, str(script)],
         capture_output=True, text=True, timeout=600, cwd=repo, env=env)
     assert out.returncode == 0, (
         f"stdout={out.stdout}\nstderr={out.stderr}")
     assert "restarting the gang" in out.stderr
-    assert "(attempt 1/2)" in out.stderr
+    assert f"(attempt {crashes}/{crashes})" in out.stderr
     assert out.stdout.count("GANG-OK") == 2, out.stdout
 
 
