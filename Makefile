@@ -1,0 +1,31 @@
+# Test / bench matrix (the role of the reference's Makefile:28-51, which ran
+# every pytest file under `mpirun -np 4`; here the fast tier runs on the
+# virtual 8-device CPU mesh in-process and the slow tier adds the real
+# multi-process `bfrun` launches).
+
+PYTEST = python -m pytest -q
+
+.PHONY: test test-fast test-slow test-all test-onchip bench native
+
+# Fast gate: < 3 min on the CPU mesh; run on every change.
+test: test-fast
+test-fast:
+	$(PYTEST) tests/ -m "not slow"
+
+# Slow tier: multi-process bfrun launches, example e2e runs, heavy model
+# grids, on-chip kernel checks (TPU tests self-skip without a chip).
+test-slow:
+	$(PYTEST) tests/ -m "slow"
+
+test-all:
+	$(PYTEST) tests/
+
+# On-chip subset only (flash/mosaic kernels compiled for the real TPU).
+test-onchip:
+	$(PYTEST) tests/ -m "slow" -k "on_tpu"
+
+bench:
+	python bench.py
+
+native:
+	$(MAKE) -C bluefog_tpu/native

@@ -24,6 +24,7 @@ Nonblocking semantics: JAX dispatch is already asynchronous, so
 
 from __future__ import annotations
 
+import collections
 import threading
 from functools import partial
 from typing import Dict, List, Optional, Union
@@ -400,6 +401,65 @@ def out_neighbor_machine_ranks(rank_: Optional[int] = None) -> List[int]:
 # SPMD plumbing
 # ---------------------------------------------------------------------------
 
+_inflight_depth: Optional[int] = None
+
+
+def _max_inflight() -> int:
+    global _inflight_depth
+    if _inflight_depth is not None:
+        return _inflight_depth
+    import os as _os
+    v = _os.environ.get("BLUEFOG_TPU_MAX_INFLIGHT")
+    if v is not None:
+        try:
+            depth = int(v)
+        except ValueError:
+            depth = -1
+        if depth < 1:
+            raise ValueError(
+                f"BLUEFOG_TPU_MAX_INFLIGHT must be a positive integer, "
+                f"got {v!r}")
+    # The CPU backend executes collectives on the host thread pool; skewed
+    # in-flight programs occupy threads waiting for peers, so the safe depth
+    # scales with cores (measured: depth 16 deadlocks a 1-core host, 8 is
+    # the observed ceiling there — keep a 2x margin).  TPU runtimes have
+    # their own flow control; 32 just bounds buffer liveness.
+    elif jax.default_backend() == "cpu":
+        depth = max(4, min(16, _os.cpu_count() or 1))
+    else:
+        depth = 32
+    _inflight_depth = depth
+    return depth
+
+
+def _throttle(out):
+    """Bound cross-process async-dispatch depth.
+
+    JAX dispatch is asynchronous; in a multi-process run a fast process can
+    race arbitrarily many compiled programs ahead of a slow peer.  The XLA
+    CPU collectives (gloo) deadlock when that skew approaches ~100 programs
+    (bounded rendezvous capacity), and on any backend unbounded skew holds
+    live buffers for every in-flight step.  This keeps a sliding window of
+    recent results and blocks on the one ``BLUEFOG_TPU_MAX_INFLIGHT``
+    (default 32) dispatches back — preserving pipelining while keeping all
+    processes within a bounded number of programs of each other (the
+    structural analogue of the reference's bounded tensor queue,
+    ``tensor_queue.h:30-66``)."""
+    if jax.process_count() <= 1:
+        return out
+    dq = _ctx.__dict__.setdefault("_inflight", collections.deque())
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves:
+        dq.append(leaves[0])
+        if len(dq) > _max_inflight():
+            old = dq.popleft()
+            try:
+                jax.block_until_ready(old)
+            except Exception:  # noqa: BLE001 — error surfaces at the owner
+                pass
+    return out
+
+
 def _rank_sharding() -> NamedSharding:
     return NamedSharding(_require_init().mesh, P(RANK_AXIS))
 
@@ -439,7 +499,8 @@ def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
             out_specs=P(RANK_AXIS)))
     from bluefog_tpu.utils.timeline import op_span
     with op_span(str(key[0]), "ENQUEUE"):
-        return _jitted(("flat", key, len(extra)), build)(_place(x), *extra)
+        return _throttle(
+            _jitted(("flat", key, len(extra)), build)(_place(x), *extra))
 
 
 def _dispatch_hier(key, fn, x, *extra) -> jnp.ndarray:
@@ -454,7 +515,8 @@ def _dispatch_hier(key, fn, x, *extra) -> jnp.ndarray:
             out_specs=P((MACHINE_AXIS, LOCAL_AXIS))))
     from bluefog_tpu.utils.timeline import op_span
     with op_span(str(key[0]), "ENQUEUE"):
-        return _jitted(("hier", key, len(extra)), build)(_place(x), *extra)
+        return _throttle(
+            _jitted(("hier", key, len(extra)), build)(_place(x), *extra))
 
 
 def _weight_override_matrix(

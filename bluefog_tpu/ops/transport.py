@@ -36,10 +36,15 @@ OP_FENCE_ACK = 6
 OP_MUTEX_ACQ = 7
 OP_MUTEX_GRANT = 8
 OP_MUTEX_REL = 9
+# Flag bit ORed into the op byte when the payload is bf16-compressed (an f32
+# window row shipped as bfloat16).  An explicit wire flag — never inferred
+# from payload size — so a future partial-row or batched payload can't be
+# silently misdecoded as compressed data.
+OP_BF16_FLAG = 0x40
 
 __all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
            "OP_GET_REPLY", "OP_FENCE_REQ", "OP_FENCE_ACK", "OP_MUTEX_ACQ",
-           "OP_MUTEX_GRANT", "OP_MUTEX_REL"]
+           "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BF16_FLAG"]
 
 
 class WindowTransport:

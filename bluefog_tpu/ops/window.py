@@ -67,7 +67,7 @@ __all__ = [
 #   MUTEX_REL  src=requesting rank, dst=rank whose mutex
 from bluefog_tpu.ops.transport import (  # noqa: E402
     OP_PUT, OP_ACCUMULATE, OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
-    OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL)
+    OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL, OP_BF16_FLAG)
 
 _MSG_TIMEOUT_SEC = 300.0  # hard cap on waiting for a peer's reply
 
@@ -96,8 +96,24 @@ class _Window:
                     else self.main[src].copy()
                 self.staging[(dst, src)] = init
         self.versions = np.zeros((n, n), dtype=np.int64)
+        # Counts OVERWRITES (put / get-reply) per slot, distinct from
+        # `versions` (any update): win_update's unlocked combine uses it to
+        # tell whether a slot changed mid-combine by accumulation only —
+        # in which case the consumed snapshot must be subtracted — or was
+        # overwritten, in which case the new content stands on its own.
+        self.overwrites = np.zeros((n, n), dtype=np.int64)
+        # Counts self-publishes to main[r] (win_put's self_weight scaling):
+        # a publish landing mid-combine serializes AFTER the update — the
+        # swap must not clobber it with the pre-publish combine result.
+        self.main_versions = np.zeros(n, dtype=np.int64)
         self.mutexes = [threading.RLock() for _ in range(n)]
         self.lock = threading.RLock()           # store-structure lock
+        # Serializes whole win_update calls against each other (snapshot →
+        # combine → swap must not interleave between two updates, or one
+        # update's swap would mis-read the other's version resets).  The
+        # drain thread never takes this lock — puts stay concurrent with
+        # the combine, which is the point of the lock split.
+        self.update_lock = threading.Lock()
         # associated-P scalars (push-sum weights); self starts at 1.0
         self.p_main = np.ones(n)
         self.p_staging: Dict[tuple, float] = {k: 0.0 for k in self.staging}
@@ -351,10 +367,11 @@ def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
         payload = np.empty(0, np.uint8)
     elif (payload.size and payload.dtype == np.float32
           and config.get().win_compression == "bf16"):
-        # Halve the DCN bytes per gossip edge.  No wire flag needed: an
-        # f32 window's payload at half the expected length can only be
-        # bf16, so the receiver detects it from the size (_payload_row).
+        # Halve the DCN bytes per gossip edge; the op byte carries an
+        # explicit flag so the receiver never has to infer compression
+        # from the payload size.
         payload = payload.astype(_BF16)
+        op |= OP_BF16_FLAG
     d.transport.send(host, port, op, name, src, dst, weight, payload,
                      p_weight)
 
@@ -366,12 +383,23 @@ def _send_to_rank_owner(rank: int, op: int, name: str, src: int, dst: int,
                   weight, p_weight, payload)
 
 
-def _payload_row(win: _Window, payload: bytes) -> np.ndarray:
+def _payload_row(win: _Window, payload: bytes,
+                 compressed: bool = False) -> np.ndarray:
     expected = int(np.prod(win.shape)) * win.dtype.itemsize
-    if (len(payload) * 2 == expected and win.dtype == np.float32):
-        # bf16-compressed edge (sender had BLUEFOG_TPU_WIN_COMPRESSION=bf16)
+    if compressed:
+        # bf16-compressed edge (sender had BLUEFOG_TPU_WIN_COMPRESSION=bf16),
+        # declared by the OP_BF16_FLAG wire bit.
+        if len(payload) * 2 != expected:
+            raise ValueError(
+                f"window {win.name!r}: bf16-flagged payload of {len(payload)} "
+                f"bytes does not match half a {expected}-byte row")
         return np.frombuffer(payload, dtype=_BF16).astype(
             win.dtype).reshape(win.shape)
+    if len(payload) != expected:
+        raise ValueError(
+            f"window {win.name!r}: payload of {len(payload)} bytes does not "
+            f"match the {expected}-byte row (shape {win.shape}, "
+            f"dtype {win.dtype})")
     return np.frombuffer(payload, dtype=win.dtype).reshape(win.shape).copy()
 
 
@@ -447,6 +475,9 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
     """Drain-thread entry: apply one inbound transport message to the local
     (owned) window state.  Must never block on peers — replies and mutex
     holds are pushed onto the worker pool."""
+    orig_op = op  # parked/replayed messages must keep the wire flag bits
+    compressed = bool(op & OP_BF16_FLAG)
+    op &= ~OP_BF16_FLAG
     d = _store.distrib
     if d is None:
         with _store.lock:
@@ -454,7 +485,7 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
                 # Directory not installed yet (peer finished init first):
                 # buffer — init_transport replays in arrival order.
                 _store.preinit_msgs.append(
-                    (op, name, src, dst, weight, p_weight, payload))
+                    (orig_op, name, src, dst, weight, p_weight, payload))
                 return
             d = _store.distrib
     if op == OP_FENCE_REQ:
@@ -484,14 +515,14 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
             # SPMD skew: the peer created + wrote this window before our
             # win_create ran.  Park; win_create replays in arrival order.
             d.parked.setdefault(name, []).append(
-                (op, name, src, dst, weight, p_weight, payload))
+                (orig_op, name, src, dst, weight, p_weight, payload))
             return
     if op in (OP_PUT, OP_ACCUMULATE):
         # Deliberately mutex-free: the drain thread must never block on a
         # rank mutex (a remote holder's REL would be queued behind us —
         # deadlock).  Slot atomicity comes from win.lock; writer exclusion
         # is the sender's job via the distributed mutex (_remote_mutex).
-        row = _payload_row(win, payload)
+        row = _payload_row(win, payload, compressed)
         with win.lock:
             if (dst, src) not in win.staging:
                 return
@@ -499,6 +530,7 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
                 win.staging[(dst, src)] += row * win.dtype.type(weight)
             else:
                 win.staging[(dst, src)] = row * win.dtype.type(weight)
+                win.overwrites[dst, src] += 1
             win.versions[dst, src] += 1
             if _store.associated_p_enabled:
                 if op == OP_ACCUMULATE:
@@ -508,10 +540,11 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
     elif op == OP_GET_REQ:
         _store.svc_pool.submit(_reply_get, name, src, dst, weight)
     elif op == OP_GET_REPLY:
-        row = _payload_row(win, payload)
+        row = _payload_row(win, payload, compressed)
         with win.lock:
             if (dst, src) in win.staging:
                 win.staging[(dst, src)] = row * win.dtype.type(weight)
+                win.overwrites[dst, src] += 1
                 win.versions[dst, src] += 1
                 if _store.associated_p_enabled:
                     win.p_staging[(dst, src)] = p_weight
@@ -675,6 +708,7 @@ def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
                     win.staging[(dst, src)] += payload
                 else:
                     win.staging[(dst, src)] = payload.copy()
+                    win.overwrites[dst, src] += 1
                 win.versions[dst, src] += 1
                 if _store.associated_p_enabled:
                     if accumulate:
@@ -697,6 +731,7 @@ def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
             sw_vec = sw if sw.ndim else np.full(win.n, float(sw))
             for r in _owned_ranks(win.n):
                 win.main[r] = scaled[r]
+                win.main_versions[r] += 1
                 if _store.associated_p_enabled:
                     win.p_main[r] *= sw_vec[r]
 
@@ -782,6 +817,7 @@ def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
                 if (dst, src) not in win.staging:
                     continue
                 win.staging[(dst, src)] = win.main[src] * win.dtype.type(w)
+                win.overwrites[dst, src] += 1
                 win.versions[dst, src] += 1
                 if _store.associated_p_enabled:
                     win.p_staging[(dst, src)] = w * win.p_main[src]
@@ -862,7 +898,16 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
 
     Multi-process: only rows of ranks owned by this process are combined and
     returned fresh (every process runs the same update for its own ranks);
-    other rows of the returned array are this process's last-known copies."""
+    other rows of the returned array are this process's last-known copies.
+
+    Locking: ``win.lock`` is held only to SNAPSHOT the inputs and to SWAP the
+    results back — the O(n·indeg·size) combine itself runs unlocked, so the
+    transport drain thread is never serialized behind it (reference analogue:
+    ``MPI_Win_sync`` is a memory barrier, not a critical section over the
+    combine, ``mpi_controller.cc:890-915``).  A put that lands mid-combine is
+    detected by its version bump and its staging slot survives the
+    ``reset_weights`` wipe — equivalent to serializing that put after this
+    update."""
     from bluefog_tpu.utils.timeline import op_span
     win = _store.get(name)
     owned = _owned_ranks(win.n)
@@ -871,8 +916,10 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
         for r in owned:  # only owned mutexes matter — remote writers to my
             win.mutexes[r].acquire()   # staging serialize on my owner locks
             acquired.append(win.mutexes[r])
-    try:
-        with op_span(f"win_update.{name}", "UPDATE"), win.lock:
+    win.update_lock.acquire()  # one update at a time per window: a
+    acquired.append(win.update_lock)   # concurrent update's swap must not
+    try:                               # mis-read this one's version resets
+        with op_span(f"win_update.{name}", "UPDATE"):
             if (self_weight is None) != (neighbor_weights is None):
                 raise ValueError(
                     "self_weight and neighbor_weights have to be presented at "
@@ -886,33 +933,73 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
                     neighbor_weights, win.in_nbrs, 1.0, peer_is_src=True)
             self_w_vec = self_w if isinstance(self_w, np.ndarray) \
                 else np.full(win.n, float(self_w))
-            out = win.main.copy()
-            p_out = win.p_main.copy()
-            # Combine + reset are scoped to owned ranks: rows owned by other
-            # processes stay untouched (their owners run the same update),
-            # and version counters reset per updated target only — one
-            # rank's update never wipes another's staleness counters
-            # (reference per-target semantics, mpi_context.cc:91-113).
+            # -- snapshot (under lock, O(copy) only) ------------------------
+            with win.lock:
+                out = win.main.copy()
+                p_out = win.p_main.copy()
+                stag = {(dst, src): win.staging[(dst, src)].copy()
+                        for dst in owned for src in win.in_nbrs[dst]
+                        if (dst, src) in win.staging}
+                p_stag = {k: win.p_staging[k] for k in stag}
+                ver = win.versions.copy()
+                ow = win.overwrites.copy()
+                mver = win.main_versions.copy()
+            # -- combine (no locks held) ------------------------------------
             for dst in owned:
-                acc = np.asarray(win.main[dst] * self_w_vec[dst],
-                                 dtype=win.dtype)
-                p_acc = win.p_main[dst] * self_w_vec[dst]
+                acc = np.asarray(out[dst] * self_w_vec[dst], dtype=win.dtype)
+                p_acc = p_out[dst] * self_w_vec[dst]
                 for src in win.in_nbrs[dst]:
                     w = nbr_w.get((dst, src))
-                    if w is None or (dst, src) not in win.staging:
+                    if w is None or (dst, src) not in stag:
                         continue
-                    acc = acc + win.staging[(dst, src)] * win.dtype.type(w)
-                    p_acc += w * win.p_staging[(dst, src)]
+                    acc = acc + stag[(dst, src)] * win.dtype.type(w)
+                    p_acc += w * p_stag[(dst, src)]
                 out[dst] = acc
                 p_out[dst] = p_acc
-                win.versions[dst, :] = 0
-                if reset_weights:
+            # -- swap (under lock) ------------------------------------------
+            # Scoped to owned ranks: rows owned by other processes stay
+            # untouched (their owners run the same update), and version
+            # counters reset per consumed edge only — one rank's update never
+            # wipes another's staleness counters (reference per-target
+            # semantics, mpi_context.cc:91-113).  Edges whose version moved
+            # since the snapshot carry a put this combine did not see: their
+            # counter and staging survive for the next update.
+            with win.lock:
+                for dst in owned:
+                    if win.main_versions[dst] == mver[dst]:
+                        win.main[dst] = out[dst]
+                    # else: a self-publish landed mid-combine; it serializes
+                    # after this update and must not be clobbered by the
+                    # pre-publish combine result.  The returned array still
+                    # reports this update's result (pre-publish), as a
+                    # serialized update-then-publish would.
                     for src in win.in_nbrs[dst]:
-                        win.staging[(dst, src)][:] = 0
-                        win.p_staging[(dst, src)] = 0.0
-            win.main[:] = out
-            if _store.associated_p_enabled:
-                win.p_main[:] = p_out
+                        if (dst, src) not in win.staging:
+                            continue
+                        delta = win.versions[dst, src] - ver[dst, src]
+                        if delta <= 0:  # update_lock makes <0 impossible;
+                            # guard anyway — a negative delta must never
+                            # reach the subtraction branch below
+                            win.versions[dst, src] = 0
+                            if reset_weights:
+                                win.staging[(dst, src)][:] = 0
+                                win.p_staging[(dst, src)] = 0.0
+                            continue
+                        # Updates landed mid-combine: they serialize AFTER
+                        # this update, so only they remain pending.
+                        win.versions[dst, src] = delta
+                        if (reset_weights
+                                and win.overwrites[dst, src] == ow[dst, src]):
+                            # Accumulates only: the slot holds
+                            # consumed-snapshot + new mass; remove the
+                            # consumed part so collected mass is not
+                            # double-counted (push-sum conservation).  An
+                            # overwrite (put/get) stands on its own.
+                            win.staging[(dst, src)] -= stag[(dst, src)]
+                            win.p_staging[(dst, src)] -= p_stag[(dst, src)]
+                    if (_store.associated_p_enabled
+                            and win.main_versions[dst] == mver[dst]):
+                        win.p_main[dst] = p_out[dst]
             return jnp.asarray(out)
     finally:
         for m in acquired:

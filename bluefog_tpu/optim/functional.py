@@ -97,6 +97,7 @@ def make_combiner(
         def _ar(x, step=None, weights=None):
             _no_weights(weights, "CommunicationType.allreduce")
             return C.allreduce(x, axis_name, average=True)
+        _ar.is_allreduce = True  # replica-identical: compress without residual
         return _ar
     if comm == CommunicationType.neighbor_allreduce:
         if dyn_sched is not None:
@@ -286,9 +287,22 @@ def dist_init(base: optax.GradientTransformation, params) -> DistOptState:
 def step_fn(order: str, base: optax.GradientTransformation,
             combine: Combiner, *, axis_name: str,
             steps_per_comm: int = 1, fuse: bool = True,
-            compression: str = "none") -> Callable:
-    """Bind an execution order to a ``(params, grads, state[, weights])`` fn."""
-    combine = compress_combiner(combine, compression)
+            compression: str = "none",
+            residual: Optional[bool] = None) -> Callable:
+    """Bind an execution order to a ``(params, grads, state[, weights])`` fn.
+
+    ``residual`` controls difference compression under ``compression='bf16'``.
+    A global-consensus allreduce must keep replicas bit-identical, so the
+    per-rank quantization residual is NOT re-added after combining (with
+    residual the drift is bf16-scale and re-averaged each round, but the
+    replica-identical invariant is worth more than the residual's accuracy
+    for that order); decentralized combiners keep difference compression.
+    Callers that know the communication type should pass this explicitly
+    (``optim.optimizers`` does); with ``None`` it falls back to the
+    ``is_allreduce`` tag ``make_combiner`` sets."""
+    if residual is None:
+        residual = not getattr(combine, "is_allreduce", False)
+    combine = compress_combiner(combine, compression, residual=residual)
     if order == "awc":
         return partial(awc_step, base, combine,
                        steps_per_comm=steps_per_comm, fuse=fuse)

@@ -142,10 +142,15 @@ class DistributedOptimizer:
             sched=sched, dyn_sched=dyn,
             local_axis=LOCAL_AXIS if hier else None,
             machine_axis=MACHINE_AXIS if hier else None)
-        inner = F.step_fn(self.order, self.base, combine,
-                          axis_name=RANK_AXIS,
-                          steps_per_comm=self.num_steps_per_communication,
-                          fuse=self.fusion, compression=self.compression)
+        inner = F.step_fn(
+            self.order, self.base, combine,
+            axis_name=RANK_AXIS,
+            steps_per_comm=self.num_steps_per_communication,
+            fuse=self.fusion, compression=self.compression,
+            # Explicit residual policy: a global-consensus allreduce must
+            # stay replica-bit-identical under compression.
+            residual=(self.communication_type
+                      != CommunicationType.allreduce))
         mesh = ctx.hier_mesh if hier else ctx.mesh
         spec = P((MACHINE_AXIS, LOCAL_AXIS)) if hier else P(RANK_AXIS)
 
@@ -200,8 +205,9 @@ class DistributedOptimizer:
         params, grads = placed
         fn = self._step_callable(with_weights=w is not None)
         if w is None:
-            return fn(params, grads, state)
-        return fn(params, grads, state, jnp.asarray(w, jnp.float32))
+            return basics._throttle(fn(params, grads, state))
+        return basics._throttle(
+            fn(params, grads, state, jnp.asarray(w, jnp.float32)))
 
 
 # ---------------------------------------------------------------------------

@@ -2,8 +2,8 @@
 
 Parity targets (reference ``torch/optimizers.py``):
   * ``_DistributedWinOptimizer`` (:844-1024) -> ``DistributedWinPutOptimizer``
-    (push style) and ``DistributedPullGetOptimizer`` (pull style): per-parameter
-    named windows; each step pushes (or pulls) parameters along the topology's
+    (push style) and ``DistributedPullGetOptimizer`` (pull style): named
+    windows; each step pushes (or pulls) parameters along the topology's
     edges and combines via ``win_update``.
   * ``_DistributedPushSumOptimizer`` (:1026-1178) -> ``DistributedPushSumOptimizer``:
     column-stochastic ``win_accumulate`` of the parameters together with the
@@ -17,11 +17,26 @@ they are the *async gossip* family, deliberately outside jit: communication
 overlaps compute via the store's worker pool, mirroring the reference's
 nonblocking RMA + finalizer threads.  The local "adapt" math is still jitted
 (vmapped over the rank axis).
+
+Fusion: by default (``fuse=True``) the whole parameter pytree travels through
+ONE window — each rank's leaves raveled into a single flat row — so a model
+with hundreds of parameters issues one transport message per edge per step
+instead of one per (leaf, edge).  This mirrors the collective family's
+``ravel_pytree`` fusion (``optim/functional.py``) and the reference's fusion
+buffer (``tensor_queue.h:70-92``); ``fuse=False`` keeps per-leaf windows (the
+reference's per-parameter layout, ``torch/optimizers.py:933-944``).
+
+Multi-process semantics: each process is authoritative for the ranks of its
+local devices only.  ``step`` returns rank-major trees whose NON-owned rows
+are frozen at their value from the previous step's input — they are never
+silently installed from stale window copies (each process trains its own
+ranks, exactly like the reference's one-tensor-per-process model).  Use
+:meth:`gather` to materialize every rank's fresh parameters for evaluation.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
@@ -45,22 +60,91 @@ def _leaf_names(tree, prefix: str):
 
 
 class _WindowOptimizerBase:
-    """Shared plumbing: per-leaf windows + vmapped local base update."""
+    """Shared plumbing: fused (or per-leaf) windows + vmapped local update."""
 
     def __init__(self, base: optax.GradientTransformation, *,
-                 window_prefix: str, num_steps_per_communication: int = 1):
+                 window_prefix: str, num_steps_per_communication: int = 1,
+                 fuse: bool = True):
         self.base = base
         self.window_prefix = window_prefix
         self.num_steps_per_communication = int(num_steps_per_communication)
-        self._names = None
+        self.fuse = bool(fuse)
+        self._names: List[str] = None
         self._update_fn = None
+        self._n = 0
+        self._shapes = None   # per-leaf (n, *rest) shapes, fused mode
+        self._dtypes = None   # per-leaf dtypes (concatenate promotes; cast back)
+        self._splits = None   # np.cumsum of per-leaf flat sizes, fused mode
 
+    # -- payload layout ----------------------------------------------------
+    def _payloads(self, tree) -> List[np.ndarray]:
+        """Rank-major arrays to ship, one per window (1 when fused)."""
+        leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+        if not self.fuse:
+            return leaves
+        return [np.concatenate([x.reshape(self._n, -1) for x in leaves],
+                               axis=1)]
+
+    def _rebuild(self, arrays: List, like):
+        """Inverse of :meth:`_payloads` — back to the pytree structure."""
+        treedef = jax.tree_util.tree_structure(like)
+        if self.fuse:
+            flat = np.asarray(arrays[0])
+            parts = np.split(flat, self._splits[:-1], axis=1)
+            # Cast back to each leaf's own dtype: the fused concatenate
+            # promoted mixed-precision trees to a common wire dtype.
+            leaves = [p.reshape(s).astype(d)
+                      for p, s, d in zip(parts, self._shapes, self._dtypes)]
+        else:
+            leaves = arrays
+        return jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(x) for x in leaves])
+
+    def _merge_owned(self, prev, new):
+        """Freeze non-owned rows (multi-process): rows of ranks owned by
+        other processes keep their previous value instead of receiving
+        stale window copies."""
+        if W._store.distrib is None:
+            return new
+        mask = np.zeros(self._n, bool)
+        mask[W._owned_ranks(self._n)] = True
+
+        def one(p, q):
+            m = jnp.asarray(mask.reshape((-1,) + (1,) * (jnp.ndim(q) - 1)))
+            return jnp.where(m, q, p)
+        return jax.tree.map(one, prev, new)
+
+    def gather(self, params):
+        """Materialize every rank's authoritative rows (for evaluation):
+        allgathers owned rows across processes; identity single-process."""
+        d = W._store.distrib
+        if d is None:
+            return params
+        from jax.experimental import multihost_utils
+        owner = np.array([d.rank_owner[r] for r in range(self._n)])
+        rows = np.arange(self._n)
+
+        def one(leaf):
+            g = np.asarray(multihost_utils.process_allgather(
+                np.asarray(leaf)))
+            return jnp.asarray(g[owner, rows])
+        return jax.tree.map(one, params)
+
+    # -- lifecycle ---------------------------------------------------------
     def init(self, params) -> DistOptState:
         basics._require_init()
-        self._names = _leaf_names(params, self.window_prefix)
-        for name, leaf in zip(self._names,
-                              jax.tree_util.tree_leaves(params)):
-            W.win_create(np.asarray(leaf), name, zero_init=self._zero_init)
+        self._n = basics.size()
+        leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
+        if self.fuse:
+            self._shapes = [x.shape for x in leaves]
+            self._dtypes = [x.dtype for x in leaves]
+            sizes = [int(np.prod(s[1:])) for s in self._shapes]
+            self._splits = np.cumsum(sizes)
+            self._names = [f"{self.window_prefix}.fused"]
+        else:
+            self._names = _leaf_names(params, self.window_prefix)
+        for name, payload in zip(self._names, self._payloads(params)):
+            W.win_create(payload, name, zero_init=self._zero_init)
         base = self.base
 
         def init_one(p):
@@ -92,28 +176,29 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
     ``bf.win_put`` and is re-resolvable every call (dynamic topologies)."""
 
     def __init__(self, base, *, window_prefix: str = "winput",
-                 num_steps_per_communication: int = 1):
+                 num_steps_per_communication: int = 1, fuse: bool = True):
         super().__init__(base, window_prefix=window_prefix,
-                         num_steps_per_communication=num_steps_per_communication)
+                         num_steps_per_communication=num_steps_per_communication,
+                         fuse=fuse)
 
     def step(self, params, grads, state: DistOptState, *,
              dst_weights=None, require_mutex: bool = True):
         new_params, base_state = self._local_adapt(params, grads, state)
         t = int(state.step)
         if (t + 1) % self.num_steps_per_communication == 0:
+            payloads = self._payloads(new_params)
             handles = [
-                W.win_put_nonblocking(np.asarray(leaf), name,
+                W.win_put_nonblocking(payload, name,
                                       dst_weights=dst_weights,
                                       require_mutex=require_mutex)
-                for name, leaf in zip(self._names,
-                                      jax.tree_util.tree_leaves(new_params))]
+                for name, payload in zip(self._names, payloads)]
             for h in handles:
                 W.win_wait(h)
             combined = [W.win_update(name, require_mutex=require_mutex)
                         for name in self._names]
-            treedef = jax.tree_util.tree_structure(params)
-            new_params = jax.tree_util.tree_unflatten(treedef, combined)
-        return new_params, DistOptState(base_state, state.step + 1)
+            new_params = self._rebuild(combined, params)
+        return (self._merge_owned(params, new_params),
+                DistOptState(base_state, state.step + 1))
 
 
 class DistributedPullGetOptimizer(_WindowOptimizerBase):
@@ -122,21 +207,22 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
     ``torch/optimizers.py:1225``)."""
 
     def __init__(self, base, *, window_prefix: str = "pullget",
-                 num_steps_per_communication: int = 1):
+                 num_steps_per_communication: int = 1, fuse: bool = True):
         super().__init__(base, window_prefix=window_prefix,
-                         num_steps_per_communication=num_steps_per_communication)
+                         num_steps_per_communication=num_steps_per_communication,
+                         fuse=fuse)
 
     def step(self, params, grads, state: DistOptState, *,
              src_weights=None, require_mutex: bool = True):
         new_params, base_state = self._local_adapt(params, grads, state)
         t = int(state.step)
         if (t + 1) % self.num_steps_per_communication == 0:
+            payloads = self._payloads(new_params)
             # Publish my new parameters as the window's exposed memory (the
             # dst_weights={} put touches no edges — it only refreshes main).
-            publish = [W.win_put_nonblocking(np.asarray(leaf), name,
+            publish = [W.win_put_nonblocking(payload, name,
                                              self_weight=1.0, dst_weights={})
-                       for name, leaf in zip(
-                           self._names, jax.tree_util.tree_leaves(new_params))]
+                       for name, payload in zip(self._names, payloads)]
             for h in publish:
                 W.win_wait(h)
             handles = [W.win_get_nonblocking(name, src_weights=src_weights,
@@ -146,9 +232,9 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
                 W.win_wait(h)
             combined = [W.win_update(name, require_mutex=require_mutex)
                         for name in self._names]
-            treedef = jax.tree_util.tree_structure(params)
-            new_params = jax.tree_util.tree_unflatten(treedef, combined)
-        return new_params, DistOptState(base_state, state.step + 1)
+            new_params = self._rebuild(combined, params)
+        return (self._merge_owned(params, new_params),
+                DistOptState(base_state, state.step + 1))
 
 
 class DistributedPushSumOptimizer(_WindowOptimizerBase):
@@ -165,9 +251,10 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
     _zero_init = True
 
     def __init__(self, base, *, window_prefix: str = "pushsum",
-                 num_steps_per_communication: int = 1):
+                 num_steps_per_communication: int = 1, fuse: bool = True):
         super().__init__(base, window_prefix=window_prefix,
-                         num_steps_per_communication=num_steps_per_communication)
+                         num_steps_per_communication=num_steps_per_communication,
+                         fuse=fuse)
 
     def init(self, params) -> DistOptState:
         W.turn_on_win_ops_with_associated_p()
@@ -200,24 +287,23 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
             dst_weights = self._outgoing_weights()
         self_share = self._self_share()
         collected = []
-        for name, leaf in zip(self._names,
-                              jax.tree_util.tree_leaves(new_params)):
+        for name, payload in zip(self._names, self._payloads(new_params)):
             # win_accumulate applies self_weight AFTER the edge sends, so the
             # out-edges carry w * p_old and per-source mass
             # (self_share + sum_out w == 1) is conserved — the push-sum
             # column-stochastic invariant.
             h = W.win_accumulate_nonblocking(
-                np.asarray(leaf), name, self_weight=self_share,
+                payload, name, self_weight=self_share,
                 dst_weights=dst_weights, require_mutex=require_mutex)
             W.win_wait(h)
             collected.append(W.win_update_then_collect(
                 name, require_mutex=require_mutex))
-        treedef = jax.tree_util.tree_structure(params)
-        new_params = jax.tree_util.tree_unflatten(treedef, collected)
-        return new_params, DistOptState(base_state, state.step + 1)
+        new_params = self._rebuild(collected, params)
+        return (self._merge_owned(params, new_params),
+                DistOptState(base_state, state.step + 1))
 
     def associated_p(self) -> np.ndarray:
-        """(n,) push-sum weight vector (identical across leaves)."""
+        """(n,) push-sum weight vector (identical across leaves/windows)."""
         return W.win_associated_p(self._names[0])
 
     def debias(self, params):

@@ -96,6 +96,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _launch_shell(tag: str, rank: int, run_cmd: str,
+                  piddir: str = "/tmp") -> str:
+    """The remote launch command for one gang rank.
+
+    ``setsid`` puts the rank in its own session, so the shell's PID (written
+    to the tag pidfile) is the process-group id of every descendant;
+    ``_remote_signal`` kills the whole group.  A bare ``pkill -f tag`` would
+    only reach this shell — the training process carries no tag in its argv.
+    The traps remove the pidfile on normal exit and on TERM, so healthy runs
+    leave no litter; the KILL path cleans up via ``_remote_signal``."""
+    pidfile = shlex.quote(f"{piddir}/{tag}.{rank}.pid")
+    inner = (f"echo $$ > {pidfile}; "
+             f"trap 'rm -f {pidfile}; exit 143' TERM INT; "
+             f"trap 'rm -f {pidfile}' EXIT; " + run_cmd)
+    return f"setsid sh -c {shlex.quote(inner)}"
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="bfrun", description=__doc__,
@@ -186,8 +203,9 @@ def main(argv=None) -> int:
                         f"{k}={shlex.quote(v)}" for k, v in env.items()
                         if k.startswith(("BFTPU_", "XLA_", "JAX_",
                                          "BLUEFOG")))
-                    remote = f"cd {shlex.quote(os.getcwd())} && {exports} " \
-                             + " ".join(shlex.quote(c) for c in cmd)
+                    run_cmd = (f"cd {shlex.quote(os.getcwd())} && {exports} "
+                               + " ".join(shlex.quote(c) for c in cmd))
+                    remote = _launch_shell(tag, rank, run_cmd)
                     entries.append((subprocess.Popen(
                         ["ssh", "-p", str(args.ssh_port), host, remote]),
                         host, True))
@@ -210,12 +228,32 @@ def main(argv=None) -> int:
 
 
 def _remote_signal(host: str, ssh_port: int, tag: str, sig: str) -> None:
-    """Signal every remote process carrying this gang tag (killing the
+    """Signal every remote process group of this gang tag (killing the
     local ssh client only drops the connection; without a TTY the remote
-    command keeps running)."""
+    command keeps running).
+
+    Each rank's launch shell ran under ``setsid`` and wrote its PID — the
+    group id of all its descendants — to ``/tmp/<tag>.<rank>.pid``, so
+    ``kill -- -PGID`` reaches the training process even though its argv
+    carries no tag.  A ``pkill -f`` fallback covers shells that have not
+    reached the pidfile write.  EVERY occurrence of the tag in this command
+    brackets its first character (``[b]frun-...``): as a glob that still
+    matches the literal pidfile paths, and as the pkill regex it still
+    matches the launch shells' command lines — but this kill shell's own
+    cmdline now contains only bracketed forms, which the regex does not
+    match, so the kill shell never signals itself mid-cleanup.  KILL also
+    removes the pidfiles (TERM leaves them for the launch shells' own
+    TERM/EXIT traps)."""
+    btag = f"[{tag[0]}]{tag[1:]}"
+    cleanup = f"rm -f /tmp/{btag}.*.pid; " if sig == "KILL" else ""
+    # `kill -s SIG -- -PGID` is the POSIX form: dash's builtin rejects the
+    # `kill -SIG -- -PGID` spelling ("Illegal number").
+    script = (
+        f"for f in /tmp/{btag}.*.pid; do "
+        f"[ -f \"$f\" ] && kill -s {sig} -- -\"$(cat \"$f\")\" 2>/dev/null; "
+        f"done; {cleanup}pkill -{sig} -f {shlex.quote(btag)}; true")
     subprocess.run(
-        ["ssh", "-p", str(ssh_port), host,
-         f"pkill -{sig} -f {shlex.quote(tag)} || true"],
+        ["ssh", "-p", str(ssh_port), host, script],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, timeout=30,
         check=False)
 

@@ -32,6 +32,27 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _host_copy(tree: Any) -> Any:
+    """Copy a pytree to host numpy, rejecting globally-sharded arrays early.
+
+    Checkpoint state must be process-local or replicated: an array whose
+    shards live on other hosts cannot be host-copied here, and silently
+    zero-filling the missing rows would write corrupt data.  Callers holding
+    global rank-major state should either save the consensus average
+    (``save(..., average_ranks=True)`` on a gathered copy) or re-shard to
+    per-process state first (``jax.experimental.multihost_utils``)."""
+    def one(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            raise ValueError(
+                "checkpoint: array with non-addressable shards "
+                f"(shape {x.shape}, sharding {x.sharding}); checkpoint "
+                "state must be process-local or replicated — gather it "
+                "(multihost_utils.process_allgather) or save per-process "
+                "shards explicitly")
+        return np.asarray(x)
+    return jax.tree.map(one, tree)
+
+
 def consensus_average(tree):
     """Average the rank replicas (leading axis) of every leaf."""
     return jax.tree.map(lambda x: jnp.mean(x, axis=0), tree)
@@ -52,7 +73,7 @@ def save(path: str, tree: Any, *, step: Optional[int] = None,
     replicas (smaller and the usual evaluation artifact)."""
     if average_ranks:
         tree = consensus_average(tree)
-    tree = jax.tree.map(np.asarray, tree)  # host-side, device-agnostic
+    tree = _host_copy(tree)  # host-side, device-agnostic
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step:010d}")
@@ -111,7 +132,7 @@ class AsyncSaver:
 
     def save(self, path: str, tree: Any, *, step: Optional[int] = None,
              wait: bool = False, after=None) -> None:
-        host = jax.tree.map(np.asarray, tree)
+        host = _host_copy(tree)
 
         def write():
             save(path, host, step=step)

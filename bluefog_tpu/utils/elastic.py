@@ -15,7 +15,9 @@ run loop itself is restartable:
     deterministic ``step_fn``, reproduces the uninterrupted run bit-exactly.
 
 Multi-process runs pass ``per_process=True``: each process writes its own
-directory (its addressable shards), and on restart the resume step is agreed
+directory — its state must be process-local or replicated (globally-sharded
+arrays are rejected by ``checkpoint._host_copy``; gather or re-shard them
+before saving) — and on restart the resume step is agreed
 as the newest step *every* process has durably saved (set intersection, not
 ``min(latest)`` — pruning or save skew may have deleted a slow process's
 frontier elsewhere), so a crash that interleaves with a save cannot resume

@@ -5,6 +5,10 @@ import sys
 
 import pytest
 
+# Examples pay a full model build + training loop each; they are the slow
+# e2e tier (run ``pytest -m slow`` or the full suite before shipping).
+pytestmark = pytest.mark.slow
+
 EXAMPLES = "examples"
 
 

@@ -10,6 +10,7 @@ the per-machine mean (``mpi_ops.py:92-104``).
 """
 
 import numpy as np
+import pytest
 
 import bluefog_tpu as bf
 from bluefog_tpu import topology as topo
@@ -130,6 +131,7 @@ def test_dynamic_hierarchical_matches_exp2_machine_walk():
             np.testing.assert_allclose(out[r], expect, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_schedule_cache_churn_no_stale_reuse():
     """Churn >128 distinct weight overrides through neighbor_allreduce: the
     FIFO schedule eviction must never let a compiled closure serve a stale

@@ -81,6 +81,7 @@ def test_shutdown_unpauses_stall_watchdog():
     assert not _monitor._paused
 
 
+@pytest.mark.slow
 def test_ibfrun_command_mode_virtual_mesh(tmp_path):
     """ibfrun -np 4 <cmd> prepares the virtual mesh for cmd — including the
     platform pin, which the injected sitecustomize must supply (site hooks
@@ -99,6 +100,7 @@ def test_ibfrun_command_mode_virtual_mesh(tmp_path):
     assert "DEVS 4" in out.stdout
 
 
+@pytest.mark.slow
 def test_ibfrun_piped_repl_session(tmp_path):
     """A real interactive session: cells piped into the launched REPL —
     init (boot), consensus, suspend, blocked op, resume, consensus again."""

@@ -27,6 +27,7 @@ def test_mlp_and_logreg_forward():
     assert lr.apply(lr.init(jax.random.PRNGKey(0), x2), x2).shape == (5, 3)
 
 
+@pytest.mark.slow
 def test_resnet18_forward_and_bn_state():
     m = models.ResNet18(num_classes=10, dtype=jnp.float32)
     x = jnp.zeros((2, 32, 32, 3))
@@ -39,6 +40,7 @@ def test_resnet18_forward_and_bn_state():
     assert out_eval.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_resnet50_param_count():
     """ResNet-50 must be the real thing: ~25.6M parameters."""
     m = models.ResNet50(num_classes=1000, dtype=jnp.float32)
@@ -60,6 +62,7 @@ def test_transformer_forward():
     assert logits.shape == (2, 16, 100)
 
 
+@pytest.mark.slow
 def test_transformer_gqa_and_mqa():
     """Grouped-query attention: fewer K/V projection params, same output
     shape, finite grads; flash kernel agrees with dense on GQA shapes."""
@@ -153,6 +156,7 @@ def test_transformer_rope_flash_matches_dense():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_transformer_swiglu_trains():
     import jax
     import jax.numpy as jnp
@@ -187,6 +191,7 @@ def test_transformer_swiglu_trains():
     assert float(loss(params)) < l0
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("variant", ["mha", "gqa_rope_swiglu"])
 def test_transformer_kv_cache_decode_matches_forward(variant):
     """Teacher-forced single-token decoding through the KV cache must
@@ -220,6 +225,7 @@ def test_transformer_kv_cache_decode_matches_forward(variant):
                                np.asarray(full), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_transformer_generate_greedy_and_sampled():
     import jax
     import jax.numpy as jnp
@@ -273,6 +279,7 @@ def test_transformer_gqa_validates_divisibility():
         TransformerConfig(mlp="swiglu", num_experts=4)
 
 
+@pytest.mark.slow
 def test_transformer_remat_matches_plain():
     """cfg.remat=True (jax.checkpoint per block) must not change outputs or
     gradients — only the backward's memory/recompute schedule."""
@@ -352,6 +359,7 @@ def test_chunked_loss_uneven_chunk_fits_down():
     np.testing.assert_allclose(float(out), float(ref), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_switch_moe_transformer_trains():
     """num_experts>0 swaps each block's MLP for a switch MoE; the model
     trains (loss falls) and router + expert weights all receive grads."""
@@ -438,6 +446,7 @@ def test_switch_moe_expert_parallel_sharding_matches():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_switch_moe_ragged_group_padding():
     """T not divisible by router_group_size: tokens pad to whole groups and
     the output slices back — no silent group-size collapse."""
@@ -456,6 +465,7 @@ def test_switch_moe_ragged_group_padding():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 def test_vgg16_forward_and_grad():
     import jax
     import jax.numpy as jnp

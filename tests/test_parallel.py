@@ -54,6 +54,7 @@ def test_ulysses_attention_matches_dense(devices, qkv, causal):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_grad_matches_dense(devices, qkv):
     """Differentiability: ring attention must backprop like dense."""
     q, k, v = qkv
@@ -75,6 +76,7 @@ def test_ring_attention_grad_matches_dense(devices, qkv):
                                rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_transformer_with_ring_attention(devices):
     """End-to-end: TransformerLM forward with sequence-parallel attention
     equals the single-device model."""
@@ -295,6 +297,7 @@ def test_pipeline_grads_match_sequential(devices):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_transformer_blocks(devices):
     """Pipeline the TransformerLM's blocks across 2 stages: equals the
     single-device model applied to the same microbatches."""

@@ -155,6 +155,7 @@ def test_metrics_writer_rank0_suffixed_without_bfrun_env(tmp_path,
     assert w.path.endswith("m.0.jsonl")
 
 
+@pytest.mark.slow
 def test_benchmark_metrics_file(tmp_path):
     import json as _json
     import runpy
@@ -193,6 +194,7 @@ def test_checkpoint_consensus_average_and_rebroadcast(tmp_path):
     assert expanded["w"].shape == (8, 3)
 
 
+@pytest.mark.slow
 def test_bfrun_local_fanout(tmp_path):
     """bfrun spawns N local processes with the rendezvous env; each process
     reports its BFTPU_* identity."""
@@ -309,6 +311,7 @@ def test_parse_hosts_errors():
         parse_hosts(":3", 1)
 
 
+@pytest.mark.slow
 def test_bfrun_host_slots_local(tmp_path):
     """-H 127.0.0.1:3 launches 3 local processes with distinct global ranks
     and slot-major local ids."""
@@ -373,3 +376,50 @@ def test_packaging_metadata():
     assert meta["tool"]["setuptools"]["dynamic"]["version"]["attr"] == \
         "bluefog_tpu.version.__version__"
     assert __version__
+
+
+def test_remote_gang_kill_process_group(tmp_path, monkeypatch):
+    """The remote-rank kill path end-to-end, with ssh swapped for a local
+    shell: the setsid'd launch shell's pidfile names the process GROUP, a
+    TERM through ``_remote_signal`` kills the whole group (dash's builtin
+    needs the ``kill -s SIG -- -PGID`` spelling), the launch shell's traps
+    remove the pidfile, and a clean exit leaves no litter either."""
+    import unittest.mock as mock
+    from bluefog_tpu.run import run as R
+
+    real_run = subprocess.run
+    tag = "bfrun-gang-" + "testdeadbeef"
+    pidfile_path = tmp_path / f"{tag}.0.pid"
+    # The PRODUCT's launch recipe (not a copy): same builder main() uses.
+    subprocess.Popen(
+        R._launch_shell(tag, 0, "sleep 30", piddir=str(tmp_path)),
+        shell=True)
+    deadline = time.monotonic() + 5
+    while not pidfile_path.exists():
+        assert time.monotonic() < deadline, "launch shell never wrote pidfile"
+        time.sleep(0.05)
+    pgid = int(pidfile_path.read_text())
+
+    def fake_ssh(argv, **kw):  # run the remote script locally
+        kw.pop("timeout", None)
+        script = argv[-1].replace("/tmp/", f"{tmp_path}/")
+        return real_run(["sh", "-c", script], **kw)
+
+    with mock.patch.object(R.subprocess, "run", side_effect=fake_ssh):
+        R._remote_signal("fakehost", 22, tag, "TERM")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            os.killpg(pgid, 0)
+            time.sleep(0.05)
+        except ProcessLookupError:
+            break
+    else:
+        raise AssertionError("process group survived TERM")
+    assert not pidfile_path.exists(), "pidfile leaked after TERM"
+
+    # Clean exit must remove the pidfile too (no litter from healthy runs).
+    subprocess.run(R._launch_shell(tag, 0, "true", piddir=str(tmp_path)),
+                   shell=True)
+    time.sleep(0.3)
+    assert not pidfile_path.exists(), "pidfile leaked after clean exit"

@@ -92,6 +92,7 @@ def _global_lstsq(Xs, ys):
 
 @pytest.mark.parametrize("mode", ["gradient_allreduce", "neighbor_allreduce",
                                   "allreduce"])
+@pytest.mark.slow
 def test_torch_distributed_optimizer_end_to_end(mode):
     """Full decentralized training loop through the torch frontend: module
     replicas + per-rank optimizers + the DistributedOptimizer wrapper reach

@@ -276,9 +276,9 @@ print("MULTIPROC-WIN-OK", jax.process_index())
 """
 
 
-def test_payload_row_autodetects_bf16():
-    """An f32 window's payload at half the expected byte length can only be
-    bf16 — the receiver upcasts without any wire flag."""
+def test_payload_row_bf16_wire_flag():
+    """bf16 compression is declared by the OP_BF16_FLAG wire bit, never
+    inferred from the payload size; size mismatches are rejected loudly."""
     import jax.numpy as jnp
     from bluefog_tpu.ops import window as W
     bf.init()
@@ -287,11 +287,19 @@ def test_payload_row_autodetects_bf16():
     assert bf.win_create(x, "pw")
     win = W._store.get("pw")
     row = x[1]
-    plain = W._payload_row(win, row.tobytes())
+    plain = W._payload_row(win, row.tobytes(), compressed=False)
     np.testing.assert_array_equal(plain, row)
-    comp = W._payload_row(win, row.astype(jnp.bfloat16).tobytes())
+    comp = W._payload_row(win, row.astype(jnp.bfloat16).tobytes(),
+                          compressed=True)
     np.testing.assert_allclose(comp, row, rtol=1e-2)
     assert comp.dtype == np.float32
+    # A half-length payload WITHOUT the flag is an error, not silent bf16.
+    with pytest.raises(ValueError):
+        W._payload_row(win, row.astype(jnp.bfloat16).tobytes(),
+                       compressed=False)
+    # A full-length payload WITH the flag is likewise rejected.
+    with pytest.raises(ValueError):
+        W._payload_row(win, row.tobytes(), compressed=True)
     bf.win_free("pw")
 
 
