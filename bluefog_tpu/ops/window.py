@@ -96,12 +96,6 @@ class _Window:
                     else self.main[src].copy()
                 self.staging[(dst, src)] = init
         self.versions = np.zeros((n, n), dtype=np.int64)
-        # Counts OVERWRITES (put / get-reply) per slot, distinct from
-        # `versions` (any update): win_update's unlocked combine uses it to
-        # tell whether a slot changed mid-combine by accumulation only —
-        # in which case the consumed snapshot must be subtracted — or was
-        # overwritten, in which case the new content stands on its own.
-        self.overwrites = np.zeros((n, n), dtype=np.int64)
         # Counts self-publishes to main[r] (win_put's self_weight scaling):
         # a publish landing mid-combine serializes AFTER the update — the
         # swap must not clobber it with the pre-publish combine result.
@@ -530,7 +524,6 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
                 win.staging[(dst, src)] += row * win.dtype.type(weight)
             else:
                 win.staging[(dst, src)] = row * win.dtype.type(weight)
-                win.overwrites[dst, src] += 1
             win.versions[dst, src] += 1
             if _store.associated_p_enabled:
                 if op == OP_ACCUMULATE:
@@ -544,7 +537,6 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         with win.lock:
             if (dst, src) in win.staging:
                 win.staging[(dst, src)] = row * win.dtype.type(weight)
-                win.overwrites[dst, src] += 1
                 win.versions[dst, src] += 1
                 if _store.associated_p_enabled:
                     win.p_staging[(dst, src)] = p_weight
@@ -708,7 +700,6 @@ def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
                     win.staging[(dst, src)] += payload
                 else:
                     win.staging[(dst, src)] = payload.copy()
-                    win.overwrites[dst, src] += 1
                 win.versions[dst, src] += 1
                 if _store.associated_p_enabled:
                     if accumulate:
@@ -817,7 +808,6 @@ def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
                 if (dst, src) not in win.staging:
                     continue
                 win.staging[(dst, src)] = win.main[src] * win.dtype.type(w)
-                win.overwrites[dst, src] += 1
                 win.versions[dst, src] += 1
                 if _store.associated_p_enabled:
                     win.p_staging[(dst, src)] = w * win.p_main[src]
@@ -904,10 +894,12 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
     results back — the O(n·indeg·size) combine itself runs unlocked, so the
     transport drain thread is never serialized behind it (reference analogue:
     ``MPI_Win_sync`` is a memory barrier, not a critical section over the
-    combine, ``mpi_controller.cc:890-915``).  A put that lands mid-combine is
-    detected by its version bump and its staging slot survives the
-    ``reset_weights`` wipe — equivalent to serializing that put after this
-    update."""
+    combine, ``mpi_controller.cc:890-915``).  With ``reset_weights`` the
+    staging buffers are MOVED out at snapshot time (fresh zero buffers swap
+    in, no copy): a put or accumulate landing mid-combine writes into the
+    fresh buffer and is pending for the next update — exactly the serialize-
+    after ordering, with no double-counted mass.  Without ``reset_weights``
+    the staging is copied at snapshot and left in place."""
     from bluefog_tpu.utils.timeline import op_span
     win = _store.get(name)
     owned = _owned_ranks(win.n)
@@ -933,73 +925,82 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
                     neighbor_weights, win.in_nbrs, 1.0, peer_is_src=True)
             self_w_vec = self_w if isinstance(self_w, np.ndarray) \
                 else np.full(win.n, float(self_w))
-            # -- snapshot (under lock, O(copy) only) ------------------------
+            # -- snapshot (under lock; moves for reset, copies otherwise) ---
+            stag: Dict[tuple, np.ndarray] = {}
+            p_stag: Dict[tuple, float] = {}
             with win.lock:
                 out = win.main.copy()
                 p_out = win.p_main.copy()
-                stag = {(dst, src): win.staging[(dst, src)].copy()
-                        for dst in owned for src in win.in_nbrs[dst]
-                        if (dst, src) in win.staging}
-                p_stag = {k: win.p_staging[k] for k in stag}
+                p_snap = win.p_main.copy()  # pre-combine P, for publish
+                for dst in owned:           # reconciliation in the swap
+                    for src in win.in_nbrs[dst]:
+                        k = (dst, src)
+                        if k not in win.staging:
+                            continue
+                        if reset_weights:
+                            # Move: consume the slot now.  Zero-fill is
+                            # lazy-paged — far cheaper than a copy.
+                            stag[k] = win.staging[k]
+                            win.staging[k] = np.zeros(win.shape, win.dtype)
+                            p_stag[k] = win.p_staging[k]
+                            win.p_staging[k] = 0.0
+                            win.versions[dst, src] = 0
+                        else:
+                            stag[k] = win.staging[k].copy()
+                            p_stag[k] = win.p_staging[k]
                 ver = win.versions.copy()
-                ow = win.overwrites.copy()
                 mver = win.main_versions.copy()
-            # -- combine (no locks held) ------------------------------------
+            # -- combine (no locks held; in-place, one scratch buffer) ------
+            tmp = np.empty(win.shape, win.dtype)
             for dst in owned:
-                acc = np.asarray(out[dst] * self_w_vec[dst], dtype=win.dtype)
+                acc = out[dst]
+                np.multiply(acc, win.dtype.type(self_w_vec[dst]), out=acc)
                 p_acc = p_out[dst] * self_w_vec[dst]
                 for src in win.in_nbrs[dst]:
                     w = nbr_w.get((dst, src))
                     if w is None or (dst, src) not in stag:
                         continue
-                    acc = acc + stag[(dst, src)] * win.dtype.type(w)
+                    np.multiply(stag[(dst, src)], win.dtype.type(w), out=tmp)
+                    np.add(acc, tmp, out=acc)
                     p_acc += w * p_stag[(dst, src)]
-                out[dst] = acc
                 p_out[dst] = p_acc
             # -- swap (under lock) ------------------------------------------
             # Scoped to owned ranks: rows owned by other processes stay
             # untouched (their owners run the same update), and version
             # counters reset per consumed edge only — one rank's update never
             # wipes another's staleness counters (reference per-target
-            # semantics, mpi_context.cc:91-113).  Edges whose version moved
-            # since the snapshot carry a put this combine did not see: their
-            # counter and staging survive for the next update.
+            # semantics, mpi_context.cc:91-113).
             with win.lock:
                 for dst in owned:
                     if win.main_versions[dst] == mver[dst]:
                         win.main[dst] = out[dst]
-                    # else: a self-publish landed mid-combine; it serializes
-                    # after this update and must not be clobbered by the
-                    # pre-publish combine result.  The returned array still
-                    # reports this update's result (pre-publish), as a
-                    # serialized update-then-publish would.
-                    for src in win.in_nbrs[dst]:
-                        if (dst, src) not in win.staging:
-                            continue
-                        delta = win.versions[dst, src] - ver[dst, src]
-                        if delta <= 0:  # update_lock makes <0 impossible;
-                            # guard anyway — a negative delta must never
-                            # reach the subtraction branch below
-                            win.versions[dst, src] = 0
-                            if reset_weights:
-                                win.staging[(dst, src)][:] = 0
-                                win.p_staging[(dst, src)] = 0.0
-                            continue
-                        # Updates landed mid-combine: they serialize AFTER
-                        # this update, so only they remain pending.
-                        win.versions[dst, src] = delta
-                        if (reset_weights
-                                and win.overwrites[dst, src] == ow[dst, src]):
-                            # Accumulates only: the slot holds
-                            # consumed-snapshot + new mass; remove the
-                            # consumed part so collected mass is not
-                            # double-counted (push-sum conservation).  An
-                            # overwrite (put/get) stands on its own.
-                            win.staging[(dst, src)] -= stag[(dst, src)]
-                            win.p_staging[(dst, src)] -= p_stag[(dst, src)]
-                    if (_store.associated_p_enabled
-                            and win.main_versions[dst] == mver[dst]):
-                        win.p_main[dst] = p_out[dst]
+                        if _store.associated_p_enabled:
+                            win.p_main[dst] = p_out[dst]
+                    elif _store.associated_p_enabled:
+                        # A self-publish landed mid-combine; it serializes
+                        # after this update.  For main that means the
+                        # publish value stands (a publish REPLACES main, so
+                        # the combine result is superseded either way).  P
+                        # is MULTIPLICATIVE (publish does p_main *= sw), so
+                        # serialize-after means p = p_combined * sw: apply
+                        # the publishes' accumulated factor on top of the
+                        # combined mass, or the consumed staging P would
+                        # vanish and push-sum conservation break.
+                        factor = (win.p_main[dst] / p_snap[dst]
+                                  if p_snap[dst] != 0.0 else 1.0)
+                        win.p_main[dst] = p_out[dst] * factor
+                    # The returned array still reports this update's result
+                    # (pre-publish), as a serialized update-then-publish
+                    # would.
+                    if not reset_weights:
+                        # Consume-in-place semantics: counters drop to the
+                        # number of updates that landed mid-combine (those
+                        # serialize after this update).
+                        for src in win.in_nbrs[dst]:
+                            if (dst, src) not in win.staging:
+                                continue
+                            delta = win.versions[dst, src] - ver[dst, src]
+                            win.versions[dst, src] = max(0, delta)
             return jnp.asarray(out)
     finally:
         for m in acquired:

@@ -42,7 +42,8 @@ def _host_copy(tree: Any) -> Any:
     (``save(..., average_ranks=True)`` on a gathered copy) or re-shard to
     per-process state first (``jax.experimental.multihost_utils``)."""
     def one(x):
-        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        if (isinstance(x, jax.Array) and not x.is_fully_addressable
+                and not x.is_fully_replicated):
             raise ValueError(
                 "checkpoint: array with non-addressable shards "
                 f"(shape {x.shape}, sharding {x.sharding}); checkpoint "
