@@ -79,7 +79,32 @@ def build_parser():
                          "instead of a learned table")
     ap.add_argument("--swiglu", action="store_true",
                     help="transformer model: SwiGLU MLP instead of GELU")
+    ap.add_argument("--num-layers", type=int, default=4,
+                    help="transformer model: number of blocks")
+    ap.add_argument("--embed-dim", type=int, default=512,
+                    help="transformer model: model width")
+    ap.add_argument("--num-heads", type=int, default=8,
+                    help="transformer model: attention heads")
+    ap.add_argument("--vocab-size", type=int, default=32000)
+    ap.add_argument("--momentum", type=float, default=0.9,
+                    help="SGD momentum (0 drops the accumulator — one "
+                         "params-sized buffer, matters for billion-param "
+                         "configs on one chip)")
+    ap.add_argument("--mfu", action="store_true",
+                    help="transformer model: also report model FLOPs "
+                         "utilization from the measured tok/s")
+    ap.add_argument("--peak-tflops", type=float, default=197.0,
+                    help="accelerator peak (bf16) TFLOP/s for --mfu "
+                         "(default: TPU v5e)")
     return ap
+
+
+def transformer_train_flops_per_token(args, params_total: int) -> float:
+    """Training FLOPs per token: 6*N for the parameter matmuls (fwd 2N +
+    bwd 4N) plus the attention scores/values term 12*L*S*d (*0.5 causal),
+    the standard PaLM-appendix accounting."""
+    attn = 12 * args.num_layers * args.seq_len * args.embed_dim * 0.5
+    return 6.0 * params_total + attn
 
 
 def measure(args, devices=None, quiet=False):
@@ -114,6 +139,8 @@ def measure(args, devices=None, quiet=False):
         has_bn = False
     else:
         cfg = models.TransformerConfig(
+            vocab_size=args.vocab_size, num_layers=args.num_layers,
+            num_heads=args.num_heads, embed_dim=args.embed_dim,
             max_seq_len=args.seq_len, remat=args.remat,
             num_experts=args.num_experts,
             num_kv_heads=args.num_kv_heads or None,
@@ -130,6 +157,11 @@ def measure(args, devices=None, quiet=False):
 
     sample = data[0][:2]
     variables = model.init(jax.random.PRNGKey(0), sample)
+    # Stashed for --mfu reporting in main() (measure()'s return shape is
+    # pinned by callers).
+    args._params_total = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(
+            variables["params"] if "params" in variables else variables))
     rank_major = lambda t: jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t)
 
@@ -137,10 +169,10 @@ def measure(args, devices=None, quiet=False):
             "allreduce": CommunicationType.allreduce,
             "hierarchical": CommunicationType.hierarchical_neighbor_allreduce,
             "empty": CommunicationType.empty}.get(args.dist_optimizer)
-    base = optax.sgd(0.0125 * n, momentum=0.9)
+    base = optax.sgd(0.0125 * n, momentum=args.momentum or None)
     if args.dist_optimizer == "gradient_allreduce":
         opt = bf.optim.DistributedGradientAllreduceOptimizer(
-            base, compression=args.compression)
+            base, compression=args.compression, donate=True)
     elif args.dist_optimizer == "win_put":
         # Window payloads compress through the transport knob.  Set it
         # unconditionally so "--compression none" overrides a pre-set env
@@ -157,8 +189,11 @@ def measure(args, devices=None, quiet=False):
     else:
         cls = (bf.optim.DistributedAdaptThenCombineOptimizer if args.atc
                else bf.optim.DistributedAdaptWithCombineOptimizer)
+        # donate: the loop rebinds params/state every batch, so the step
+        # may alias them — one params-sized buffer saved, decisive at
+        # billion-parameter scale.
         opt = cls(base, comm, use_dynamic_topology=args.dynamic,
-                  compression=args.compression)
+                  compression=args.compression, donate=True)
 
     if has_bn:
         params = rank_major(variables["params"])
@@ -279,6 +314,21 @@ def main():
     print(f"total {unit}/sec: {mean:.1f} +- {ci:.1f} "
           f"({mean / n:.1f}/device, model={args.model}, "
           f"optimizer={args.dist_optimizer})")
+
+    if args.mfu and args.model == "transformer":
+        if args.num_experts:
+            # Switch MoE activates one expert per token; 6*N over ALL
+            # expert weights would overstate FLOPs/token several-fold.
+            print("note: --mfu accounting covers dense models only "
+                  "(top-1 MoE activates 1 of --num-experts expert MLPs "
+                  "per token); skipping the MFU report")
+        else:
+            fpt = transformer_train_flops_per_token(args, args._params_total)
+            mfu = mean / n * fpt / (args.peak_tflops * 1e12)
+            print(f"params: {args._params_total/1e9:.3f}B  "
+                  f"train FLOPs/token: {fpt/1e9:.2f}G  "
+                  f"MFU: {100*mfu:.1f}% of {args.peak_tflops:.0f} "
+                  "TFLOP/s/chip")
 
     if args.efficiency and n > 1:
         mean1, _, _ = measure(args, devices=jax.devices()[:1], quiet=True)

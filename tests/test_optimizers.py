@@ -330,3 +330,23 @@ def test_push_sum_optimizer_converges():
     w = np.asarray(debiased["w"])
     spread = np.abs(w - w.mean(axis=0, keepdims=True)).max()
     assert spread < 0.2, f"push-sum consensus failed: spread {spread}"
+
+
+def test_donate_matches_undonated():
+    """``donate=True`` (buffer aliasing for billion-param configs) must be
+    numerically identical to the default step."""
+    bf.init(lambda: topo.ExponentialGraph(N))
+    A, y, _ = make_problem()
+    outs = {}
+    for donate in (False, True):
+        opt = bf.optim.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.05), donate=donate)
+        params = {"w": jnp.asarray(
+            np.random.RandomState(1).randn(N, DIM, 1) * 2.0)}
+        state = opt.init(params)
+        compute_grads = grad_fn(A, y)
+        for _ in range(5):
+            grads = compute_grads(params)
+            params, state = opt.step(params, grads, state)
+        outs[donate] = np.asarray(params["w"]).copy()
+    np.testing.assert_array_equal(outs[True], outs[False])
