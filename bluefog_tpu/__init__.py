@@ -51,6 +51,7 @@ from bluefog_tpu.basics import (  # noqa: F401
     allreduce_nonblocking_,
     allgather,
     allgather_nonblocking,
+    allgather_v,
     broadcast,
     broadcast_,
     broadcast_nonblocking,
@@ -63,6 +64,7 @@ from bluefog_tpu.basics import (  # noqa: F401
     unified_mpi_window_model_supported,
     neighbor_allgather,
     neighbor_allgather_nonblocking,
+    neighbor_allgather_v,
     neighbor_allreduce,
     neighbor_allreduce_nonblocking,
     dynamic_neighbor_allreduce,

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import networkx as nx
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bluefog_tpu import topology as topology_util
@@ -693,6 +694,110 @@ def neighbor_allgather(x, name: Optional[str] = None) -> jnp.ndarray:
     """Gather in-neighbor tensors: output ``(size, max_indegree, ...)`` in
     ascending-src order with zero padding for irregular indegree."""
     return synchronize(neighbor_allgather_nonblocking(x, name))
+
+
+def _ragged_pack(tensors):
+    """Validate and pad a per-rank list of variable-first-dim tensors into a
+    rank-major ``(n, max_d, *trailing)`` buffer + the static length tuple."""
+    n = size()
+    if len(tensors) != n:
+        raise ValueError(
+            f"expected one tensor per rank ({n}), got {len(tensors)}")
+    arrs = [np.asarray(t) for t in tensors]
+    trailing = arrs[0].shape[1:]
+    dtype = arrs[0].dtype
+    for i, a in enumerate(arrs):
+        if a.ndim == 0:
+            raise ValueError(f"rank {i}: scalar tensors have no first dim")
+        if a.shape[1:] != trailing or a.dtype != dtype:
+            raise ValueError(
+                f"rank {i}: shape {a.shape} / dtype {a.dtype} does not "
+                f"match rank 0's trailing dims {trailing} / {dtype} "
+                "(only the FIRST dim may vary, reference "
+                "mpi_context.cc:443-504)")
+    lengths = tuple(int(a.shape[0]) for a in arrs)
+    max_d = max(max(lengths), 1)
+    padded = np.zeros((n, max_d) + trailing, dtype)
+    for i, a in enumerate(arrs):
+        padded[i, :lengths[i]] = a
+    return padded, lengths
+
+
+def allgather_v(tensors, name: Optional[str] = None) -> jnp.ndarray:
+    """Variable-first-dim allgather: rank ``i`` contributes ``tensors[i]``
+    of shape ``(d_i, *trailing)``; every rank receives the concatenation
+    ``(sum_i d_i, *trailing)`` in rank order.
+
+    The reference sizes the output by pre-allgathering first-dim counts
+    (``mpi_context.cc:443-504``, tested ``test/torch_ops_test.py:285-364``);
+    under SPMD the lengths are static metadata baked into the compiled
+    program — ranks exchange max-padded rows and the valid segments are
+    sliced back out inside the same jitted fn (XLA fuses the gather +
+    concatenation, no host round trip).
+
+    Returns the rank-major ``(size, sum_d, *trailing)`` array (every row
+    identical — gather semantics)."""
+    ctx = _require_active()
+    padded, lengths = _ragged_pack(tensors)
+    n = size()
+
+    def build():
+        def run(b):
+            g = lax.all_gather(b[0], RANK_AXIS)  # (n, max_d, *trailing)
+            parts = [g[i, :lengths[i]] for i in range(n)]  # static slices
+            return jnp.concatenate(parts, axis=0)[None]
+        return jax.jit(jax.shard_map(
+            run, mesh=ctx.mesh, in_specs=(P(RANK_AXIS),),
+            out_specs=P(RANK_AXIS)))
+    from bluefog_tpu.utils.timeline import op_span
+    with op_span("allgather_v", "ENQUEUE"):  # dispatch only (op-span parity)
+        fn = _jitted(("allgather_v", lengths, padded.shape, str(padded.dtype)),
+                     build)
+        handle = _throttle(fn(_place(padded)))
+    return synchronize(handle)  # COMMUNICATE span lives in synchronize
+
+
+def neighbor_allgather_v(tensors, name: Optional[str] = None):
+    """Variable-first-dim neighbor allgather: returns a LIST of per-rank
+    arrays — entry ``dst`` is the concatenation of ``tensors[src]`` over
+    ``dst``'s in-neighbors in ascending src order, shape
+    ``(sum_{src in in(dst)} d_src, *trailing)``.
+
+    The ragged per-rank output cannot be one rectangular rank-major array
+    (indegree AND row counts vary), so this is a host-assembled eager op:
+    the wire exchange is the compiled neighbor_allgather over max-padded
+    rows (neighbor edges only — not a full allgather), and the valid
+    segments are sliced out per destination (reference
+    ``MPI_Neighbor_allgatherv``, ``mpi_controller.cc:251-293``)."""
+    _require_active()
+    padded, lengths = _ragged_pack(tensors)
+    n = size()
+    gathered = to_numpy(neighbor_allgather(padded, name=name))
+    topo = load_topology()
+    # The slot layout comes from the compiled schedule, whose edge set is
+    # the NONZERO entries of the weight matrix (schedule._rounds_from_matrix
+    # iterates np.nonzero) — a weighted topology carrying an explicit
+    # zero-weight edge sends nothing on it, so the src list here must use
+    # the same effective edge set or segments would be misattributed.
+    if is_topo_weighted():
+        w = topology_util.weight_matrix(topo)
+
+        def srcs_of(dst):
+            return [s for s in range(n) if s != dst and w[s, dst] != 0.0]
+    else:
+        def srcs_of(dst):
+            return topology_util.in_neighbor_ranks(topo, dst)  # ascending
+    out = []
+    for dst in range(n):
+        srcs = srcs_of(dst)
+        segs = [gathered[dst, slot, :lengths[src]]
+                for slot, src in enumerate(srcs)]
+        if segs:
+            out.append(jnp.asarray(np.concatenate(segs, axis=0)))
+        else:
+            out.append(jnp.asarray(
+                np.zeros((0,) + padded.shape[2:], padded.dtype)))
+    return out
 
 
 def hierarchical_neighbor_allreduce_nonblocking(

@@ -309,3 +309,90 @@ def test_pair_gossip_dtype_grid(dtype):
     got = np.asarray(out.astype("float32"))
     expected = np.stack([np.full(4, (r + targets[r]) / 2.0) for r in range(N)])
     np.testing.assert_allclose(got, expected, atol=2e-2)
+
+
+@pytest.mark.parametrize("dim", [1, 2, 3])
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, np.float64])
+def test_allgather_variable_size(dim, dtype):
+    """Variable-first-dim allgather (reference
+    ``torch_ops_test.py:321-364``): rank i contributes sizes[i] rows filled
+    with i; everyone receives the rank-ordered concatenation."""
+    sizes = [17, 32, 81, 12, 15, 23, 22, 9][:N]
+    tensors = [np.full([sizes[r]] + [17] * (dim - 1), r, dtype)
+               for r in range(N)]
+    out = np.asarray(bf.allgather_v(tensors))
+    assert out.shape == (N, sum(sizes)) + (17,) * (dim - 1)
+    for row in range(N):  # gather semantics: every rank sees the same
+        off = 0
+        for i in range(N):
+            seg = out[row, off:off + sizes[i]]
+            assert seg.shape == (sizes[i],) + (17,) * (dim - 1)
+            assert seg.min() == i and seg.max() == i
+            off += sizes[i]
+
+
+def test_allgather_v_uniform_matches_allgather():
+    x = rank_tensors((3, 2))
+    out_v = np.asarray(bf.allgather_v(list(x)))
+    out = np.asarray(bf.allgather(x))
+    np.testing.assert_array_equal(out_v, out)
+
+
+def test_allgather_v_validation():
+    with pytest.raises(ValueError, match="one tensor per rank"):
+        bf.allgather_v([np.zeros((2, 3))] * (N - 1))
+    bad = [np.zeros((r + 1, 3), np.float32) for r in range(N)]
+    bad[3] = np.zeros((2, 4), np.float32)  # trailing dim mismatch
+    with pytest.raises(ValueError, match="FIRST dim may vary"):
+        bf.allgather_v(bad)
+
+
+def test_neighbor_allgather_variable_size():
+    """Ragged neighbor allgather on a directed ring: each rank receives its
+    single in-neighbor's variable-size tensor (reference
+    ``MPI_Neighbor_allgatherv``, ``mpi_controller.cc:251-293``)."""
+    bf.set_topology(topo.RingGraph(N, connect_style=1))  # edges i -> i-1
+    sizes = [3, 7, 1, 5, 2, 8, 4, 6][:N]
+    tensors = [np.full((sizes[r], 2), r, np.float32) for r in range(N)]
+    out = bf.neighbor_allgather_v(tensors)
+    assert len(out) == N
+    for dst in range(N):
+        src = (dst + 1) % N
+        got = np.asarray(out[dst])
+        assert got.shape == (sizes[src], 2)
+        np.testing.assert_array_equal(got, np.full((sizes[src], 2), src))
+
+
+def test_neighbor_allgather_v_multi_neighbor_ascending_order():
+    """Undirected ring: two in-neighbors, concatenated ascending by src."""
+    bf.set_topology(topo.RingGraph(N, connect_style=0))
+    sizes = [3, 7, 1, 5, 2, 8, 4, 6][:N]
+    tensors = [np.full((sizes[r],), float(r), np.float32) for r in range(N)]
+    out = bf.neighbor_allgather_v(tensors)
+    for dst in range(N):
+        srcs = sorted([(dst - 1) % N, (dst + 1) % N])
+        expected = np.concatenate(
+            [np.full((sizes[s],), float(s), np.float32) for s in srcs])
+        np.testing.assert_array_equal(np.asarray(out[dst]), expected)
+
+
+def test_neighbor_allgather_v_zero_weight_edge():
+    """A weighted topology with an explicit zero-weight edge sends nothing
+    on it; the ragged gather's src attribution must use the same effective
+    edge set as the compiled schedule (regression: slot misassignment)."""
+    import networkx as nx
+    G = nx.DiGraph()
+    G.add_nodes_from(range(N))
+    for i in range(N):
+        G.add_edge(i, i, weight=0.5)
+        G.add_edge((i + 1) % N, i, weight=0.5)   # real edge: src = i+1
+        G.add_edge((i + 2) % N, i, weight=0.0)   # dead edge: src = i+2
+    bf.set_topology(G, is_weighted=True)
+    sizes = [3, 7, 1, 5, 2, 8, 4, 6][:N]
+    tensors = [np.full((sizes[r],), float(r), np.float32) for r in range(N)]
+    out = bf.neighbor_allgather_v(tensors)
+    for dst in range(N):
+        src = (dst + 1) % N
+        np.testing.assert_array_equal(
+            np.asarray(out[dst]),
+            np.full((sizes[src],), float(src), np.float32))

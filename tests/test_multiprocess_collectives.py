@@ -114,6 +114,14 @@ pairs = [r + 1 if r % 2 == 0 else r - 1 for r in range(n)]
 expected = np.stack([(x[r] + x[pairs[r]]) / 2.0 for r in range(n)])
 check(bf.pair_gossip_nonblocking(x, pairs), expected, "pair gossip")
 
+# -- ragged allgather -------------------------------------------------------
+sizes = [3, 7, 1, 5, 2, 8, 4, 6][:n]
+ragged = [np.full((sizes[r], 2), r, np.float32) for r in range(n)]
+got = bf.to_numpy(bf.allgather_v(ragged))
+expected_cat = np.concatenate(ragged, axis=0)
+for r in range(n):
+    np.testing.assert_array_equal(got[r], expected_cat)
+
 print("MP-COLLECTIVES-OK", jax.process_index())
 """
 
