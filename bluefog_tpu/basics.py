@@ -775,21 +775,17 @@ def neighbor_allgather_v(tensors, name: Optional[str] = None):
     gathered = to_numpy(neighbor_allgather(padded, name=name))
     topo = load_topology()
     # The slot layout comes from the compiled schedule, whose edge set is
-    # the NONZERO entries of the weight matrix (schedule._rounds_from_matrix
-    # iterates np.nonzero) — a weighted topology carrying an explicit
-    # zero-weight edge sends nothing on it, so the src list here must use
-    # the same effective edge set or segments would be misattributed.
-    if is_topo_weighted():
-        w = topology_util.weight_matrix(topo)
-
-        def srcs_of(dst):
-            return [s for s in range(n) if s != dst and w[s, dst] != 0.0]
-    else:
-        def srcs_of(dst):
-            return topology_util.in_neighbor_ranks(topo, dst)  # ascending
+    # the NONZERO entries of the effective weight matrix
+    # (schedule._rounds_from_matrix iterates np.nonzero; uniform_weights
+    # masks zero entries too) — a topology carrying an explicit zero-weight
+    # edge sends nothing on it, so the src list here must use the same
+    # effective edge set or segments would be misattributed.
+    w = topology_util.weight_matrix(topo)
+    if not is_topo_weighted():
+        w = S.uniform_weights(w)
     out = []
     for dst in range(n):
-        srcs = srcs_of(dst)
+        srcs = [s for s in range(n) if s != dst and w[s, dst] != 0.0]
         segs = [gathered[dst, slot, :lengths[src]]
                 for slot, src in enumerate(srcs)]
         if segs:

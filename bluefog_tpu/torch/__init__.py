@@ -12,8 +12,11 @@ Data model matches the eager JAX surface: rank-major tensors, leading dim ==
 module's state into that form; ``neighbor_allreduce_module_`` averages a list
 of per-rank module replicas in place.
 
-This is an interop bridge — tensors round-trip host memory.  Training at
-speed belongs in the jitted JAX path.
+The collectives are differentiable (``torch.autograd.Function`` wrappers —
+the role of the reference TF layer's registered gradients,
+``tensorflow/mpi_ops.py:95-211``), so communication can sit inside a torch
+training graph.  This is an interop bridge — tensors round-trip host
+memory.  Training at speed belongs in the jitted JAX path.
 """
 
 from __future__ import annotations
@@ -43,28 +46,126 @@ def _like(t: torch.Tensor, arr) -> torch.Tensor:
                                                 device=t.device)
 
 
+# ---------------------------------------------------------------------------
+# Differentiable collectives (the role of the reference TF layer's gradient
+# registrations, ``tensorflow/mpi_ops.py:95-211``): every op below is a
+# ``torch.autograd.Function``, so gradients flow through communication in
+# torch training graphs.  All ops are LINEAR in the rank-major input, so each
+# backward is the transposed combine.
+# ---------------------------------------------------------------------------
+
+class _AllreduceFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name):
+        ctx.average = average
+        return _like(tensor, _b.allreduce(_to_np(tensor), average=average,
+                                          name=name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # out[r] = (1/n) sum_s x[s] (avg) or sum_s x[s] (sum); the Jacobian
+        # is the same averaging/summing matrix transposed == itself, so the
+        # gradient of an allreduce is an allreduce (reference
+        # ``_allreduce_grad``, tensorflow/mpi_ops.py:95-105).
+        g = _like(grad, _b.allreduce(_to_np(grad), average=ctx.average))
+        return g, None, None
+
+
+class _BroadcastFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return _like(tensor, _b.broadcast(_to_np(tensor), root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # out[r] = x[root] for every r: the root's row collects every
+        # rank's gradient; other rows get zero (reference
+        # ``_broadcast_grad``, tensorflow/mpi_ops.py:163-177).
+        g = _to_np(grad)
+        out = np.zeros_like(g)
+        out[ctx.root_rank] = g.sum(axis=0)
+        return _like(grad, out), None, None
+
+
+class _AllgatherFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.in_shape = tensor.shape
+        return _like(tensor, _b.allgather(_to_np(tensor), name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # out[r] = concat_s x[s]: each source segment appears on every
+        # rank's row, so grad_in[s] sums its segment over rows (reference
+        # ``_allgather_grad``, tensorflow/mpi_ops.py:203-211).
+        n, d = ctx.in_shape[0], ctx.in_shape[1]
+        g = _to_np(grad).reshape((n, n, d) + tuple(ctx.in_shape[2:]))
+        return _like(grad, g.sum(axis=0)), None
+
+
+class _NeighborAllreduceFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, w, is_default, name):
+        # w: the resolved (n, n) combine matrix (out = w^T @ x), kept for
+        # backward.  When the call carried no explicit weights, forward
+        # dispatches through the DEFAULT schedule so it shares the jit
+        # cache entry with the JAX surface instead of compiling a
+        # duplicate matrix-override program.
+        ctx.w = w
+        if is_default:
+            return _like(tensor, _b.neighbor_allreduce(_to_np(tensor),
+                                                       name=name))
+        return _like(tensor, _b.neighbor_allreduce(
+            _to_np(tensor), src_weights=w, name=name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # out[d] = sum_s w[s, d] x[s] => grad_in[s] = sum_d w[s, d] g[d]:
+        # the same neighbor combine along REVERSED edges, i.e. the
+        # transposed weight matrix (compiled like any other override).
+        g = _like(grad, _b.neighbor_allreduce(
+            _to_np(grad), src_weights=np.ascontiguousarray(ctx.w.T)))
+        return g, None, None, None
+
+
+def _resolved_weight_matrix(self_weight, src_weights, dst_weights):
+    """The effective (n, n) combine matrix for a neighbor_allreduce call
+    (explicit args > topology weights > uniform — reference
+    ``torch/mpi_ops.py:433-489``)."""
+    w = _b._weight_override_matrix(self_weight, src_weights, dst_weights)
+    if w is not None:
+        return w
+    from bluefog_tpu import topology as topology_util
+    from bluefog_tpu.ops import schedule as S
+    base = topology_util.weight_matrix(_b.load_topology())
+    if not _b.is_topo_weighted():
+        base = S.uniform_weights(base)
+    return base
+
+
 def allreduce(tensor: torch.Tensor, *, average: bool = True,
               name: Optional[str] = None) -> torch.Tensor:
-    return _like(tensor, _b.allreduce(_to_np(tensor), average=average,
-                                      name=name))
+    return _AllreduceFn.apply(tensor, average, name)
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int,
               name: Optional[str] = None) -> torch.Tensor:
-    return _like(tensor, _b.broadcast(_to_np(tensor), root_rank, name))
+    return _BroadcastFn.apply(tensor, root_rank, name)
 
 
 def allgather(tensor: torch.Tensor,
               name: Optional[str] = None) -> torch.Tensor:
-    return _like(tensor, _b.allgather(_to_np(tensor), name))
+    return _AllgatherFn.apply(tensor, name)
 
 
 def neighbor_allreduce(tensor: torch.Tensor, *, self_weight=None,
                        src_weights=None, dst_weights=None,
                        name: Optional[str] = None) -> torch.Tensor:
-    return _like(tensor, _b.neighbor_allreduce(
-        _to_np(tensor), self_weight=self_weight, src_weights=src_weights,
-        dst_weights=dst_weights, name=name))
+    is_default = (self_weight is None and src_weights is None
+                  and dst_weights is None)
+    w = _resolved_weight_matrix(self_weight, src_weights, dst_weights)
+    return _NeighborAllreduceFn.apply(tensor, w, is_default, name)
 
 
 def neighbor_allgather(tensor: torch.Tensor,

@@ -396,3 +396,24 @@ def test_neighbor_allgather_v_zero_weight_edge():
         np.testing.assert_array_equal(
             np.asarray(out[dst]),
             np.full((sizes[src],), float(src), np.float32))
+
+
+def test_neighbor_allgather_v_zero_weight_edge_unweighted():
+    """Same regression with is_weighted=False: the uniform schedule also
+    drops zero-weight edges, so src attribution must too."""
+    import networkx as nx
+    G = nx.DiGraph()
+    G.add_nodes_from(range(N))
+    for i in range(N):
+        G.add_edge(i, i, weight=0.5)
+        G.add_edge((i + 1) % N, i, weight=0.5)
+        G.add_edge((i + 2) % N, i, weight=0.0)  # dead edge
+    bf.set_topology(G, is_weighted=False)
+    sizes = [3, 7, 1, 5, 2, 8, 4, 6][:N]
+    tensors = [np.full((sizes[r],), float(r), np.float32) for r in range(N)]
+    out = bf.neighbor_allgather_v(tensors)
+    for dst in range(N):
+        src = (dst + 1) % N
+        np.testing.assert_array_equal(
+            np.asarray(out[dst]),
+            np.full((sizes[src],), float(src), np.float32))
