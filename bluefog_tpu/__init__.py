@@ -36,6 +36,7 @@ from bluefog_tpu.basics import (  # noqa: F401
     machine_size,
     machine_rank,
     is_homogeneous,
+    owned_ranks,
     mesh,
     set_topology,
     set_machine_topology,

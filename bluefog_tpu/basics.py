@@ -66,6 +66,10 @@ class _Context:
         # compiled schedule for a different topology object.
         self.topology_version: int = 0
         self.machine_topology_version: int = 0
+        # {hostname: total device count} gathered at init_distributed();
+        # None single-process / before the gather (is_homogeneous falls
+        # back to per-process counts then).
+        self.host_device_counts: Optional[Dict[str, int]] = None
         self._static_scheds: Dict = {}
         self._lock = threading.RLock()
 
@@ -208,6 +212,9 @@ def init_distributed(topology_fn=None, is_weighted: bool = False) -> None:
             pass
     init(topology_fn, is_weighted)
     if jax.process_count() > 1:
+        # Placement probe (reference mpi_controller.cc:71-96): feeds
+        # is_homogeneous with real per-host device counts.
+        _gather_host_device_counts()
         # Bring up the DCN window transport so the one-sided family works
         # across processes (each process owns its local devices' ranks).
         from bluefog_tpu.ops import window as _window
@@ -281,12 +288,22 @@ def size() -> int:
 
 
 def rank() -> int:
-    """Lowest global rank owned by this process (multi-controller parity)."""
+    """Lowest global rank owned by this process (multi-controller parity).
+
+    A process driving several devices owns several ranks — use
+    :func:`owned_ranks` for the full list when naming per-rank artifacts
+    (logs, checkpoints, timelines are named per PROCESS, which is the
+    unambiguous unit here)."""
+    ranks = owned_ranks()
+    return ranks[0] if ranks else 0
+
+
+def owned_ranks() -> List[int]:
+    """Global ranks of the devices this process is authoritative for
+    (ascending).  Single-process: every rank."""
     ctx = _require_init()
-    for i, d in enumerate(ctx.devices):
-        if d.process_index == jax.process_index():
-            return i
-    return 0
+    me = jax.process_index()
+    return [i for i, d in enumerate(ctx.devices) if d.process_index == me]
 
 
 def local_size() -> int:
@@ -294,6 +311,8 @@ def local_size() -> int:
 
 
 def local_rank() -> int:
+    """Local rank of :func:`rank` within its machine (see
+    :func:`owned_ranks` when this process owns several ranks)."""
     return rank() % _require_init().local_size
 
 
@@ -306,9 +325,42 @@ def machine_rank() -> int:
     return rank() // _require_init().local_size
 
 
+def _gather_host_device_counts() -> None:
+    """Allgather (hostname, local device count) across processes — the
+    reference's placement probe (``mpi_controller.cc:71-96``: allgather
+    hostnames, compare per-host counts).  Called by ``init_distributed``;
+    one tiny collective at startup."""
+    import socket
+    from jax.experimental import multihost_utils
+    name = socket.gethostname().encode()[:56]
+    buf = np.zeros(64, np.uint8)
+    buf[:len(name)] = np.frombuffer(name, np.uint8)
+    buf[56:64] = np.frombuffer(
+        np.asarray([len(jax.local_devices())], np.int64).tobytes(), np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    counts: Dict[str, int] = {}
+    for p in range(gathered.shape[0]):
+        host = bytes(gathered[p, :56]).rstrip(b"\0").decode()
+        cnt = int(np.frombuffer(bytes(gathered[p, 56:64]), np.int64)[0])
+        counts[host] = counts.get(host, 0) + cnt
+    _ctx.host_device_counts = counts
+
+
 def is_homogeneous() -> bool:
+    """True iff every MACHINE hosts the same number of devices — the
+    reference probes actual placement at init (``mpi_controller.cc:71-96``:
+    allgather hostnames, compare per-host counts).  Uneven slot layouts
+    (``bfrun -H host1:3,host2:5``) return False, and hierarchical ops'
+    machine arithmetic (which assumes ``local_size`` ranks per machine)
+    should not be trusted.  Multi-process runs use the per-host counts
+    gathered by ``init_distributed``; otherwise falls back to per-process
+    device counts (single-process: trivially True)."""
     ctx = _require_init()
-    return len(ctx.devices) % ctx.local_size == 0
+    if ctx.host_device_counts:
+        return len(set(ctx.host_device_counts.values())) <= 1
+    counts = collections.Counter(
+        getattr(d, "process_index", 0) for d in ctx.devices)
+    return len(set(counts.values())) <= 1
 
 
 def mesh() -> Mesh:

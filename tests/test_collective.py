@@ -417,3 +417,52 @@ def test_neighbor_allgather_v_zero_weight_edge_unweighted():
         np.testing.assert_array_equal(
             np.asarray(out[dst]),
             np.full((sizes[src],), float(src), np.float32))
+
+
+def test_owned_ranks_single_process():
+    bf.init()
+    assert bf.owned_ranks() == list(range(N))
+    assert bf.rank() == bf.owned_ranks()[0]
+
+
+def test_is_homogeneous_detects_uneven_placement():
+    """Forged heterogeneous placement: uneven per-HOST device counts must
+    flip is_homogeneous to False (the reference probes actual placement,
+    mpi_controller.cc:71-96; round-2 review: the old check could never
+    return False).  In bfrun slot mode every process owns ONE device, so
+    the per-host aggregation — not per-process counts — carries the
+    signal."""
+    import types
+    bf.init()
+    assert bf.is_homogeneous()
+    from bluefog_tpu import basics
+
+    # bfrun -H host1:3,host2:5: 8 single-device processes, uneven hosts.
+    basics._ctx.host_device_counts = {"host1": 3, "host2": 5}
+    assert not bf.is_homogeneous()
+    basics._ctx.host_device_counts = {"host1": 4, "host2": 4}
+    assert bf.is_homogeneous()
+
+    # Fallback path (no gathered placement): per-process device counts.
+    basics._ctx.host_device_counts = None
+
+    def stub(proc):
+        return types.SimpleNamespace(process_index=proc)
+    basics._ctx.devices = [stub(0)] * 3 + [stub(1)] * 5
+    assert not bf.is_homogeneous()
+    basics._ctx.devices = [stub(0)] * 4 + [stub(1)] * 4
+    assert bf.is_homogeneous()
+
+
+def test_owned_ranks_respects_forged_placement():
+    import types
+    bf.init()
+    from bluefog_tpu import basics
+
+    def stub(proc):
+        return types.SimpleNamespace(process_index=proc)
+    # jax.process_index() is 0 in this suite; ranks 2,5 owned by "us"
+    basics._ctx.devices = [stub(1), stub(1), stub(0), stub(1), stub(1),
+                           stub(0), stub(1), stub(1)]
+    assert bf.owned_ranks() == [2, 5]
+    assert bf.rank() == 2

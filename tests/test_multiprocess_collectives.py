@@ -51,8 +51,7 @@ from bluefog_tpu.ops import schedule as S
 
 bf.init_distributed()
 n = bf.size()
-owned = [i for i, d in enumerate(jax.devices())
-         if d.process_index == jax.process_index()]
+owned = bf.owned_ranks()
 assert owned, "every process must own ranks"
 rng = np.random.RandomState(7)
 x = rng.randn(n, 3).astype(np.float32)
@@ -212,8 +211,7 @@ from bluefog_tpu import topology as topo
 
 bf.init_distributed()
 n = bf.size()
-owned = [i for i, d in enumerate(jax.devices())
-         if d.process_index == jax.process_index()]
+owned = bf.owned_ranks()
 DIM, SAMPLES = 4, 16
 rng = np.random.RandomState(0)
 w_star = rng.randn(DIM, 1)
