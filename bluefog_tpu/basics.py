@@ -330,19 +330,22 @@ def _gather_host_device_counts() -> None:
     reference's placement probe (``mpi_controller.cc:71-96``: allgather
     hostnames, compare per-host counts).  Called by ``init_distributed``;
     one tiny collective at startup."""
+    import hashlib
     import socket
     from jax.experimental import multihost_utils
-    name = socket.gethostname().encode()[:56]
-    buf = np.zeros(64, np.uint8)
-    buf[:len(name)] = np.frombuffer(name, np.uint8)
-    buf[56:64] = np.frombuffer(
-        np.asarray([len(jax.local_devices())], np.int64).tobytes(), np.uint8)
-    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    # Group by a fixed-width HASH of the full hostname — truncating the
+    # name itself would merge distinct hosts sharing a long prefix (pod
+    # FQDNs) and could split a multibyte character.
+    digest = hashlib.blake2b(socket.gethostname().encode(),
+                             digest_size=8).digest()
+    pair = np.frombuffer(
+        digest + np.asarray([len(jax.local_devices())],
+                            np.int64).tobytes(), np.int64)
+    gathered = np.asarray(multihost_utils.process_allgather(pair))
     counts: Dict[str, int] = {}
     for p in range(gathered.shape[0]):
-        host = bytes(gathered[p, :56]).rstrip(b"\0").decode()
-        cnt = int(np.frombuffer(bytes(gathered[p, 56:64]), np.int64)[0])
-        counts[host] = counts.get(host, 0) + cnt
+        key = hex(int(gathered[p, 0]) & (2**64 - 1))
+        counts[key] = counts.get(key, 0) + int(gathered[p, 1])
     _ctx.host_device_counts = counts
 
 

@@ -22,5 +22,6 @@ from bluefog_tpu.parallel.ulysses import (  # noqa: F401
 )
 from bluefog_tpu.parallel.tensor_parallel import (  # noqa: F401
     tp_param_specs, tp_shard_params)
-from bluefog_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from bluefog_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply, pipeline_train_step)
 from bluefog_tpu.parallel.moe import moe_apply, switch_dispatch  # noqa: F401

@@ -26,10 +26,11 @@ anywhere.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_train_step"]
 
 
 def pipeline_apply(stage_fn, stage_params, microbatches, *,
@@ -68,3 +69,95 @@ def pipeline_apply(stage_fn, stage_params, microbatches, *,
     # Replicate the last stage's outputs to every rank (masked psum).
     outputs = jnp.where(me == n - 1, outputs, jnp.zeros_like(outputs))
     return lax.psum(outputs, axis_name)
+
+
+def pipeline_train_step(stage_fn, stage_params, microbatches, targets,
+                        loss_fn, *, axis_name: str = "pp"):
+    """One 1F1B training step: returns ``(loss, stage_grads)``.
+
+    GPipe via reverse-mode AD (``jax.grad`` through :func:`pipeline_apply`)
+    keeps scan residuals for every one of the ``M + n - 1`` forward ticks —
+    O(M) activation memory per rank.  This is the 1F1B (one-forward-
+    one-backward) schedule with gradients computed INSIDE the scan, so no
+    scan residuals exist at all and per-rank residency is O(n) stashed
+    microbatch inputs plus the parameter-gradient accumulator:
+
+      * fwd of microbatch ``i`` at stage ``s`` runs on tick ``2i + s``;
+        bwd runs on tick ``2i + 2n - 1 - s``.  Parities differ, so a stage
+        never does both in one tick; a stage holds at most ``n - s``
+        in-flight microbatches, so an ``i mod n`` stash slot is never
+        overwritten before its backward consumes it.
+      * activations hop down (``ppermute``) each tick, cotangents hop up.
+      * the backward recomputes the stage forward via ``jax.vjp`` from the
+        stashed input (stage-granular rematerialization — the standard
+        1F1B memory/compute trade).
+      * ``loss_fn(y, target) -> scalar`` runs on the LAST stage only; the
+        returned loss is the mean over microbatches, replicated to all
+        ranks; ``stage_grads`` matches this rank's ``stage_params``.
+
+    Constraint: every stage must map ``(mb, ...)`` activations to the same
+    shape/dtype (uniform-width pipeline — transformer blocks), since the
+    shift registers are single fixed-shape buffers.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    act_shape = microbatches.shape[1:]
+    down = [(i, (i + 1) % n) for i in range(n)]
+    up = [(i, (i - 1) % n) for i in range(n)]
+    zero_act = jnp.zeros(act_shape, microbatches.dtype)
+
+    def tick(carry, t):
+        stash, fwd_reg, bwd_reg, gparams, loss_acc = carry
+        moved_act = lax.ppermute(fwd_reg, axis_name, down)
+        moved_cot = lax.ppermute(bwd_reg, axis_name, up)
+
+        tf = t - me
+        i = jnp.maximum(tf, 0) // 2
+        fwd_on = (tf >= 0) & (tf % 2 == 0) & (i < M)
+        tb = t - (2 * n - 1 - me)
+        j = jnp.maximum(tb, 0) // 2
+        bwd_on = (tb >= 0) & (tb % 2 == 0) & (j < M)
+
+        def do_fwd(op):
+            stash, _ = op
+            feed = lax.dynamic_index_in_dim(
+                microbatches, jnp.minimum(i, M - 1), 0, keepdims=False)
+            x = jnp.where(me == 0, feed, moved_act)
+            y = stage_fn(stage_params, x)
+            stash = lax.dynamic_update_index_in_dim(stash, x, i % n, 0)
+            return stash, y
+
+        stash, fwd_out = lax.cond(
+            fwd_on, do_fwd, lambda op: (op[0], zero_act), (stash, moved_act))
+
+        def do_bwd(op):
+            gparams, loss_acc = op
+            x = lax.dynamic_index_in_dim(stash, j % n, 0, keepdims=False)
+            y, vjp_fn = jax.vjp(stage_fn, stage_params, x)
+            tgt = lax.dynamic_index_in_dim(
+                targets, jnp.minimum(j, M - 1), 0, keepdims=False)
+            lval, gy = jax.value_and_grad(loss_fn)(y, tgt)
+            # Last stage seeds the chain with the loss gradient; upstream
+            # stages consume the cotangent that just hopped up.
+            cot = jnp.where(me == n - 1, gy, moved_cot).astype(y.dtype)
+            dp, dx = vjp_fn(cot)
+            gparams = jax.tree.map(jnp.add, gparams, dp)
+            loss_acc = loss_acc + jnp.where(
+                me == n - 1, lval.astype(jnp.float32), 0.0)
+            return gparams, loss_acc, dx
+
+        gparams, loss_acc, bwd_out = lax.cond(
+            bwd_on, do_bwd, lambda op: (op[0], op[1], zero_act),
+            (gparams, loss_acc))
+        return (stash, fwd_out, bwd_out, gparams, loss_acc), None
+
+    carry0 = (jnp.zeros((n,) + act_shape, microbatches.dtype),
+              zero_act, zero_act,
+              jax.tree.map(jnp.zeros_like, stage_params),
+              jnp.zeros((), jnp.float32))
+    (_, _, _, gparams, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(2 * M + 2 * n - 2))
+    loss = lax.psum(jnp.where(me == n - 1, loss_acc, 0.0), axis_name) / M
+    grads = jax.tree.map(lambda g: g / M, gparams)
+    return loss, grads

@@ -83,12 +83,16 @@ def test_tensor_parallel_training_example(capsys):
     assert "kernel sharding PartitionSpec(None, 'tp')" in out
 
 
-def test_pipeline_training_example(capsys):
-    """GPipe training: one stage per device, loss falls, pipelined forward
-    equals the sequential stack."""
-    run_example(f"{EXAMPLES}/pipeline_training.py", ["--steps", "60"])
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_training_example(capsys, schedule):
+    """Pipelined training (GPipe-through-AD and 1F1B): one stage per
+    device, loss falls, pipelined forward equals the sequential stack."""
+    run_example(f"{EXAMPLES}/pipeline_training.py",
+                ["--steps", "60", "--schedule", schedule])
     out = capsys.readouterr().out
     assert "matches the sequential stack" in out
+    if schedule == "1f1b":
+        assert "compiled temp memory" in out
 
 
 def test_text_generation_example(capsys):
