@@ -173,32 +173,59 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
     ``win_update`` (reference factory ``torch/optimizers.py:1271``).
 
     ``step(..., dst_weights=...)`` takes the same weight forms as
-    ``bf.win_put`` and is re-resolvable every call (dynamic topologies)."""
+    ``bf.win_put`` and is re-resolvable every call (dynamic topologies).
+
+    ``overlap=True`` makes the put genuinely asynchronous: ``step`` issues
+    the nonblocking put and returns WITHOUT waiting — the put executes on
+    the worker pool while the caller computes the next forward/backward,
+    and the next step's ``win_update`` combines whatever has arrived (one
+    extra step of staleness, the reference's actual async operating mode:
+    its win optimizers overlapped RMA with compute via hooks,
+    ``torch/optimizers.py:889-909``).  The previous put is always waited
+    before the next one is issued, so per-window ordering holds even with
+    a multi-worker pool."""
 
     def __init__(self, base, *, window_prefix: str = "winput",
-                 num_steps_per_communication: int = 1, fuse: bool = True):
+                 num_steps_per_communication: int = 1, fuse: bool = True,
+                 overlap: bool = False):
         super().__init__(base, window_prefix=window_prefix,
                          num_steps_per_communication=num_steps_per_communication,
                          fuse=fuse)
+        self.overlap = bool(overlap)
+        self._pending: List[int] = []
 
     def step(self, params, grads, state: DistOptState, *,
              dst_weights=None, require_mutex: bool = True):
         new_params, base_state = self._local_adapt(params, grads, state)
         t = int(state.step)
         if (t + 1) % self.num_steps_per_communication == 0:
+            # Ordering: the previous overlapped put must complete before a
+            # new one targets the same window.
+            for h in self._pending:
+                W.win_wait(h)
+            self._pending = []
             payloads = self._payloads(new_params)
             handles = [
                 W.win_put_nonblocking(payload, name,
                                       dst_weights=dst_weights,
                                       require_mutex=require_mutex)
                 for name, payload in zip(self._names, payloads)]
-            for h in handles:
-                W.win_wait(h)
+            if self.overlap:
+                self._pending = handles
+            else:
+                for h in handles:
+                    W.win_wait(h)
             combined = [W.win_update(name, require_mutex=require_mutex)
                         for name in self._names]
             new_params = self._rebuild(combined, params)
         return (self._merge_owned(params, new_params),
                 DistOptState(base_state, state.step + 1))
+
+    def free(self):
+        for h in self._pending:
+            W.win_wait(h)
+        self._pending = []
+        super().free()
 
 
 class DistributedPullGetOptimizer(_WindowOptimizerBase):

@@ -109,6 +109,8 @@ def single_process(args):
     for name, opt in [
             ("DP-7 win_put ", bf.optim.DistributedWinPutOptimizer(
                 optax.sgd(0.01))),
+            ("DP-7 overlap ", bf.optim.DistributedWinPutOptimizer(
+                optax.sgd(0.01), window_prefix="winput_ov", overlap=True)),
             ("DP-3 sync nbr", bf.optim.DistributedNeighborAllreduceOptimizer(
                 optax.sgd(0.01)))]:
         state = opt.init(params)

@@ -350,3 +350,15 @@ def test_donate_matches_undonated():
             params, state = opt.step(params, grads, state)
         outs[donate] = np.asarray(params["w"]).copy()
     np.testing.assert_array_equal(outs[True], outs[False])
+
+
+def test_win_put_optimizer_overlap_converges():
+    """overlap=True: the put runs behind the caller's compute (one step of
+    staleness — the reference's actual async operating mode); convergence
+    must survive."""
+    bf.init(lambda: topo.ExponentialGraph(N))
+    A, y, _ = make_problem()
+    opt = bf.optim.DistributedWinPutOptimizer(optax.sgd(0.05), overlap=True)
+    params, _ = run_training(opt, A, y, steps=150)
+    opt.free()
+    assert global_mse(params["w"], A, y) < 0.1
