@@ -41,7 +41,8 @@ def local_attention(q, k, v, *, causal: bool = True):
 class TransformerConfig:
     def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
                  embed_dim=512, mlp_ratio=4, max_seq_len=2048,
-                 dtype=jnp.bfloat16, remat=False, num_experts=0,
+                 dtype=jnp.bfloat16, remat=False, remat_policy="full",
+                 num_experts=0,
                  expert_capacity_factor=2.0, router_group_size=4096,
                  num_kv_heads=None, pos_encoding="learned",
                  rope_theta=10000.0, mlp="gelu"):
@@ -85,6 +86,10 @@ class TransformerConfig:
         # for O(num_layers) less activation HBM, the standard long-context
         # training knob (pairs with the O(S)-memory flash attention).
         self.remat = remat
+        if remat_policy not in ("full", "dots"):
+            raise ValueError(f"remat_policy {remat_policy!r} not in "
+                             "('full', 'dots')")
+        self.remat_policy = remat_policy
         # num_experts > 0 replaces each block's MLP with a switch-routed
         # mixture of experts (top-1, static capacity).  Expert weights are
         # stacked (E, ...) so ``parallel.tp_param_specs``-style expert
@@ -315,8 +320,19 @@ class TransformerLM(nn.Module):
             x = x + pos
         positions = jnp.broadcast_to(positions,
                                      (tokens.shape[0], tokens.shape[1]))
-        block_cls = Block if cache is not None or not cfg.remat \
-            else nn.remat(Block)
+        if cache is not None or not cfg.remat:
+            block_cls = Block
+        elif getattr(cfg, "remat_policy", "full") == "dots":
+            # Save every dot_general output, recompute only non-dot ops in
+            # the backward: less recompute than full remat at the cost of
+            # keeping dot activations resident.  NOTE: with dense
+            # local_attention the (B,H,S,S) score/value einsums ARE dots
+            # and stay live — at long S use flash attention (a pallas_call,
+            # not a dot_general: recomputed, O(S) memory) or "full".
+            block_cls = nn.remat(
+                Block, policy=jax.checkpoint_policies.checkpoint_dots)
+        else:
+            block_cls = nn.remat(Block)
         new_cache = []
         for i in range(cfg.num_layers):
             blk = block_cls(cfg, attn, name=f"block_{i}")

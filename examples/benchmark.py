@@ -65,6 +65,10 @@ def build_parser():
                     help="transformer model: jax.checkpoint each block "
                          "(recompute activations in backward; long-context "
                          "memory knob)")
+    ap.add_argument("--remat-policy", choices=["full", "dots"],
+                    default="full",
+                    help="with --remat: 'dots' saves matmul outputs and "
+                         "recomputes only elementwise/attention")
     ap.add_argument("--chunked-loss", action="store_true",
                     help="transformer model: chunked lm-head cross-entropy "
                          "(never materializes the S x vocab logits)")
@@ -142,6 +146,7 @@ def measure(args, devices=None, quiet=False):
             vocab_size=args.vocab_size, num_layers=args.num_layers,
             num_heads=args.num_heads, embed_dim=args.embed_dim,
             max_seq_len=args.seq_len, remat=args.remat,
+            remat_policy=args.remat_policy,
             num_experts=args.num_experts,
             num_kv_heads=args.num_kv_heads or None,
             pos_encoding="rope" if args.rope else "learned",

@@ -280,9 +280,11 @@ def test_transformer_gqa_validates_divisibility():
 
 
 @pytest.mark.slow
-def test_transformer_remat_matches_plain():
-    """cfg.remat=True (jax.checkpoint per block) must not change outputs or
-    gradients — only the backward's memory/recompute schedule."""
+@pytest.mark.parametrize("policy", ["full", "dots"])
+def test_transformer_remat_matches_plain(policy):
+    """cfg.remat=True (jax.checkpoint per block, either policy) must not
+    change outputs or gradients — only the backward's memory/recompute
+    schedule."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -292,7 +294,8 @@ def test_transformer_remat_matches_plain():
               max_seq_len=16, dtype=jnp.float32)
     tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
     plain = TransformerLM(TransformerConfig(**kw))
-    remat = TransformerLM(TransformerConfig(remat=True, **kw))
+    remat = TransformerLM(TransformerConfig(remat=True, remat_policy=policy,
+                                            **kw))
     params = plain.init(jax.random.PRNGKey(0), tokens)
 
     out_p = plain.apply(params, tokens)
