@@ -102,6 +102,8 @@ from bluefog_tpu.ops.window import (  # noqa: F401
     win_poll,
     win_mutex,
     win_fence,
+    win_state_dict,
+    win_load_state_dict,
     get_win_version,
     get_current_created_window_names,
     win_associated_p,

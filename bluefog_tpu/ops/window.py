@@ -55,6 +55,7 @@ __all__ = [
     "win_get", "win_get_nonblocking", "win_accumulate",
     "win_accumulate_nonblocking", "win_update", "win_update_then_collect",
     "win_wait", "win_poll", "win_mutex", "win_fence", "get_win_version",
+    "win_state_dict", "win_load_state_dict",
     "get_current_created_window_names", "win_associated_p",
     "turn_on_win_ops_with_associated_p", "turn_off_win_ops_with_associated_p",
 ]
@@ -1115,6 +1116,63 @@ def win_fence(name: Optional[str] = None) -> None:
                 f"win_fence: missing acks ({d.fence_acks}/{len(peers)}) "
                 f"after {_MSG_TIMEOUT_SEC:.0f}s")
     basics.barrier()
+
+
+def win_state_dict(name: str) -> Dict[str, object]:
+    """Snapshot a window's complete state for checkpointing: main memory,
+    per-edge staging, version counters and associated-P.  Pairs with
+    :func:`win_load_state_dict` so elastic restarts (``utils.elastic``)
+    can resume async-gossip training without losing in-staging mass —
+    push-sum's conservation invariant survives a crash/restore cycle.
+    The returned tree is plain numpy (orbax/`utils.checkpoint`-ready);
+    staging keys are ``"dst:src"`` strings.
+
+    Serializes against in-flight ``win_update`` calls via ``update_lock``:
+    the update's snapshot/combine/swap window holds mass in a local that
+    no lock-free snapshot could see — without this, a snapshot landing
+    mid-update would silently drop it."""
+    win = _store.get(name)
+    with win.update_lock, win.lock:
+        return {
+            "main": win.main.copy(),
+            "staging": {f"{d}:{s}": a.copy()
+                        for (d, s), a in win.staging.items()},
+            "versions": win.versions.copy(),
+            "main_versions": win.main_versions.copy(),
+            "p_main": win.p_main.copy(),
+            "p_staging": {f"{d}:{s}": np.float64(v)
+                          for (d, s), v in win.p_staging.items()},
+        }
+
+
+def win_load_state_dict(name: str, state: Dict[str, object]) -> None:
+    """Restore a window from :func:`win_state_dict` output.  The window
+    must already exist (``win_create`` with the same topology) — this
+    overwrites its buffers in place (serialized against in-flight updates,
+    as in :func:`win_state_dict`)."""
+    win = _store.get(name)
+    main = np.asarray(state["main"])
+    if main.shape != win.main.shape or main.dtype != win.main.dtype:
+        raise ValueError(
+            f"win_load_state_dict({name!r}): snapshot main "
+            f"{main.shape}/{main.dtype} does not match the window "
+            f"{win.main.shape}/{win.main.dtype}")
+    staging = {tuple(int(x) for x in k.split(":")): np.asarray(v)
+               for k, v in dict(state["staging"]).items()}
+    if set(staging) != set(win.staging):
+        raise ValueError(
+            f"win_load_state_dict({name!r}): snapshot edges do not match "
+            "the window's topology (recreate the window under the "
+            "topology it was saved with)")
+    with win.update_lock, win.lock:
+        win.main[:] = main
+        for k, v in staging.items():
+            win.staging[k][:] = v
+        win.versions[:] = np.asarray(state["versions"])
+        win.main_versions[:] = np.asarray(state["main_versions"])
+        win.p_main[:] = np.asarray(state["p_main"])
+        for k, v in dict(state["p_staging"]).items():
+            win.p_staging[tuple(int(x) for x in k.split(":"))] = float(v)
 
 
 def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:
