@@ -164,6 +164,44 @@ class _WindowOptimizerBase:
             W.win_free(name)
         self._names = None
 
+    def _quiesce(self) -> None:
+        """Complete every in-flight window op this optimizer issued (and,
+        multi-process, fence the transport) so a snapshot cannot miss
+        queued or in-flight gossip mass."""
+        if W._store.distrib is not None:
+            W.win_fence()
+
+    def _require_windows(self, what: str):
+        if not self._names:
+            raise RuntimeError(
+                f"{type(self).__name__}.{what}: no windows exist — call "
+                "init() first (and not after free()); a silent empty "
+                "snapshot would lose all gossip state")
+        return self._names
+
+    def window_state_dict(self):
+        """Snapshot every window this optimizer owns (checkpoint-ready
+        numpy tree keyed by window name; pair with
+        :meth:`load_window_state_dict` after re-``init`` on restart so
+        in-staging gossip mass survives elastic restarts).  Quiesces
+        in-flight ops first — overlapped puts and transport-in-flight
+        mass land before the snapshot."""
+        names = self._require_windows("window_state_dict")
+        self._quiesce()
+        return {name: W.win_state_dict(name) for name in names}
+
+    def load_window_state_dict(self, state) -> None:
+        names = set(self._require_windows("load_window_state_dict"))
+        snap = dict(state)
+        if set(snap) != names:
+            raise ValueError(
+                f"{type(self).__name__}.load_window_state_dict: snapshot "
+                f"windows {sorted(snap)} do not match this optimizer's "
+                f"{sorted(names)} — was the snapshot taken with a "
+                "different fuse= setting or window_prefix?")
+        for name, s in snap.items():
+            W.win_load_state_dict(name, s)
+
     _zero_init = False
 
 
@@ -226,6 +264,12 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
             W.win_wait(h)
         self._pending = []
         super().free()
+
+    def _quiesce(self) -> None:
+        for h in self._pending:   # overlapped puts must land first
+            W.win_wait(h)
+        self._pending = []
+        super()._quiesce()
 
 
 class DistributedPullGetOptimizer(_WindowOptimizerBase):

@@ -362,3 +362,58 @@ def test_win_put_optimizer_overlap_converges():
     params, _ = run_training(opt, A, y, steps=150)
     opt.free()
     assert global_mse(params["w"], A, y) < 0.1
+
+
+def test_push_sum_optimizer_window_checkpoint_resume():
+    """Push-sum optimizer state (incl. window staging + associated-P)
+    survives a checkpoint/re-init/restore cycle bit-exactly."""
+    bf.init(lambda: topo.RingGraph(N, connect_style=1))
+    A, y, _ = make_problem()
+    opt = bf.optim.DistributedPushSumOptimizer(optax.sgd(0.05))
+    params = {"w": jnp.asarray(
+        np.random.RandomState(1).randn(N, DIM, 1).astype(np.float32) * 2.0)}
+    state = opt.init(params)
+    compute_grads = grad_fn(A, y)
+    for _ in range(10):
+        params, state = opt.step(params, compute_grads(params), state)
+    win_snap = opt.window_state_dict()
+    p_mid, s_mid = params, state
+    for _ in range(10):
+        params, state = opt.step(params, compute_grads(params), state)
+    ref = np.asarray(params["w"]).copy()
+    p_ref = np.asarray(opt.associated_p()).copy()
+    opt.free()
+    bf.shutdown()
+
+    bf.init(lambda: topo.RingGraph(N, connect_style=1))
+    opt2 = bf.optim.DistributedPushSumOptimizer(optax.sgd(0.05))
+    params2 = jax.tree.map(jnp.asarray, p_mid)
+    opt2.init(params2)  # recreate windows (zero state)
+    opt2.load_window_state_dict(win_snap)
+    state2 = s_mid
+    for _ in range(10):
+        params2, state2 = opt2.step(params2, compute_grads(params2), state2)
+    np.testing.assert_array_equal(np.asarray(params2["w"]), ref)
+    np.testing.assert_array_equal(np.asarray(opt2.associated_p()), p_ref)
+    opt2.free()
+
+
+def test_window_state_dict_guards():
+    """Snapshot/restore misuse fails loudly: no windows, or a snapshot
+    taken under a different fuse/prefix layout."""
+    bf.init(lambda: topo.ExponentialGraph(N))
+    opt = bf.optim.DistributedWinPutOptimizer(optax.sgd(0.05))
+    with pytest.raises(RuntimeError, match="no windows exist"):
+        opt.window_state_dict()
+    params = {"w": jnp.zeros((N, DIM, 1))}
+    opt.init(params)
+    snap = opt.window_state_dict()
+    opt.free()
+    with pytest.raises(RuntimeError, match="no windows exist"):
+        opt.load_window_state_dict(snap)
+    # different layout: per-leaf windows cannot consume a fused snapshot
+    opt2 = bf.optim.DistributedWinPutOptimizer(optax.sgd(0.05), fuse=False)
+    opt2.init(params)
+    with pytest.raises(ValueError, match="fuse= setting or window_prefix"):
+        opt2.load_window_state_dict(snap)
+    opt2.free()
