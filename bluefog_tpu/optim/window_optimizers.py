@@ -185,13 +185,20 @@ class _WindowOptimizerBase:
         :meth:`load_window_state_dict` after re-``init`` on restart so
         in-staging gossip mass survives elastic restarts).  Quiesces
         in-flight ops first — overlapped puts and transport-in-flight
-        mass land before the snapshot."""
+        mass land before the snapshot.
+
+        Multi-process: COLLECTIVE — the quiesce fences the transport
+        (``win_fence`` ends in a barrier), so every process must call
+        this (and :meth:`load_window_state_dict`) together, like the
+        reference's collective window ops."""
         names = self._require_windows("window_state_dict")
         self._quiesce()
         return {name: W.win_state_dict(name) for name in names}
 
     def load_window_state_dict(self, state) -> None:
         names = set(self._require_windows("load_window_state_dict"))
+        self._quiesce()  # an in-flight put landing after the restore
+        #                  would corrupt the just-restored state
         snap = dict(state)
         if set(snap) != names:
             raise ValueError(
@@ -239,9 +246,7 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
         if (t + 1) % self.num_steps_per_communication == 0:
             # Ordering: the previous overlapped put must complete before a
             # new one targets the same window.
-            for h in self._pending:
-                W.win_wait(h)
-            self._pending = []
+            self._drain_pending()
             payloads = self._payloads(new_params)
             handles = [
                 W.win_put_nonblocking(payload, name,
@@ -259,16 +264,17 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
         return (self._merge_owned(params, new_params),
                 DistOptState(base_state, state.step + 1))
 
-    def free(self):
-        for h in self._pending:
-            W.win_wait(h)
-        self._pending = []
-        super().free()
-
-    def _quiesce(self) -> None:
+    def _drain_pending(self) -> None:
         for h in self._pending:   # overlapped puts must land first
             W.win_wait(h)
         self._pending = []
+
+    def free(self):
+        self._drain_pending()
+        super().free()
+
+    def _quiesce(self) -> None:
+        self._drain_pending()
         super()._quiesce()
 
 
