@@ -104,6 +104,7 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
                 ckpt_dir: str, num_steps: int, save_every: int = 100,
                 keep: int = 3, per_process: bool = False,
                 on_step: Optional[Callable[[Any, int], None]] = None,
+                on_restore: Optional[Callable[[Any, int], None]] = None,
                 async_save: bool = True) -> Any:
     """Run ``state = step_fn(state, step)`` for ``num_steps`` steps with
     automatic checkpoint/resume.  Returns the final state.
@@ -115,6 +116,11 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
     ``data.DistributedSampler.set_epoch`` is already step-derivable).
     ``on_step`` runs after every step (logging, eval); it is not
     exactly-once — after a crash, replayed steps invoke it again.
+    ``on_restore(restored_state, start_step)`` fires only when a checkpoint
+    was found, immediately after the restore and BEFORE the
+    ``start >= num_steps`` early return — use it to re-install side-band
+    state the pytree cannot carry (e.g. window-store buffers via
+    ``opt.load_window_state_dict``).
     ``async_save=True`` copies the state to host synchronously but writes
     the file on a background worker, so training overlaps the disk write;
     at most one write is in flight, and the preemption/final saves join it
@@ -134,6 +140,11 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
         state = checkpoint.restore(ckpt_dir, step=start, target=state)
         get_logger().info("elastic: resumed from step %d (%s)", start,
                           ckpt_dir)
+        if on_restore is not None:
+            # Re-install side-band state the pytree cannot carry by itself
+            # (e.g. window-store buffers via
+            # ``opt.load_window_state_dict(state[...])``).
+            on_restore(state, start)
     if start >= num_steps:
         return state
 

@@ -28,6 +28,13 @@ def main():
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--preempt-at-step", type=int, default=0)
+    ap.add_argument("--optimizer", choices=["neighbor_allreduce",
+                                            "push_sum"],
+                    default="neighbor_allreduce",
+                    help="push_sum: async window gossip — the window "
+                         "store (staging mass, associated-P) rides the "
+                         "checkpoint via win_state_dict, so resume is "
+                         "bit-exact for the one-sided family too")
     args = ap.parse_args()
 
     import jax
@@ -55,7 +62,14 @@ def main():
     p0 = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16)))
     params = jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), p0)
-    opt = bf.optim.DistributedNeighborAllreduceOptimizer(optax.adam(args.lr))
+    if args.optimizer == "push_sum":
+        # Push-sum needs a topology whose out-degrees drive the
+        # column-stochastic split; a directed ring keeps it simple.
+        bf.set_topology(bf.topology_util.RingGraph(n, connect_style=2))
+        opt = bf.optim.DistributedPushSumOptimizer(optax.sgd(args.lr))
+    else:
+        opt = bf.optim.DistributedNeighborAllreduceOptimizer(
+            optax.adam(args.lr))
 
     def loss_fn(p, x, y):
         return jnp.mean((model.apply(p, x) - y) ** 2)
@@ -68,37 +82,58 @@ def main():
     # each epoch's batches; a streaming job would re-iterate the loader.
     cache = {"epoch": -1, "batches": None}
 
+    push_sum = args.optimizer == "push_sum"
+
     def step_fn(state, step):
         epoch = step // steps_per_epoch
         if cache["epoch"] != epoch:
             loader.set_epoch(epoch)
             cache["epoch"], cache["batches"] = epoch, list(loader)
         batch = cache["batches"][step % steps_per_epoch]
-        grads = grad_all(state["params"], batch["x"], batch["y"])
+        at = opt.debias(state["params"]) if push_sum else state["params"]
+        grads = grad_all(at, batch["x"], batch["y"])
         new_p, new_s = opt.step(state["params"], grads, state["opt"])
-        return {"params": new_p, "opt": new_s}
+        out = {"params": new_p, "opt": new_s}
+        if push_sum:
+            # The window store (staging mass + associated-P) is side-band
+            # state the params pytree cannot carry: snapshot it into the
+            # checkpoint tree so a restart resumes push-sum bit-exactly.
+            out["win"] = opt.window_state_dict()
+        return out
+
+    def on_restore(state, step):
+        if push_sum:
+            opt.load_window_state_dict(state["win"])
 
     def report(state, step):
         if args.preempt_at_step and step + 1 == args.preempt_at_step:
             os.kill(os.getpid(), signal.SIGTERM)
         if (step + 1) % args.save_every == 0:
+            p = opt.debias(state["params"]) if push_sum else state["params"]
             loss = float(jax.vmap(loss_fn)(
-                state["params"], jnp.asarray(xs.reshape(n, -1, 16)),
+                p, jnp.asarray(xs.reshape(n, -1, 16)),
                 jnp.asarray(ys.reshape(n, -1, 1))).mean())
             print(f"step {step + 1}  mean rank loss {loss:.5f}", flush=True)
 
     state0 = {"params": params, "opt": opt.init(params)}
+    if push_sum:
+        state0["win"] = opt.window_state_dict()
     try:
         final = run_elastic(step_fn, state0, ckpt_dir=args.ckpt_dir,
                             num_steps=args.steps,
-                            save_every=args.save_every, on_step=report)
+                            save_every=args.save_every, on_step=report,
+                            on_restore=on_restore)
     except Preempted as e:
         print(f"preempted; checkpoint saved at step {e.step} — rerun with "
               f"the same --ckpt-dir to resume")
         raise SystemExit(75)
+    eval_p = opt.debias(final["params"]) if push_sum else final["params"]
     loss = float(jax.vmap(loss_fn)(
-        final["params"], jnp.asarray(xs.reshape(n, -1, 16)),
+        eval_p, jnp.asarray(xs.reshape(n, -1, 16)),
         jnp.asarray(ys.reshape(n, -1, 1))).mean())
+    if push_sum:
+        opt.free()
+        bf.turn_off_win_ops_with_associated_p()
     print(f"done: {args.steps} steps, final mean rank loss {loss:.5f}")
 
 

@@ -51,19 +51,30 @@ def test_mnist_lenet_short():
                  "--batch-size", "64"])
 
 
-def test_elastic_training_preempt_then_resume(tmp_path, capsys):
+@pytest.mark.parametrize("optimizer", ["neighbor_allreduce", "push_sum"])
+def test_elastic_training_preempt_then_resume(tmp_path, capsys, optimizer):
     """The elastic example self-preempts mid-run, then a second invocation
-    resumes from the checkpoint and finishes."""
+    resumes from the checkpoint and finishes — bit-identically to an
+    uninterrupted run (for push_sum this covers the window store riding
+    the checkpoint: staging mass + associated-P)."""
     d = str(tmp_path / "ck")
+    base = ["--steps", "20", "--save-every", "5", "--optimizer", optimizer]
     with pytest.raises(SystemExit) as ei:
         run_example(f"{EXAMPLES}/elastic_training.py",
-                    ["--ckpt-dir", d, "--steps", "20", "--save-every", "5",
-                     "--preempt-at-step", "12"])
+                    ["--ckpt-dir", d] + base + ["--preempt-at-step", "12"])
     assert ei.value.code == 75
     assert "preempted; checkpoint saved at step 12" in capsys.readouterr().out
+    run_example(f"{EXAMPLES}/elastic_training.py", ["--ckpt-dir", d] + base)
+    resumed = capsys.readouterr().out
+    assert "done: 20 steps" in resumed
+
+    # Uninterrupted reference run in a fresh directory: identical final loss.
     run_example(f"{EXAMPLES}/elastic_training.py",
-                ["--ckpt-dir", d, "--steps", "20", "--save-every", "5"])
-    assert "done: 20 steps" in capsys.readouterr().out
+                ["--ckpt-dir", str(tmp_path / "ref")] + base)
+    ref = capsys.readouterr().out
+    final = [l for l in resumed.splitlines() if l.startswith("done:")][0]
+    final_ref = [l for l in ref.splitlines() if l.startswith("done:")][0]
+    assert final == final_ref, (final, final_ref)
 
 
 def test_benchmark_harness_tiny():
