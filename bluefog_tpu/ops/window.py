@@ -891,16 +891,22 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
     returned fresh (every process runs the same update for its own ranks);
     other rows of the returned array are this process's last-known copies.
 
-    Locking: ``win.lock`` is held only to SNAPSHOT the inputs and to SWAP the
-    results back — the O(n·indeg·size) combine itself runs unlocked, so the
-    transport drain thread is never serialized behind it (reference analogue:
+    Locking: ``win.lock`` is held to SNAPSHOT the inputs, to SWAP the
+    results back, and (keep-staging mode) for at most ONE edge's multiply
+    at a time during the combine — the transport drain thread is never
+    serialized behind the whole O(n·indeg·size) combine, only behind a
+    single O(size) scale of the slot it is racing with (reference analogue:
     ``MPI_Win_sync`` is a memory barrier, not a critical section over the
     combine, ``mpi_controller.cc:890-915``).  With ``reset_weights`` the
     staging buffers are MOVED out at snapshot time (fresh zero buffers swap
     in, no copy): a put or accumulate landing mid-combine writes into the
     fresh buffer and is pending for the next update — exactly the serialize-
     after ordering, with no double-counted mass.  Without ``reset_weights``
-    the staging is copied at snapshot and left in place."""
+    the slots stay live and each is read once under its brief per-edge
+    lock (no point-in-time cross-edge snapshot is implied: an edge read
+    later in the combine may include a put that landed after an earlier
+    edge's read — any such put serializes before this update for its edge
+    and the pending counters account for it exactly)."""
     from bluefog_tpu.utils.timeline import op_span
     win = _store.get(name)
     owned = _owned_ranks(win.n)
@@ -946,24 +952,45 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
                             p_stag[k] = win.p_staging[k]
                             win.p_staging[k] = 0.0
                             win.versions[dst, src] = 0
-                        else:
-                            stag[k] = win.staging[k].copy()
-                            p_stag[k] = win.p_staging[k]
+                        # else: keep-staging path snapshots NOTHING here —
+                        # the combine reads each live slot (data + P) under
+                        # a brief per-edge lock hold instead, saving a full
+                        # read+write pass over every staging buffer.
                 ver = win.versions.copy()
                 mver = win.main_versions.copy()
-            # -- combine (no locks held; in-place, one scratch buffer) ------
+            # -- combine (locks held per edge at most; one scratch buffer) --
             tmp = np.empty(win.shape, win.dtype)
             for dst in owned:
                 acc = out[dst]
                 np.multiply(acc, win.dtype.type(self_w_vec[dst]), out=acc)
                 p_acc = p_out[dst] * self_w_vec[dst]
                 for src in win.in_nbrs[dst]:
-                    w = nbr_w.get((dst, src))
-                    if w is None or (dst, src) not in stag:
+                    k = (dst, src)
+                    w = nbr_w.get(k)
+                    if w is None:
                         continue
-                    np.multiply(stag[(dst, src)], win.dtype.type(w), out=tmp)
+                    if reset_weights:
+                        if k not in stag:
+                            continue
+                        np.multiply(stag[k], win.dtype.type(w), out=tmp)
+                    else:
+                        # Slot still live: scale it under win.lock so a
+                        # concurrent drain-thread write cannot tear the
+                        # read (held for ONE edge's multiply, not the
+                        # whole combine).
+                        with win.lock:
+                            if k not in win.staging:
+                                continue
+                            np.multiply(win.staging[k],
+                                        win.dtype.type(w), out=tmp)
+                            p_stag[k] = win.p_staging[k]
+                            # This update consumed everything in the slot
+                            # as of NOW — make the swap's pending-count
+                            # delta exact for puts that landed between
+                            # the snapshot and this read.
+                            ver[dst, src] = win.versions[dst, src]
                     np.add(acc, tmp, out=acc)
-                    p_acc += w * p_stag[(dst, src)]
+                    p_acc += w * p_stag.get(k, 0.0)
                 p_out[dst] = p_acc
             # -- swap (under lock) ------------------------------------------
             # Scoped to owned ranks: rows owned by other processes stay

@@ -105,6 +105,7 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
                 keep: int = 3, per_process: bool = False,
                 on_step: Optional[Callable[[Any, int], None]] = None,
                 on_restore: Optional[Callable[[Any, int], None]] = None,
+                on_save: Optional[Callable[[Any, int], Any]] = None,
                 async_save: bool = True) -> Any:
     """Run ``state = step_fn(state, step)`` for ``num_steps`` steps with
     automatic checkpoint/resume.  Returns the final state.
@@ -121,6 +122,11 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
     ``start >= num_steps`` early return — use it to re-install side-band
     state the pytree cannot carry (e.g. window-store buffers via
     ``opt.load_window_state_dict``).
+    ``on_save(state, step) -> tree`` transforms the state at SAVE time only
+    (periodic, preemption and final saves) — refresh expensive side-band
+    snapshots here (e.g. ``{**state, "win": opt.window_state_dict()}``)
+    instead of rebuilding them every step; the returned tree must keep the
+    restore-target structure.
     ``async_save=True`` copies the state to host synchronously but writes
     the file on a background worker, so training overlaps the disk write;
     at most one write is in flight, and the preemption/final saves join it
@@ -161,6 +167,8 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
     saver = checkpoint.AsyncSaver() if async_save else None
 
     def save(tree, step: int, *, wait: bool) -> None:
+        if on_save is not None:
+            tree = on_save(tree, step)
         if saver is None:
             jax.block_until_ready(tree)
             checkpoint.save(ckpt_dir, tree, step=step)

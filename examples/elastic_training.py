@@ -95,11 +95,16 @@ def main():
         new_p, new_s = opt.step(state["params"], grads, state["opt"])
         out = {"params": new_p, "opt": new_s}
         if push_sum:
-            # The window store (staging mass + associated-P) is side-band
-            # state the params pytree cannot carry: snapshot it into the
-            # checkpoint tree so a restart resumes push-sum bit-exactly.
-            out["win"] = opt.window_state_dict()
+            out["win"] = state["win"]  # placeholder; refreshed at save time
         return out
+
+    def on_save(state, step):
+        if not push_sum:
+            return state
+        # The window store (staging mass + associated-P) is side-band state
+        # the params pytree cannot carry: snapshot it at SAVE time only (a
+        # per-step snapshot would copy every window each step for nothing).
+        return {**state, "win": opt.window_state_dict()}
 
     def on_restore(state, step):
         if push_sum:
@@ -122,7 +127,7 @@ def main():
         final = run_elastic(step_fn, state0, ckpt_dir=args.ckpt_dir,
                             num_steps=args.steps,
                             save_every=args.save_every, on_step=report,
-                            on_restore=on_restore)
+                            on_restore=on_restore, on_save=on_save)
     except Preempted as e:
         print(f"preempted; checkpoint saved at step {e.step} — rerun with "
               f"the same --ckpt-dir to resume")
