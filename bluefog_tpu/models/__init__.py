@@ -19,3 +19,4 @@ from bluefog_tpu.models.transformer import (  # noqa: F401
 from bluefog_tpu.models.vgg import (  # noqa: F401
     VGG, VGG11, VGG16, VGG19,
 )
+from bluefog_tpu.models.vit import ViT  # noqa: F401

@@ -42,7 +42,7 @@ class TransformerConfig:
     def __init__(self, vocab_size=32000, num_layers=4, num_heads=8,
                  embed_dim=512, mlp_ratio=4, max_seq_len=2048,
                  dtype=jnp.bfloat16, remat=False, remat_policy="full",
-                 num_experts=0,
+                 causal=True, num_experts=0,
                  expert_capacity_factor=2.0, router_group_size=4096,
                  num_kv_heads=None, pos_encoding="learned",
                  rope_theta=10000.0, mlp="gelu"):
@@ -90,6 +90,9 @@ class TransformerConfig:
             raise ValueError(f"remat_policy {remat_policy!r} not in "
                              "('full', 'dots')")
         self.remat_policy = remat_policy
+        # causal=False gives BIDIRECTIONAL attention (encoder mode — the
+        # ViT uses it); the KV-cache decode path requires causal=True.
+        self.causal = causal
         # num_experts > 0 replaces each block's MLP with a switch-routed
         # mixture of experts (top-1, static capacity).  Expert weights are
         # stacked (E, ...) so ``parallel.tp_param_specs``-style expert
@@ -178,6 +181,23 @@ def apply_rope(x, positions, theta: float = 10000.0):
                             x1 * sin + x2 * cos], -1).astype(x.dtype)
 
 
+def block_class(cfg):
+    """The (possibly remat-wrapped) Block class for a config — shared by
+    ``TransformerLM`` and ``models.vit.ViT`` so ``remat_policy`` behaves
+    identically in both."""
+    if not cfg.remat:
+        return Block
+    if getattr(cfg, "remat_policy", "full") == "dots":
+        # Save every dot_general output, recompute only non-dot ops in
+        # the backward: less recompute than full remat at the cost of
+        # keeping dot activations resident.  NOTE: with dense
+        # local_attention the (B,H,S,S) score/value einsums ARE dots
+        # and stay live — at long S use flash attention (a pallas_call,
+        # not a dot_general: recomputed, O(S) memory) or "full".
+        return nn.remat(Block, policy=jax.checkpoint_policies.checkpoint_dots)
+    return nn.remat(Block)
+
+
 class Block(nn.Module):
     cfg: Any
     attn_impl: Callable
@@ -235,7 +255,8 @@ class Block(nn.Module):
                 self.sow("kv_cache", "kv_entries", (k1, v1))
             k = jnp.repeat(k1, rep, axis=2) if rep > 1 else k1
             v = jnp.repeat(v1, rep, axis=2) if rep > 1 else v1
-            attn = self.attn_impl(q, k, v, causal=True)
+            attn = self.attn_impl(
+                q, k, v, causal=getattr(self.cfg, "causal", True))
         else:
             ck, cv = cache
             idx = positions[0, 0]  # decode positions are batch-uniform
@@ -299,6 +320,12 @@ class TransformerLM(nn.Module):
             if getattr(cfg, "num_experts", 0) > 0:
                 raise NotImplementedError(
                     "KV-cache decoding with MoE blocks is not supported")
+            if not getattr(cfg, "causal", True):
+                raise ValueError(
+                    "KV-cache decoding requires causal=True: the decode "
+                    "branch masks by cache index (causal by construction), "
+                    "which would diverge from a bidirectional training "
+                    "forward")
             if tokens.shape[1] != 1:
                 raise ValueError(
                     f"cache decoding takes ONE token per step; got "
@@ -320,19 +347,7 @@ class TransformerLM(nn.Module):
             x = x + pos
         positions = jnp.broadcast_to(positions,
                                      (tokens.shape[0], tokens.shape[1]))
-        if cache is not None or not cfg.remat:
-            block_cls = Block
-        elif getattr(cfg, "remat_policy", "full") == "dots":
-            # Save every dot_general output, recompute only non-dot ops in
-            # the backward: less recompute than full remat at the cost of
-            # keeping dot activations resident.  NOTE: with dense
-            # local_attention the (B,H,S,S) score/value einsums ARE dots
-            # and stay live — at long S use flash attention (a pallas_call,
-            # not a dot_general: recomputed, O(S) memory) or "full".
-            block_cls = nn.remat(
-                Block, policy=jax.checkpoint_policies.checkpoint_dots)
-        else:
-            block_cls = nn.remat(Block)
+        block_cls = Block if cache is not None else block_class(cfg)
         new_cache = []
         for i in range(cfg.num_layers):
             blk = block_cls(cfg, attn, name=f"block_{i}")

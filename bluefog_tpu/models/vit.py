@@ -1,0 +1,85 @@
+"""Vision Transformer (ViT-style image classifier).
+
+Beyond the reference's CNN-only zoo (``examples/pytorch_benchmark.py``
+models): a patch-embedding encoder built from the SAME transformer blocks
+as ``TransformerLM`` — bidirectional attention (``TransformerConfig(
+causal=False)``), so every attention implementation the LM supports
+(dense, flash, ring, Ulysses) serves the vision model too, and the
+parallelism strategies (dp/sp/tp/pp/ep) apply unchanged.
+
+Structure (ViT-S/16-style defaults): Conv patchify → prepend a learned
+[CLS] token → learned position embeddings → N encoder blocks → RMSNorm →
+classification head on the [CLS] representation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from bluefog_tpu.models.transformer import (TransformerConfig,
+                                            block_class, local_attention)
+
+__all__ = ["ViT"]
+
+
+class ViT(nn.Module):
+    """Vision transformer classifier over ``(B, H, W, C)`` images."""
+
+    num_classes: int = 1000
+    image_size: int = 224
+    patch_size: int = 16
+    embed_dim: int = 384
+    num_layers: int = 12
+    num_heads: int = 6
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    remat_policy: str = "full"
+    attn_impl: Optional[Callable] = None
+
+    def _cfg(self) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=1,  # unused: images enter through the patch conv
+            num_layers=self.num_layers, num_heads=self.num_heads,
+            embed_dim=self.embed_dim, mlp_ratio=self.mlp_ratio,
+            max_seq_len=(self.image_size // self.patch_size) ** 2 + 1,
+            dtype=self.dtype, remat=self.remat,
+            remat_policy=self.remat_policy, causal=False)
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self._cfg()
+        if images.shape[1] % self.patch_size or \
+                images.shape[2] % self.patch_size:
+            raise ValueError(
+                f"image {images.shape[1]}x{images.shape[2]} not divisible "
+                f"by patch size {self.patch_size}")
+        x = nn.Conv(self.embed_dim,
+                    kernel_size=(self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    dtype=self.dtype, name="patch_embed")(
+                        jnp.asarray(images, self.dtype))
+        B = x.shape[0]
+        x = x.reshape(B, -1, self.embed_dim)      # (B, N_patches, d)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, self.embed_dim))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, self.embed_dim)).astype(x.dtype),
+             x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.embed_dim))
+        x = x + pos.astype(x.dtype)
+        attn = self.attn_impl if self.attn_impl is not None \
+            else local_attention
+        block_cls = block_class(cfg)
+        for i in range(self.num_layers):
+            x = block_cls(cfg, attn, name=f"block_{i}")(x)
+        x = nn.RMSNorm(dtype=self.dtype)(x)
+        # Classify from the [CLS] token (f32 head, as in the LM's lm_head).
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0].astype(jnp.float32))

@@ -28,7 +28,7 @@ def build_parser():
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet18", "resnet34", "resnet50", "resnet101",
                              "resnet152", "vgg11", "vgg16", "vgg19",
-                             "lenet", "transformer"])
+                             "lenet", "vit", "transformer"])
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--num-warmup-batches", type=int, default=10)
     ap.add_argument("--num-iters", type=int, default=10)
@@ -139,6 +139,18 @@ def measure(args, devices=None, quiet=False):
     elif args.model == "lenet":
         model = models.LeNet5()
         data = jnp.zeros((n, args.batch_size, 28, 28, 1))
+        labels = jnp.zeros((n, args.batch_size), jnp.int32)
+        has_bn = False
+    elif args.model == "vit":
+        attn = None
+        if args.flash_attention:
+            from bluefog_tpu.ops.flash_attention import flash_attention_impl
+            attn = flash_attention_impl()
+        model = models.ViT(num_classes=1000, image_size=args.image_size,
+                           dtype=jnp.bfloat16, remat=args.remat,
+                           remat_policy=args.remat_policy, attn_impl=attn)
+        data = jnp.zeros((n, args.batch_size, args.image_size,
+                          args.image_size, 3), jnp.bfloat16)
         labels = jnp.zeros((n, args.batch_size), jnp.int32)
         has_bn = False
     else:

@@ -483,3 +483,63 @@ def test_vgg16_forward_and_grad():
     g = jax.grad(lambda p: jnp.sum(model.apply(p, x) ** 2))(params)
     assert all(np.isfinite(np.asarray(l)).all()
                for l in jax.tree_util.tree_leaves(g))
+
+
+def test_vit_forward_and_grad():
+    """ViT: patchify + [CLS] + bidirectional encoder blocks; logits shape,
+    gradient flow to every parameter group."""
+    m = models.ViT(num_classes=10, image_size=32, patch_size=8,
+                   embed_dim=64, num_layers=2, num_heads=4,
+                   dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                    jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = jax.jit(lambda p, x: m.apply(p, x))(params, x)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+
+    def loss(p):
+        return jnp.sum(m.apply(p, x) ** 2)
+    g = jax.grad(loss)(params)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(g)[0]:
+        assert float(jnp.abs(leaf).sum()) > 0, \
+            f"no gradient reached {jax.tree_util.keystr(path)}"
+
+
+def test_vit_attention_is_bidirectional():
+    """Information must flow from LATER patches into the [CLS] token's
+    logits beyond what a causal mask would allow: perturbing the LAST
+    patch changes the [CLS]-derived output (under a causal mask the CLS
+    position, index 0, could never see it)."""
+    m = models.ViT(num_classes=4, image_size=16, patch_size=8,
+                   embed_dim=32, num_layers=1, num_heads=2,
+                   dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 16, 16, 3),
+                    jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x)
+    base = np.asarray(m.apply(params, x))
+    x2 = x.at[:, 8:, 8:, :].add(1.0)  # last patch only
+    pert = np.asarray(m.apply(params, x2))
+    assert np.abs(pert - base).max() > 1e-4, \
+        "CLS logits blind to later patches — attention is causal"
+
+
+def test_vit_validates_patch_divisibility():
+    m = models.ViT(image_size=30, patch_size=16)
+    with pytest.raises(ValueError, match="not divisible"):
+        m.init(jax.random.PRNGKey(0), jnp.zeros((1, 30, 30, 3)))
+
+
+def test_kv_cache_rejects_bidirectional_config():
+    """causal=False (encoder mode) must not silently decode causally."""
+    from bluefog_tpu.models import transformer as T
+    cfg = models.TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                                   embed_dim=16, max_seq_len=8,
+                                   dtype=jnp.float32, causal=False)
+    m = models.TransformerLM(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), tokens)
+    cache = T.init_cache(cfg, batch=1, max_len=8)
+    with pytest.raises(ValueError, match="causal=True"):
+        m.apply(params, jnp.zeros((1, 1), jnp.int32),
+                positions=jnp.zeros((1, 1), jnp.int32), cache=cache)
