@@ -18,7 +18,6 @@ from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
-import numpy as np
 
 from bluefog_tpu.models.transformer import (TransformerConfig,
                                             block_class, local_attention)

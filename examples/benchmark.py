@@ -129,6 +129,11 @@ def measure(args, devices=None, quiet=False):
     bf.init(devices=devices, local_size=local_size)
     n = bf.size()
 
+    attn = None
+    if args.flash_attention:
+        from bluefog_tpu.ops.flash_attention import flash_attention_impl
+        attn = flash_attention_impl()
+
     if args.model.startswith(("resnet", "vgg")):
         name = args.model.replace("resnet", "ResNet").replace("vgg", "VGG")
         model = getattr(models, name)(num_classes=1000, dtype=jnp.bfloat16)
@@ -142,10 +147,6 @@ def measure(args, devices=None, quiet=False):
         labels = jnp.zeros((n, args.batch_size), jnp.int32)
         has_bn = False
     elif args.model == "vit":
-        attn = None
-        if args.flash_attention:
-            from bluefog_tpu.ops.flash_attention import flash_attention_impl
-            attn = flash_attention_impl()
         model = models.ViT(num_classes=1000, image_size=args.image_size,
                            dtype=jnp.bfloat16, remat=args.remat,
                            remat_policy=args.remat_policy, attn_impl=attn)
@@ -163,10 +164,6 @@ def measure(args, devices=None, quiet=False):
             num_kv_heads=args.num_kv_heads or None,
             pos_encoding="rope" if args.rope else "learned",
             mlp="swiglu" if args.swiglu else "gelu")
-        attn = None
-        if args.flash_attention:
-            from bluefog_tpu.ops.flash_attention import flash_attention_impl
-            attn = flash_attention_impl()
         model = models.TransformerLM(cfg, attn_impl=attn)
         data = jnp.zeros((n, args.batch_size, args.seq_len), jnp.int32)
         labels = None

@@ -226,7 +226,9 @@ compute_grads = jax.jit(grad_fn)
 
 init_w = (np.random.RandomState(1).randn(n, DIM, 1) * 2.0).astype(np.float32)
 params = {"w": jnp.asarray(init_w)}
-opt = bf.optim.DistributedWinPutOptimizer(optax.sgd(0.05))
+# @OVERLAP@=True: puts ride the transport BEHIND the next step's compute
+# (the async operating mode); False covers the default blocking puts.
+opt = bf.optim.DistributedWinPutOptimizer(optax.sgd(0.05), overlap=@OVERLAP@)
 state = opt.init(params)
 for _ in range(150):
     params, state = opt.step(params, compute_grads(params), state)
@@ -253,9 +255,11 @@ print("MP-WINOPT-OK", jax.process_index())
 """
 
 
-def test_multiprocess_window_optimizer_owned_rows(tmp_path):
-    """DistributedWinPutOptimizer under bfrun: owned rows converge,
-    non-owned rows stay frozen (not silently stale), gather() materializes
-    every rank's fresh parameters."""
-    out = _run_bfrun(tmp_path, _WINDOW_OPT_SCRIPT, 2, 4)
+@pytest.mark.parametrize("overlap", ["False", "True"])
+def test_multiprocess_window_optimizer_owned_rows(tmp_path, overlap):
+    """DistributedWinPutOptimizer under bfrun (blocking AND overlapped
+    puts): owned rows converge, non-owned rows stay frozen (not silently
+    stale), gather() materializes every rank's fresh parameters."""
+    out = _run_bfrun(tmp_path,
+                     _WINDOW_OPT_SCRIPT.replace("@OVERLAP@", overlap), 2, 4)
     assert out.count("MP-WINOPT-OK") == 2, out
