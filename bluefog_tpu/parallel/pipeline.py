@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_apply", "pipeline_train_step"]
+__all__ = ["pipeline_apply", "pipeline_train_step",
+           "pipeline_train_step_interleaved"]
 
 
 def pipeline_apply(stage_fn, stage_params, microbatches, *,
@@ -98,66 +99,133 @@ def pipeline_train_step(stage_fn, stage_params, microbatches, targets,
     Constraint: every stage must map ``(mb, ...)`` activations to the same
     shape/dtype (uniform-width pipeline — transformer blocks), since the
     shift registers are single fixed-shape buffers.
+
+    Implemented as the ``v = 1`` case of
+    :func:`pipeline_train_step_interleaved` (one chunk per rank) — a single
+    scan body carries the schedule, and
+    ``test_interleaved_v1_degenerates_to_plain_1f1b`` pins the equivalence.
+    """
+    chunk_params = jax.tree.map(lambda x: x[None], stage_params)
+    loss, grads = pipeline_train_step_interleaved(
+        stage_fn, chunk_params, microbatches, targets, loss_fn,
+        axis_name=axis_name)
+    return loss, jax.tree.map(lambda g: g[0], grads)
+
+
+def pipeline_train_step_interleaved(stage_fn, chunk_params, microbatches,
+                                    targets, loss_fn, *,
+                                    axis_name: str = "pp"):
+    """Interleaved (virtual-stage) 1F1B: each rank holds ``v`` NON-adjacent
+    stage chunks, shrinking the pipeline bubble from O(n/M) to O(n/(vM)).
+
+    ``chunk_params``: pytree whose leaves carry a leading ``(v, ...)`` axis —
+    rank ``r``'s chunk ``c`` is GLOBAL stage ``s = c*n + r`` of an
+    ``S = n*v``-stage pipeline (the Megatron interleaved assignment: rank
+    order repeats every ``n`` stages, so the tick-to-tick handoff is always
+    the same +1 ring shift, with rank ``n-1 → 0`` hops crossing into the
+    next chunk).  Schedule: fwd of microbatch ``i`` at stage ``s`` on tick
+    ``2i + s``, bwd on tick ``2i + 2S - 1 - s`` — the same parity-separated
+    1F1B law as :func:`pipeline_train_step`, just over ``S`` stages.  A rank
+    may run several of its chunks in one tick (their stages differ by
+    multiples of ``n``); each chunk has its own shift-register lane, so one
+    ``(v, ...)``-shaped ppermute per direction per tick still carries
+    everything.
+
+    Returns ``(loss, chunk_grads)`` with ``chunk_grads`` matching
+    ``chunk_params``.  Same uniform-activation-shape constraint as the
+    non-interleaved schedule; per-rank stash is O(v·S) = O(n·v²) microbatch
+    inputs (vs O(M) for GPipe-through-AD).
     """
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     M = microbatches.shape[0]
+    v = jax.tree_util.tree_leaves(chunk_params)[0].shape[0]
+    S = n * v
     act_shape = microbatches.shape[1:]
     down = [(i, (i + 1) % n) for i in range(n)]
     up = [(i, (i - 1) % n) for i in range(n)]
     zero_act = jnp.zeros(act_shape, microbatches.dtype)
+    zero_lane = jnp.zeros((v,) + act_shape, microbatches.dtype)
+
+    def chunk_param(c):
+        return jax.tree.map(lambda x: x[c], chunk_params)
 
     def tick(carry, t):
-        stash, fwd_reg, bwd_reg, gparams, loss_acc = carry
-        moved_act = lax.ppermute(fwd_reg, axis_name, down)
-        moved_cot = lax.ppermute(bwd_reg, axis_name, up)
+        stash, fwd_lanes, bwd_lanes, gparams, loss_acc = carry
+        # One (v, ...)-shaped hop per direction serves every chunk: lane c
+        # carries stage c*n+r's output toward stage c*n+r+1.  A payload
+        # leaving rank n-1 on lane c is CONSUMED by rank 0's chunk c+1, so
+        # rank 0 reads lane c-1 (lane shift below); other ranks read lane c.
+        moved_act = lax.ppermute(fwd_lanes, axis_name, down)
+        moved_cot = lax.ppermute(bwd_lanes, axis_name, up)
+        # rank 0: chunk c's input arrived on lane c-1; rank n-1's bwd input
+        # for chunk c arrived on lane c+1 (cotangent of stage c*n+n-1 comes
+        # from stage c*n+n = chunk c+1 of rank 0 — which sent on lane c+1).
+        act_in = jnp.where(me == 0, jnp.roll(moved_act, 1, axis=0),
+                           moved_act)
+        cot_in = jnp.where(me == n - 1, jnp.roll(moved_cot, -1, axis=0),
+                           moved_cot)
 
-        tf = t - me
-        i = jnp.maximum(tf, 0) // 2
-        fwd_on = (tf >= 0) & (tf % 2 == 0) & (i < M)
-        tb = t - (2 * n - 1 - me)
-        j = jnp.maximum(tb, 0) // 2
-        bwd_on = (tb >= 0) & (tb % 2 == 0) & (j < M)
+        new_fwd = zero_lane
+        new_bwd = zero_lane
+        for c in range(v):
+            s = c * n + me
+            tf = t - s
+            i = jnp.maximum(tf, 0) // 2
+            fwd_on = (tf >= 0) & (tf % 2 == 0) & (i < M)
+            tb = t - (2 * S - 1 - s)
+            j = jnp.maximum(tb, 0) // 2
+            bwd_on = (tb >= 0) & (tb % 2 == 0) & (j < M)
+            p_c = chunk_param(c)
 
-        def do_fwd(op):
-            stash, _ = op
-            feed = lax.dynamic_index_in_dim(
-                microbatches, jnp.minimum(i, M - 1), 0, keepdims=False)
-            x = jnp.where(me == 0, feed, moved_act)
-            y = stage_fn(stage_params, x)
-            stash = lax.dynamic_update_index_in_dim(stash, x, i % n, 0)
-            return stash, y
+            def do_fwd(op, c=c, s=s, i=i, p_c=p_c):
+                stash, _ = op
+                feed = lax.dynamic_index_in_dim(
+                    microbatches, jnp.minimum(i, M - 1), 0, keepdims=False)
+                x = jnp.where(s == 0, feed, act_in[c])
+                y = stage_fn(p_c, x)
+                stash = lax.dynamic_update_index_in_dim(
+                    stash, x, c * S + i % S, 0)
+                return stash, y
 
-        stash, fwd_out = lax.cond(
-            fwd_on, do_fwd, lambda op: (op[0], zero_act), (stash, moved_act))
+            stash, y_out = lax.cond(
+                fwd_on, do_fwd, lambda op: (op[0], zero_act),
+                (stash, act_in[c]))
+            new_fwd = new_fwd.at[c].set(y_out)
 
-        def do_bwd(op):
-            gparams, loss_acc = op
-            x = lax.dynamic_index_in_dim(stash, j % n, 0, keepdims=False)
-            y, vjp_fn = jax.vjp(stage_fn, stage_params, x)
-            tgt = lax.dynamic_index_in_dim(
-                targets, jnp.minimum(j, M - 1), 0, keepdims=False)
-            lval, gy = jax.value_and_grad(loss_fn)(y, tgt)
-            # Last stage seeds the chain with the loss gradient; upstream
-            # stages consume the cotangent that just hopped up.
-            cot = jnp.where(me == n - 1, gy, moved_cot).astype(y.dtype)
-            dp, dx = vjp_fn(cot)
-            gparams = jax.tree.map(jnp.add, gparams, dp)
-            loss_acc = loss_acc + jnp.where(
-                me == n - 1, lval.astype(jnp.float32), 0.0)
-            return gparams, loss_acc, dx
+            def do_bwd(op, c=c, s=s, j=j, p_c=p_c):
+                gparams, loss_acc = op
+                x = lax.dynamic_index_in_dim(stash, c * S + j % S, 0,
+                                             keepdims=False)
+                y, vjp_fn = jax.vjp(stage_fn, p_c, x)
+                tgt = lax.dynamic_index_in_dim(
+                    targets, jnp.minimum(j, M - 1), 0, keepdims=False)
+                lval, gy = jax.value_and_grad(loss_fn)(y, tgt)
+                cot = jnp.where(s == S - 1, gy, cot_in[c]).astype(y.dtype)
+                dp, dx = vjp_fn(cot)
+                gparams = jax.tree.map(
+                    lambda g, d, c=c: g.at[c].add(d), gparams, dp)
+                loss_acc = loss_acc + jnp.where(
+                    s == S - 1, lval.astype(jnp.float32), 0.0)
+                return gparams, loss_acc, dx
 
-        gparams, loss_acc, bwd_out = lax.cond(
-            bwd_on, do_bwd, lambda op: (op[0], op[1], zero_act),
-            (gparams, loss_acc))
-        return (stash, fwd_out, bwd_out, gparams, loss_acc), None
+            gparams, loss_acc, dx_out = lax.cond(
+                bwd_on, do_bwd, lambda op: (op[0], op[1], zero_act),
+                (gparams, loss_acc))
+            new_bwd = new_bwd.at[c].set(dx_out)
 
-    carry0 = (jnp.zeros((n,) + act_shape, microbatches.dtype),
-              zero_act, zero_act,
-              jax.tree.map(jnp.zeros_like, stage_params),
+        return (stash, new_fwd, new_bwd, gparams, loss_acc), None
+
+    # Stash: S slots per chunk — an early stage s holds up to S - s
+    # in-flight microbatches (its backward trails by 2(S - s) - 1 ticks),
+    # and the i mod S reuse window is provably safe: mb i-S's backward at
+    # tick 2i - 1 - s precedes mb i's forward at 2i + s for every s >= 0.
+    carry0 = (jnp.zeros((v * S,) + act_shape, microbatches.dtype),
+              zero_lane, zero_lane,
+              jax.tree.map(jnp.zeros_like, chunk_params),
               jnp.zeros((), jnp.float32))
     (_, _, _, gparams, loss_acc), _ = lax.scan(
-        tick, carry0, jnp.arange(2 * M + 2 * n - 2))
+        tick, carry0, jnp.arange(2 * M + 2 * S - 2))
     loss = lax.psum(jnp.where(me == n - 1, loss_acc, 0.0), axis_name) / M
     grads = jax.tree.map(lambda g: g / M, gparams)
     return loss, grads
