@@ -457,6 +457,16 @@ def out_neighbor_machine_ranks(rank_: Optional[int] = None) -> List[int]:
 # SPMD plumbing
 # ---------------------------------------------------------------------------
 
+def _mesh_platform() -> str:
+    """Platform of the devices actually IN the bf mesh (a CPU virtual mesh
+    can be built on a process whose default backend is gpu/tpu via
+    ``bf.init(devices=jax.devices("cpu"))`` — the throttle must key on the
+    mesh, not the process default)."""
+    if _ctx.devices:
+        return getattr(_ctx.devices[0], "platform", jax.default_backend())
+    return jax.default_backend()
+
+
 _inflight_depth: Optional[int] = None
 
 
@@ -480,7 +490,7 @@ def _max_inflight() -> int:
     # scales with cores (measured: depth 16 deadlocks a 1-core host, 8 is
     # the observed ceiling there — keep a 2x margin).  TPU runtimes have
     # their own flow control; 32 just bounds buffer liveness.
-    elif jax.default_backend() == "cpu":
+    elif _mesh_platform() == "cpu":
         depth = max(4, min(16, _os.cpu_count() or 1))
     else:
         depth = 32
@@ -500,8 +510,15 @@ def _throttle(out):
     (default 32) dispatches back — preserving pipelining while keeping all
     processes within a bounded number of programs of each other (the
     structural analogue of the reference's bounded tensor queue,
-    ``tensor_queue.h:30-66``)."""
-    if jax.process_count() <= 1:
+    ``tensor_queue.h:30-66``).
+
+    Also applied on single-process MULTI-DEVICE CPU meshes (the virtual
+    test topology): the XLA CPU runtime ABORTS the process (not a Python
+    error) when too many collective-bearing programs queue unsynced —
+    observed at ~50-120 in-flight scan+ppermute programs on a 1-core
+    host."""
+    if jax.process_count() <= 1 and not (
+            _mesh_platform() == "cpu" and len(_ctx.devices) > 1):
         return out
     dq = _ctx.__dict__.setdefault("_inflight", collections.deque())
     leaves = jax.tree_util.tree_leaves(out)

@@ -10,6 +10,10 @@ against running the layers sequentially, and asserts the loss fell.
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/pipeline_training.py
+
+(The library also ships ``parallel.pipeline_train_step_interleaved`` —
+Megatron virtual-stage chunks with an O(n/(vM)) bubble; see the oracle in
+``tests/test_parallel.py::test_interleaved_1f1b_matches_sequential_grads``.)
 """
 
 import argparse
@@ -106,6 +110,11 @@ def main():
     l0 = None
     for i in range(args.steps):
         params, state, loss = step(params, state)
+        if (i + 1) % 8 == 0:
+            # Bound async-dispatch depth: the XLA CPU runtime aborts when
+            # too many collective-bearing programs queue unsynced (the
+            # scan+ppermute schedule is exactly that shape).
+            jax.block_until_ready(loss)
         if i == 0:
             l0 = float(loss)
         if (i + 1) % 50 == 0:

@@ -82,6 +82,8 @@ def main():
     l0 = None
     for i in range(args.steps):
         params, state, loss = step(params, state)
+        if (i + 1) % 8 == 0:
+            jax.block_until_ready(loss)  # bound CPU-mesh dispatch depth
         if i == 0:
             l0 = float(loss)
         if (i + 1) % 50 == 0:
