@@ -113,8 +113,11 @@ _ctx = _Context()
 
 
 def _reset_for_tests():
-    global _ctx
+    global _ctx, _inflight_depth
     _ctx = _Context()
+    # The throttle depth derives from the mesh platform, which a re-init
+    # can change — a cached value must not outlive the context.
+    _inflight_depth = None
 
 
 def _require_init() -> _Context:

@@ -121,6 +121,13 @@ expected_cat = np.concatenate(ragged, axis=0)
 for r in range(n):
     np.testing.assert_array_equal(got[r], expected_cat)
 
+# ragged neighbor gather (host-assembled over the coordinator gather path)
+bf.set_topology(topo.RingGraph(n, connect_style=1))  # edges i -> i-1
+outs = bf.neighbor_allgather_v(ragged)
+for dst in range(n):
+    src = (dst + 1) % n
+    np.testing.assert_array_equal(np.asarray(outs[dst]), ragged[src])
+
 print("MP-COLLECTIVES-OK", jax.process_index())
 """
 
