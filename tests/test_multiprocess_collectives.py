@@ -192,6 +192,15 @@ w = train(bf.optim.DistributedNeighborAllreduceOptimizer(
 mse = global_mse(w)
 assert mse < 0.05, f"dynamic neighbor_allreduce MSE {mse}"
 
+# Hierarchical machine-level averaging (machines = processes here).
+if bf.machine_size() > 1:
+    bf.set_topology(topo.ExponentialGraph(n))
+    bf.set_machine_topology(topo.RingGraph(bf.machine_size()))
+    w = train(bf.optim.DistributedHierarchicalNeighborAllreduceOptimizer(
+        optax.sgd(0.05)), 120)
+    mse = global_mse(w)
+    assert mse < 0.05, f"hierarchical neighbor_allreduce MSE {mse}"
+
 print("MP-OPTIMIZER-OK", jax.process_index())
 """
 
