@@ -38,6 +38,7 @@ from bluefog_tpu.basics import (  # noqa: F401
     is_homogeneous,
     owned_ranks,
     mesh,
+    hierarchical_mesh,
     set_topology,
     set_machine_topology,
     load_topology,
