@@ -25,7 +25,8 @@ import time
 def build_parser():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet18",
-                    choices=["resnet18", "resnet34", "resnet50", "lenet"])
+                    choices=["resnet18", "resnet34", "resnet50", "lenet",
+                             "vit"])
     ap.add_argument("--image-size", type=int, default=32)
     ap.add_argument("--num-classes", type=int, default=10)
     ap.add_argument("--samples-per-rank", type=int, default=512)
@@ -102,6 +103,17 @@ def main():
 
     if args.model == "lenet":
         model = models.LeNet5(num_classes=args.num_classes)
+        has_bn = False
+    elif args.model == "vit":
+        # Small ViT fit to the example's image size: the patch must DIVIDE
+        # the image, so take the largest divisor at most size // 4
+        # (worst case 1x1 patches — more tokens, still valid).
+        patch = next(p for p in range(max(2, args.image_size // 4), 0, -1)
+                     if args.image_size % p == 0)
+        model = models.ViT(num_classes=args.num_classes,
+                           image_size=args.image_size, patch_size=patch,
+                           embed_dim=64, num_layers=4, num_heads=4,
+                           dtype=jnp.float32)
         has_bn = False
     else:
         model = getattr(models, args.model.replace("resnet", "ResNet"))(

@@ -167,14 +167,16 @@ def test_benchmark_measure_single_device_subset():
     bf.shutdown()
 
 
-def test_resnet_training_example_converges(capsys):
+@pytest.mark.parametrize("model,lr", [("lenet", "0.005"), ("vit", "0.01")])
+def test_resnet_training_example_converges(capsys, model, lr):
     """Full training protocol (reference pytorch_resnet.py): shard data,
     broadcast, warmup+decay schedule, validate — reaches high accuracy on
-    the class-pattern task."""
+    the class-pattern task (CNN and vision-transformer variants)."""
     run_example(f"{EXAMPLES}/resnet_training.py",
-                ["--model", "lenet", "--image-size", "28",
+                ["--model", model, "--image-size",
+                 "28" if model == "lenet" else "32",
                  "--samples-per-rank", "256", "--batch-size", "16",
-                 "--epochs", "5", "--base-lr", "0.005"])
+                 "--epochs", "5", "--base-lr", lr])
     out = capsys.readouterr().out
     acc = float(out.strip().splitlines()[-1].split()[-1])
     assert acc > 0.9, out
