@@ -70,7 +70,11 @@ from bluefog_tpu.ops.transport import (  # noqa: E402
     OP_PUT, OP_ACCUMULATE, OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
     OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL, OP_BF16_FLAG)
 
-_MSG_TIMEOUT_SEC = 300.0  # hard cap on waiting for a peer's reply
+# Hard cap on waiting for a peer's reply.  Env-overridable so fault-injection
+# tests (and impatient deployments) can bound partition detection; the
+# reference's equivalent knob is the MPI-level timeout its users set out of
+# band.
+_MSG_TIMEOUT_SEC = float(os.environ.get("BLUEFOG_TPU_WIN_TIMEOUT", "300"))
 
 
 class _Window:
@@ -944,6 +948,14 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
                         k = (dst, src)
                         if k not in win.staging:
                             continue
+                        if nbr_w.get(k) is None:
+                            # Edge excluded from an explicit partial
+                            # neighbor_weights: its gossip mass is NOT
+                            # consumed by this update — leave staging,
+                            # P and version counters pending (reference
+                            # resets only buffers included in
+                            # neighbor_weights, torch/mpi_ops.py:1068).
+                            continue
                         if reset_weights:
                             # Move: consume the slot now.  Zero-fill is
                             # lazy-paged — far cheaper than a copy.
@@ -1026,6 +1038,12 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
                         # serialize after this update).
                         for src in win.in_nbrs[dst]:
                             if (dst, src) not in win.staging:
+                                continue
+                            if nbr_w.get((dst, src)) is None:
+                                # Unconsumed edge (excluded by a partial
+                                # neighbor_weights): its pending count is
+                                # untouched — rebaselining it would
+                                # under-report staleness.
                                 continue
                             delta = win.versions[dst, src] - ver[dst, src]
                             win.versions[dst, src] = max(0, delta)

@@ -228,7 +228,14 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
     its win optimizers overlapped RMA with compute via hooks,
     ``torch/optimizers.py:889-909``).  The previous put is always waited
     before the next one is issued, so per-window ordering holds even with
-    a multi-worker pool."""
+    a multi-worker pool.
+
+    Note that in overlap mode the rank's OWN row lags too, not just the
+    neighbors': a put self-publishes the adapted parameters into the local
+    window, so when step ``t+1``'s ``win_update`` runs before step ``t``'s
+    put has landed, the combine is taken over step ``t-1``'s published
+    self value — step ``t``'s local adapt result reaches the combined
+    state one step late, same as its neighbors see it."""
 
     def __init__(self, base, *, window_prefix: str = "winput",
                  num_steps_per_communication: int = 1, fuse: bool = True,
@@ -378,6 +385,25 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         new_params = self._rebuild(collected, params)
         return (self._merge_owned(params, new_params),
                 DistOptState(base_state, state.step + 1))
+
+    def collect(self, params, *, require_mutex: bool = True):
+        """Fold ALL in-flight gossip into the iterates (evaluation-time
+        collect, the reference's end-of-run ``win_update_then_collect``
+        usage, ``torch/mpi_ops.py:1206-1260``).
+
+        The async step issues accumulates without a fence — at any instant a
+        chunk of the network's value/P mass rides the transport, so an
+        instantaneous de-bias snapshot is noisy (a rank whose mass is mostly
+        in flight has tiny P and a wild ratio).  ``win_fence`` (which acks
+        every peer's applied sends and ends in a barrier) guarantees no
+        mass is in flight; the collect then restores exact conservation:
+        gathered P sums to ``n`` and the P-weighted average equals the true
+        network average."""
+        W.win_fence()
+        collected = [W.win_update_then_collect(name,
+                                               require_mutex=require_mutex)
+                     for name in self._names]
+        return self._merge_owned(params, self._rebuild(collected, params))
 
     def associated_p(self) -> np.ndarray:
         """(n,) push-sum weight vector (identical across leaves/windows)."""

@@ -88,6 +88,33 @@ def test_win_put_partial_destinations():
     bf.win_free()
 
 
+def test_win_update_partial_weights_leaves_excluded_edges_pending():
+    """An edge excluded from an explicit partial ``neighbor_weights`` keeps
+    its staged mass AND its staleness counter for the next update —
+    reference resets only the buffers included in neighbor_weights
+    (``torch/mpi_ops.py`` win_update doc)."""
+    setup_ring()
+    x = np.ones((N, 3), np.float32)
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_put(x, "w")  # both in-edges of every rank staged, versions = 1
+    # Consume only the counter-clockwise edge (src = r-1).
+    ccw = {(r, (r - 1) % N): 1.0 for r in range(N)}
+    out = np.asarray(bf.win_update("w", self_weight=1.0,
+                                   neighbor_weights=ccw, reset_weights=True))
+    np.testing.assert_allclose(out, np.full((N, 3), 2.0), rtol=1e-5)
+    # Excluded clockwise edge: version counter untouched, mass pending.
+    assert bf.get_win_version("w", 0) == {(N - 1): 0, 1: 1}
+    full = {(r, s): 1.0 for r in range(N)
+            for s in [(r - 1) % N, (r + 1) % N]}
+    out2 = np.asarray(bf.win_update("w", self_weight=1.0,
+                                    neighbor_weights=full,
+                                    reset_weights=True))
+    # Consumed edge was reset to zero; excluded edge still held its put.
+    np.testing.assert_allclose(out2, np.full((N, 3), 3.0), rtol=1e-5)
+    assert bf.get_win_version("w", 0) == {(N - 1): 0, 1: 0}
+    bf.win_free()
+
+
 def test_win_accumulate():
     setup_ring()
     x = np.ones((N, 2), np.float32)
