@@ -1,0 +1,233 @@
+"""Multi-machine interactive sessions: a rank-0 REPL driving a worker fleet.
+
+Parity: reference ``run/interactive_run.py:271-420`` (``ibfrun`` multi-machine
+mode boots an ipcontroller + ssh-launched ipengines so one notebook drives the
+MPI world).  The TPU-native counterpart has no ipyparallel: JAX multi-process
+SPMD requires every process to run the SAME program, so the "engine fleet" is
+a set of exec-loop workers and the "controller" is a rank-0 REPL that ships
+each complete cell to every worker over a TCP control channel, then executes
+it locally — collectives inside a cell line up across the gang exactly as in
+a batch run.
+
+Wire protocol (length-prefixed JSON): ``{"op": "exec", "src": ...}`` answered
+by ``{"ok": true}`` or ``{"ok": false, "tb": ...}``; ``{"op": "exit"}`` ends
+the session.  Cells run CONCURRENTLY on workers and the REPL — the ack is
+collected only after the local exec, because a collective would otherwise
+deadlock (workers blocked in the op, REPL blocked on acks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import code
+import json
+import os
+import socket
+import struct
+import sys
+import time
+import traceback
+
+__all__ = ["main", "worker_main", "repl_main", "ClusterConsole"]
+
+_ACK_TIMEOUT = float(os.environ.get("BLUEFOG_TPU_IBF_ACK_TIMEOUT", "600"))
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> dict:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise EOFError("control channel closed")
+        hdr += chunk
+    (n,) = struct.unpack(">I", hdr)
+    data = b""
+    while len(data) < n:
+        chunk = sock.recv(n - len(data))
+        if not chunk:
+            raise EOFError("control channel closed")
+        data += chunk
+    return json.loads(data.decode())
+
+
+def _boot_bf():
+    """Shared SPMD boot: honor the virtual-mesh env the launcher prepared
+    (site hooks can pin jax_platforms, so env vars alone are not enough),
+    then rendezvous."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import bluefog_tpu as bf
+    bf.init_distributed()
+    return bf
+
+
+def worker_main(ctrl: str) -> int:
+    """Exec-loop worker (the reference's ipengine role): rendezvous, connect
+    to the REPL's control socket, run every shipped cell in a persistent
+    namespace."""
+    bf = _boot_bf()
+    host, port_s = ctrl.rsplit(":", 1)
+    deadline = time.monotonic() + 120
+    sock = None
+    while sock is None:
+        try:
+            sock = socket.create_connection((host, int(port_s)), timeout=10)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
+    _send_msg(sock, {"op": "hello", "rank": int(bf.rank())})
+    ns: dict = {"bf": bf, "__name__": "__main__"}
+    while True:
+        try:
+            msg = _recv_msg(sock)
+        except EOFError:
+            break  # REPL gone: shut down with it
+        if msg.get("op") == "exit":
+            break
+        seq = msg.get("seq")
+        try:
+            exec(compile(msg["src"], "<cluster>", "exec"), ns)  # noqa: S102
+        except SystemExit:
+            _send_msg(sock, {"ok": True, "seq": seq})
+            break
+        except BaseException:  # noqa: BLE001 — report, stay alive
+            _send_msg(sock, {"ok": False, "tb": traceback.format_exc(),
+                             "seq": seq})
+            continue
+        _send_msg(sock, {"ok": True, "seq": seq})
+    try:
+        sock.close()
+    except OSError:
+        pass
+    bf.shutdown()
+    return 0
+
+
+class ClusterConsole(code.InteractiveConsole):
+    """REPL that ships each COMPLETE cell to the worker fleet before running
+    it locally (concurrent SPMD execution), then surfaces worker errors."""
+
+    def __init__(self, workers, locals=None):  # noqa: A002 — stdlib name
+        super().__init__(locals=locals)
+        self._workers = list(workers)  # live [(rank, sock)]
+        self._seq = 0
+
+    def _drop(self, rank, sock, why):
+        print(f"[ibfrun] rank {rank}: control channel lost ({why}); "
+              "continuing without it", file=sys.stderr)
+        try:
+            sock.close()
+        except OSError:
+            pass
+        self._workers = [(r, s) for r, s in self._workers if s is not sock]
+
+    def runsource(self, source, filename="<input>", symbol="single"):
+        try:
+            compiled = self.compile(source, filename, symbol)
+        except (OverflowError, SyntaxError, ValueError):
+            self.showsyntaxerror(filename)
+            return False
+        if compiled is None:
+            return True  # incomplete cell: keep buffering
+        self._seq += 1
+        for rank, sock in list(self._workers):
+            try:
+                _send_msg(sock, {"op": "exec", "src": source,
+                                 "seq": self._seq})
+            except OSError as e:
+                self._drop(rank, sock, e)
+        self.runcode(compiled)
+        self._collect_acks()
+        return False
+
+    def _collect_acks(self):
+        """One ack per worker for THIS cell.  Sequence numbers keep the
+        pairing exact: a late ack from a previous slow cell is drained and
+        discarded, never attributed to the current one; a worker that
+        exceeds the timeout stays in the fleet (its stale ack is skipped on
+        the next collect), while a closed channel removes it."""
+        for rank, sock in list(self._workers):
+            sock.settimeout(_ACK_TIMEOUT)
+            while True:
+                try:
+                    reply = _recv_msg(sock)
+                except socket.timeout:
+                    print(f"[ibfrun] rank {rank}: no ack within "
+                          f"{_ACK_TIMEOUT:.0f}s (cell still running "
+                          "there?)", file=sys.stderr)
+                    break
+                except (EOFError, OSError) as e:
+                    self._drop(rank, sock, e)
+                    break
+                if reply.get("seq") == self._seq:
+                    if not reply.get("ok"):
+                        tb = reply.get("tb", "").rstrip().splitlines()
+                        tail = tb[-1] if tb else "unknown error"
+                        print(f"[ibfrun] rank {rank} raised: {tail}",
+                              file=sys.stderr)
+                    break
+                # Stale ack from an earlier timed-out cell: drain it.
+
+
+def repl_main(ctrl: str, expect: int) -> int:
+    """Rank-0 side: listen for ``expect`` workers, rendezvous, drive the
+    interactive session."""
+    host, port_s = ctrl.rsplit(":", 1)
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("", int(port_s)))
+    srv.listen(expect)
+    bf = _boot_bf()
+    workers = []
+    srv.settimeout(120)
+    for _ in range(expect):
+        conn, _ = srv.accept()
+        hello = _recv_msg(conn)
+        workers.append((int(hello.get("rank", -1)), conn))
+    workers.sort()
+    print(f"bluefog_tpu interactive: {bf.size()} rank(s) across "
+          f"{bf.machine_size()} process(es) ready; every cell runs SPMD on "
+          "the whole gang", flush=True)
+    console = ClusterConsole(workers, locals={"bf": bf,
+                                              "__name__": "__main__"})
+    try:
+        console.interact(banner="", exitmsg="")
+    except SystemExit:
+        pass
+    for _, sock in workers:
+        try:
+            _send_msg(sock, {"op": "exit"})
+            sock.close()
+        except OSError:
+            pass
+    srv.close()
+    bf.shutdown()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bf-cluster-repl", description=__doc__)
+    p.add_argument("--ctrl", required=True, help="rank-0 control host:port")
+    p.add_argument("--repl", action="store_true",
+                   help="run the rank-0 REPL (default: worker exec loop)")
+    p.add_argument("--expect", type=int, default=None,
+                   help="worker connections the REPL waits for "
+                        "(default: processes - 1)")
+    args = p.parse_args(argv)
+    if args.repl:
+        expect = args.expect
+        if expect is None:
+            expect = int(os.environ.get("BFTPU_NUM_PROCESSES", "1")) - 1
+        return repl_main(args.ctrl, expect)
+    return worker_main(args.ctrl)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,14 @@ reference's ``ibfrun start`` performed:
 * ``ibfrun -np 8 jupyter notebook`` (any command) — run that command inside
   the prepared environment instead of a REPL; kernels started by it inherit
   the virtual mesh.
+* ``ibfrun -np 4 --hosts h1:2,h2:2`` — MULTI-MACHINE interactive mode
+  (reference ``interactive_run.py:271-420`` ``multiple_machines_launch``):
+  ranks 1..n-1 run exec-loop workers launched over the same ``--rsh``/ssh
+  transport as ``bfrun``, rank 0 is a REPL that ships every complete cell
+  to the fleet before running it locally, so collectives inside a cell run
+  SPMD across the gang (``run/cluster_repl.py``).  With ``--hosts``, ``-np``
+  counts processes (as in bfrun) and ``--devices-per-proc`` adds a virtual
+  mesh per process.
 
 Inside the session, ``bf.suspend()`` / ``bf.resume()`` quiesce and re-enable
 communication between cells (reference ``common/basics.py:497-515``).
@@ -33,6 +41,8 @@ import subprocess
 import sys
 import tempfile
 import textwrap
+import time
+import uuid
 
 from bluefog_tpu.run.run import virtual_mesh_env
 
@@ -51,12 +61,94 @@ def build_parser() -> argparse.ArgumentParser:
         prog="ibfrun", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("-np", "--num-proc", type=int, default=None,
-                   help="virtual CPU device count (default: real devices)")
+                   help="virtual CPU device count; with --hosts: number of "
+                        "processes (bfrun semantics)")
     p.add_argument("--no-init", action="store_true",
                    help="prepare the environment but skip bf.init()")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="multi-machine mode: comma-separated host[:slots] "
+                        "entries; rank 0 is the local REPL, the rest are "
+                        "exec-loop workers")
+    p.add_argument("--rsh", default=None,
+                   help="remote-shell command for --hosts workers "
+                        "(default: ssh -p <ssh-port>)")
+    p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--devices-per-proc", type=int, default=None,
+                   help="virtual CPU devices per process (--hosts mode)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run instead of a REPL")
     return p
+
+
+def _cluster(args) -> int:
+    """Launch the multi-machine interactive gang: rank-0 REPL locally, the
+    other ranks as cluster_repl workers over the rsh/ssh transport (the
+    launch/kill/env machinery is bfrun's — one remote code path to trust)."""
+    from bluefog_tpu.run import run as R
+    n = args.num_proc or 1
+    placement = R.parse_hosts(args.hosts, n)
+    coord_host = placement[0][0]
+    if not R.is_local_host(coord_host):
+        # Rank 0 (REPL + coordinator + control socket) always runs HERE;
+        # fail fast instead of letting workers dial a host where nothing
+        # listens and time out opaquely two minutes later.
+        print(f"ibfrun: the first --hosts entry ({coord_host}) must be this "
+              "machine — rank 0 is the local REPL", file=sys.stderr)
+        return 2
+    rsh = R.rsh_argv(args.rsh, args.ssh_port)
+    coord = f"{coord_host}:{R._free_port()}"
+    ctrl = f"{coord_host}:{R._free_port()}"
+    tag = f"ibfrun-gang-{uuid.uuid4().hex[:12]}"
+    host_slots = {}
+    for host, _ in placement:
+        host_slots[host] = host_slots.get(host, 0) + 1
+
+    def child_env(rank, local_rank, local_size):
+        env = dict(os.environ)
+        env["BFTPU_COORDINATOR"] = coord
+        env["BFTPU_NUM_PROCESSES"] = str(n)
+        env["BFTPU_PROCESS_ID"] = str(rank)
+        env["BFTPU_LOCAL_ID"] = str(local_rank)
+        env["BFTPU_LOCAL_SIZE"] = str(local_size)
+        env["BFTPU_GANG_TAG"] = tag
+        if args.devices_per_proc:
+            virtual_mesh_env(env, args.devices_per_proc)
+        return env
+
+    wcmd = [sys.executable, "-m", "bluefog_tpu.run.cluster_repl",
+            "--ctrl", ctrl]
+    entries = []
+    try:
+        for rank, (host, local_rank) in enumerate(placement):
+            if rank == 0:
+                continue  # the REPL below
+            env = child_env(rank, local_rank, host_slots[host])
+            if R.is_local_host(host):
+                entries.append((subprocess.Popen(wcmd, env=env), host,
+                                False))
+            else:
+                remote = R._launch_shell(tag, rank,
+                                         R.remote_run_cmd(env, wcmd))
+                entries.append((subprocess.Popen(rsh + [host, remote]),
+                                host, True))
+        rc = subprocess.call(
+            [sys.executable, "-m", "bluefog_tpu.run.cluster_repl", "--repl",
+             "--ctrl", ctrl, "--expect", str(n - 1)],
+            env=child_env(0, placement[0][1], host_slots[coord_host]))
+    except KeyboardInterrupt:
+        print("ibfrun: interrupted; stopping the gang", file=sys.stderr)
+        R._kill_gang(entries, rsh, tag)
+        return 130
+    # REPL exit ends the session: workers exit on control-channel EOF.
+    deadline = time.monotonic() + 15
+    for p, _, _ in entries:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            pass
+    if any(p.poll() is None for p, _, _ in entries):
+        R._kill_gang(entries, rsh, tag)
+    return rc
 
 
 def _cpu_pin_dir() -> str:
@@ -103,10 +195,19 @@ def _prepared_env(num_proc):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    env, pin = _prepared_env(args.num_proc)
     cmd = args.command
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
+    if args.hosts:
+        if cmd or args.no_init:
+            # The fleet protocol IS the session: an arbitrary command has
+            # no cell stream to broadcast, and workers must init to
+            # rendezvous.  Refuse rather than silently ignore.
+            print("ibfrun: --hosts mode drives a REPL only; a command and "
+                  "--no-init are not supported with it", file=sys.stderr)
+            return 2
+        return _cluster(args)
+    env, pin = _prepared_env(args.num_proc)
 
     try:
         if cmd:

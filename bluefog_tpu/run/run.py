@@ -96,6 +96,31 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# Env vars forwarded to remote ranks (the remote login shell supplies the
+# rest, as with mpirun's -x lists).
+_ENV_EXPORT_PREFIXES = ("BFTPU_", "XLA_", "JAX_", "BLUEFOG")
+
+
+def is_local_host(host: str) -> bool:
+    return host in ("127.0.0.1", "localhost", socket.gethostname())
+
+
+def rsh_argv(rsh_opt, ssh_port: int) -> list:
+    """The remote transport argv prefix: ``--rsh`` override or ssh."""
+    return shlex.split(rsh_opt) if rsh_opt else ["ssh", "-p", str(ssh_port)]
+
+
+def remote_run_cmd(env: dict, cmd: list) -> str:
+    """The shell line a remote rank executes: replicate cwd + the BFTPU/JAX
+    env, then the command.  Shared by bfrun and multi-machine ibfrun so a
+    new env var cannot reach one launcher's remote ranks and not the
+    other's."""
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items()
+                       if k.startswith(_ENV_EXPORT_PREFIXES))
+    return (f"cd {shlex.quote(os.getcwd())} && {exports} "
+            + " ".join(shlex.quote(c) for c in cmd))
+
+
 def _launch_shell(tag: str, rank: int, run_cmd: str,
                   piddir: str = "/tmp") -> str:
     """The remote launch command for one gang rank.
@@ -104,13 +129,17 @@ def _launch_shell(tag: str, rank: int, run_cmd: str,
     to the tag pidfile) is the process-group id of every descendant;
     ``_remote_signal`` kills the whole group.  A bare ``pkill -f tag`` would
     only reach this shell — the training process carries no tag in its argv.
-    The traps remove the pidfile on normal exit and on TERM, so healthy runs
-    leave no litter; the KILL path cleans up via ``_remote_signal``."""
+    ``-w`` (wait) is load-bearing: when the invoking remote shell is already
+    a process-group leader, ``setsid`` FORKS and without ``-w`` the parent
+    exits 0 immediately — the gang supervisor would read every remote rank
+    as instantly successful.  The traps remove the pidfile on normal exit
+    and on TERM, so healthy runs leave no litter; the KILL path cleans up
+    via ``_remote_signal``."""
     pidfile = shlex.quote(f"{piddir}/{tag}.{rank}.pid")
     inner = (f"echo $$ > {pidfile}; "
              f"trap 'rm -f {pidfile}; exit 143' TERM INT; "
              f"trap 'rm -f {pidfile}' EXIT; " + run_cmd)
-    return f"setsid sh -c {shlex.quote(inner)}"
+    return f"setsid -w sh -c {shlex.quote(inner)}"
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -123,6 +152,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated host[:slots] entries "
                         "(default: all local)")
     p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--rsh", default=None,
+                   help="remote-shell command used to reach -H hosts, "
+                        "invoked as '<rsh> <host> <script>' (default: "
+                        "'ssh -p <ssh-port>').  The same transport carries "
+                        "launch, TERM/KILL escalation and pidfile cleanup, "
+                        "so tests and rsh-like schedulers exercise the "
+                        "REAL remote code path (reference verifies its ssh "
+                        "transport live, run/run.py:128-145)")
     p.add_argument("--coordinator-port", type=int, default=None)
     p.add_argument("--devices-per-proc", type=int, default=None,
                    help="virtual CPU devices per process (testing)")
@@ -175,6 +212,9 @@ def main(argv=None) -> int:
     else:
         placement = [("127.0.0.1", i) for i in range(args.num_proc)]
 
+    # The remote transport: one argv prefix for launch AND signalling.
+    rsh = rsh_argv(args.rsh, args.ssh_port)
+
     host_slots = {}
     for host, _ in placement:
         host_slots[host] = host_slots.get(host, 0) + 1
@@ -195,24 +235,18 @@ def main(argv=None) -> int:
                 env = _child_env(args, coord, rank, local_rank,
                                  host_slots[host])
                 env["BFTPU_GANG_TAG"] = tag
-                if host in ("127.0.0.1", "localhost", socket.gethostname()):
+                if is_local_host(host):
                     entries.append((subprocess.Popen(cmd, env=env), host,
                                     False))
                 else:
-                    exports = " ".join(
-                        f"{k}={shlex.quote(v)}" for k, v in env.items()
-                        if k.startswith(("BFTPU_", "XLA_", "JAX_",
-                                         "BLUEFOG")))
-                    run_cmd = (f"cd {shlex.quote(os.getcwd())} && {exports} "
-                               + " ".join(shlex.quote(c) for c in cmd))
-                    remote = _launch_shell(tag, rank, run_cmd)
-                    entries.append((subprocess.Popen(
-                        ["ssh", "-p", str(args.ssh_port), host, remote]),
-                        host, True))
-            rc = _wait_gang(entries, args.ssh_port, tag)
+                    remote = _launch_shell(tag, rank, remote_run_cmd(env,
+                                                                     cmd))
+                    entries.append((subprocess.Popen(rsh + [host, remote]),
+                                    host, True))
+            rc = _wait_gang(entries, rsh, tag)
         except KeyboardInterrupt:
             print("bfrun: interrupted; stopping the gang", file=sys.stderr)
-            _kill_gang(entries, args.ssh_port, tag)
+            _kill_gang(entries, rsh, tag)
             return 130
         if rc == 0 or attempt >= args.restarts:
             return rc
@@ -227,7 +261,7 @@ def main(argv=None) -> int:
         time.sleep(delay)
 
 
-def _remote_signal(host: str, ssh_port: int, tag: str, sig: str) -> None:
+def _remote_signal(host: str, rsh: list, tag: str, sig: str) -> None:
     """Signal every remote process group of this gang tag (killing the
     local ssh client only drops the connection; without a TTY the remote
     command keeps running).
@@ -253,12 +287,12 @@ def _remote_signal(host: str, ssh_port: int, tag: str, sig: str) -> None:
         f"[ -f \"$f\" ] && kill -s {sig} -- -\"$(cat \"$f\")\" 2>/dev/null; "
         f"done; {cleanup}pkill -{sig} -f {shlex.quote(btag)}; true")
     subprocess.run(
-        ["ssh", "-p", str(ssh_port), host, script],
+        rsh + [host, script],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, timeout=30,
         check=False)
 
 
-def _kill_gang(entries, ssh_port: int, tag: str,
+def _kill_gang(entries, rsh: list, tag: str,
                kill_grace: float = 10.0) -> None:
     """TERM the whole gang (local + remote), escalate to KILL after
     ``kill_grace`` — a peer blocked in a collective against a dead rank
@@ -269,7 +303,7 @@ def _kill_gang(entries, ssh_port: int, tag: str,
         if p.poll() is None:
             p.terminate()
     for h in remote_hosts:
-        _remote_signal(h, ssh_port, tag, "TERM")
+        _remote_signal(h, rsh, tag, "TERM")
     deadline = time.monotonic() + kill_grace
     pending = [p for p, _, _ in entries]
     for p in pending:
@@ -278,7 +312,7 @@ def _kill_gang(entries, ssh_port: int, tag: str,
         except subprocess.TimeoutExpired:
             p.kill()
     for h in remote_hosts:
-        _remote_signal(h, ssh_port, tag, "KILL")
+        _remote_signal(h, rsh, tag, "KILL")
     for p in pending:
         try:
             p.wait(timeout=30)
@@ -287,7 +321,7 @@ def _kill_gang(entries, ssh_port: int, tag: str,
     return
 
 
-def _wait_gang(entries, ssh_port: int, tag: str) -> int:
+def _wait_gang(entries, rsh: list, tag: str) -> int:
     """Wait for all processes; any nonzero exit kills the survivors."""
     procs = [p for p, _, _ in entries]
     while True:
@@ -298,7 +332,7 @@ def _wait_gang(entries, ssh_port: int, tag: str) -> int:
                 return 0
             time.sleep(0.2)
             continue
-        _kill_gang(entries, ssh_port, tag)
+        _kill_gang(entries, rsh, tag)
         return bad
 
 
