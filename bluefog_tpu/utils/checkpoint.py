@@ -24,7 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["save", "restore", "latest_step", "list_steps",
-           "broadcast_to_ranks", "consensus_average", "AsyncSaver"]
+           "broadcast_to_ranks", "consensus_average", "AsyncSaver",
+           "has_global_shards"]
 
 
 def _checkpointer():
@@ -32,26 +33,47 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _is_global(x: Any) -> bool:
+    """True for a jax.Array whose shards span processes (GSPMD state)."""
+    return (isinstance(x, jax.Array) and not x.is_fully_addressable
+            and not x.is_fully_replicated)
+
+
+def has_global_shards(tree: Any) -> bool:
+    """True when any leaf is globally sharded (multihost orbax territory)."""
+    return any(_is_global(x) for x in jax.tree.leaves(tree))
+
+
 def _host_copy(tree: Any) -> Any:
     """Copy a pytree to host numpy, rejecting globally-sharded arrays early.
 
-    Checkpoint state must be process-local or replicated: an array whose
-    shards live on other hosts cannot be host-copied here, and silently
-    zero-filling the missing rows would write corrupt data.  Callers holding
-    global rank-major state should either save the consensus average
-    (``save(..., average_ranks=True)`` on a gathered copy) or re-shard to
-    per-process state first (``jax.experimental.multihost_utils``)."""
+    An array whose shards live on other hosts cannot be host-copied here,
+    and silently zero-filling the missing rows would write corrupt data.
+    ``save``/``restore`` handle such state through orbax's multihost path
+    (every process writes its own shards into ONE coordinated checkpoint) —
+    this strict copy is for the paths that need a host snapshot, e.g.
+    ``AsyncSaver`` (which must decouple the write from live device buffers
+    the caller may donate on the next step)."""
     def one(x):
-        if (isinstance(x, jax.Array) and not x.is_fully_addressable
-                and not x.is_fully_replicated):
+        if _is_global(x):
             raise ValueError(
                 "checkpoint: array with non-addressable shards "
-                f"(shape {x.shape}, sharding {x.sharding}); checkpoint "
-                "state must be process-local or replicated — gather it "
-                "(multihost_utils.process_allgather) or save per-process "
-                "shards explicitly")
+                f"(shape {x.shape}, sharding {x.sharding}); this path "
+                "needs a host copy — use the synchronous sharded save "
+                "(checkpoint.save handles global arrays via orbax "
+                "multihost) or gather first "
+                "(multihost_utils.process_allgather)")
         return np.asarray(x)
     return jax.tree.map(one, tree)
+
+
+def _prepare_for_save(tree: Any) -> Any:
+    """Host-copy addressable leaves; pass globally-sharded jax.Arrays
+    through untouched — orbax writes each process's shards into a single
+    coordinated checkpoint (the multihost path the reference era handled by
+    torch-native per-rank files)."""
+    return jax.tree.map(lambda x: x if _is_global(x) else np.asarray(x),
+                        tree)
 
 
 def consensus_average(tree):
@@ -71,10 +93,34 @@ def save(path: str, tree: Any, *, step: Optional[int] = None,
     """Save a pytree; returns the concrete directory written.
 
     ``average_ranks=True`` stores the consensus-averaged model instead of all
-    replicas (smaller and the usual evaluation artifact)."""
+    replicas (smaller and the usual evaluation artifact).
+
+    Globally-sharded leaves (GSPMD tensor-parallel state) are saved through
+    orbax's multihost path: every process calls ``save`` with the same
+    arguments and writes its own shards into one coordinated checkpoint."""
     if average_ranks:
+        if has_global_shards(tree):
+            raise ValueError(
+                "checkpoint: average_ranks with globally-sharded state is "
+                "ambiguous (the leading axis is a sharded model axis, not "
+                "rank replicas) — save the sharded state directly")
         tree = consensus_average(tree)
-    tree = _host_copy(tree)  # host-side, device-agnostic
+    tree = _prepare_for_save(tree)  # host numpy; global shards stay lazy
+    if jax.process_count() > 1 and has_global_shards(tree):
+        # A coordinated checkpoint stores exactly ONE copy of each
+        # non-sharded leaf (orbax writes it from the primary process).  A
+        # per-process-distinct value would silently collapse to process
+        # 0's on restore — fail loudly instead.
+        host_leaves = [x for x in jax.tree.leaves(tree)
+                       if not _is_global(x)]
+        if host_leaves:
+            from jax.experimental import multihost_utils
+            multihost_utils.assert_equal(
+                host_leaves,
+                fail_message="checkpoint: non-sharded leaves differ across "
+                "processes; a coordinated sharded checkpoint stores one "
+                "copy — shard such leaves, make them identical, or save "
+                "them per-process separately")
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step:010d}")
@@ -90,7 +136,12 @@ def restore(path: str, *, step: Optional[int] = None,
     dict trees, but NamedTuples (e.g. ``DistOptState``) and optax state
     tuples lose their structure.  Pass ``target`` (a matching tree of arrays,
     e.g. a freshly-initialized optimizer state) to get the original structure
-    back, ready for ``opt.step``."""
+    back, ready for ``opt.step``.
+
+    Target leaves that are globally-sharded jax.Arrays are restored AS
+    global arrays with the target leaf's sharding (each process reads only
+    its own shards) — tensor-parallel training state round-trips without
+    ever materializing on one host."""
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step:010d}")
@@ -98,9 +149,23 @@ def restore(path: str, *, step: Optional[int] = None,
     if target is None:
         return ckpt.restore(path)
     import orbax.checkpoint as ocp
+
+    def item_of(x):
+        if _is_global(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=x.sharding)
+        return np.asarray(x)
+
+    def restore_arg(x):
+        if _is_global(x):
+            return ocp.ArrayRestoreArgs(sharding=x.sharding,
+                                        global_shape=x.shape)
+        return ocp.RestoreArgs()
+
     restored = ckpt.restore(
-        path, args=ocp.args.PyTreeRestore(item=jax.tree.map(np.asarray,
-                                                            target)))
+        path, args=ocp.args.PyTreeRestore(
+            item=jax.tree.map(item_of, target),
+            restore_args=jax.tree.map(restore_arg, target)))
     # Re-attach the target's tree structure (NamedTuple/custom nodes).
     return jax.tree.unflatten(jax.tree.structure(target),
                               jax.tree.leaves(restored))

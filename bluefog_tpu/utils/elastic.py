@@ -14,14 +14,21 @@ run loop itself is restartable:
     checkpoints replays at most ``save_every - 1`` steps and, with a
     deterministic ``step_fn``, reproduces the uninterrupted run bit-exactly.
 
-Multi-process runs pass ``per_process=True``: each process writes its own
-directory — its state must be process-local or replicated (globally-sharded
-arrays are rejected by ``checkpoint._host_copy``; gather or re-shard them
-before saving) — and on restart the resume step is agreed
-as the newest step *every* process has durably saved (set intersection, not
-``min(latest)`` — pruning or save skew may have deleted a slow process's
-frontier elsewhere), so a crash that interleaves with a save cannot resume
-ranks from different steps or name a step someone is missing.
+Multi-process runs with process-local or replicated state pass
+``per_process=True``: each process writes its own directory, and on restart
+the resume step is agreed as the newest step *every* process has durably
+saved (set intersection, not ``min(latest)`` — pruning or save skew may have
+deleted a slow process's frontier elsewhere), so a crash that interleaves
+with a save cannot resume ranks from different steps or name a step someone
+is missing.
+
+Multi-process runs with GLOBALLY-SHARDED state (GSPMD tensor parallelism)
+pass ``per_process=False``: every process writes its own shards into ONE
+coordinated orbax checkpoint (synchronous — the async saver's host copy
+cannot exist for non-addressable shards), preemption is agreed collectively
+every step (a one-host SIGTERM must not make one process enter the
+collective save alone), and restore reads each process's shards back into
+the live state's shardings.
 """
 
 from __future__ import annotations
@@ -132,15 +139,37 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
     at most one write is in flight, and the preemption/final saves join it
     before returning (the "checkpoint saved" promise stays durable).
     """
+    sharded = checkpoint.has_global_shards(state)
     if jax.process_count() > 1:
-        if not per_process:
+        if sharded:
+            # GSPMD state: ONE coordinated orbax checkpoint — every process
+            # writes its own shards; per-process directories would tear the
+            # global arrays apart.
+            if per_process:
+                raise ValueError(
+                    "run_elastic: globally-sharded state uses a single "
+                    "shared checkpoint (orbax multihost) — pass "
+                    "per_process=False")
+            if async_save:
+                # The async saver decouples writes via a host copy, which
+                # cannot exist for non-addressable shards; the multihost
+                # write is synchronous by construction.
+                get_logger().info(
+                    "elastic: sharded state — using synchronous "
+                    "coordinated saves")
+                async_save = False
+        elif not per_process:
             raise ValueError(
                 "run_elastic in a multi-process run requires "
                 "per_process=True: each process must write its own "
                 "checkpoint directory (concurrent writes to one orbax path "
                 "race), and resume must be agreed across processes")
-        ckpt_dir = os.path.join(ckpt_dir, f"proc{jax.process_index()}")
-    start = _agreed_start(ckpt_dir, per_process)
+        else:
+            ckpt_dir = os.path.join(ckpt_dir, f"proc{jax.process_index()}")
+    # Sharded mode shares one directory but still agrees explicitly — the
+    # allgather doubles as the barrier that keeps a fast process from
+    # restoring while a late one still holds the old run's state.
+    start = _agreed_start(ckpt_dir, per_process or sharded)
     _discard_steps_above(ckpt_dir, start)
     if start:
         state = checkpoint.restore(ckpt_dir, step=start, target=state)
@@ -177,13 +206,27 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
         saver.save(ckpt_dir, tree, step=step, wait=wait,
                    after=lambda: _prune(ckpt_dir, keep))
 
+    def preempted_now() -> bool:
+        """Sharded multi-process mode must AGREE on preemption: the save is
+        a collective orbax write, and a one-host SIGTERM would otherwise
+        send one process into the barrier while the others train on.  The
+        per-step allgather is a host-side scalar sync — noise next to the
+        coordinated save it protects."""
+        if not (sharded and jax.process_count() > 1):
+            return preempt.is_set()
+        import numpy as np
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.int32(preempt.is_set()))
+        return bool(np.asarray(flags).max())
+
     try:
         for step in range(start, num_steps):
             state = step_fn(state, step)
             if on_step is not None:
                 on_step(state, step)
             done = step + 1
-            if preempt.is_set() and done < num_steps:
+            if preempted_now() and done < num_steps:
                 # (a preemption during the FINAL step falls through to the
                 # normal completion save/return — the work is already done)
                 save(state, done, wait=True)
