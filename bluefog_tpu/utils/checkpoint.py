@@ -25,7 +25,7 @@ import numpy as np
 
 __all__ = ["save", "restore", "latest_step", "list_steps",
            "broadcast_to_ranks", "consensus_average", "AsyncSaver",
-           "has_global_shards"]
+           "has_global_shards", "restore_host", "leaf_shapes"]
 
 
 def _checkpointer():
@@ -169,6 +169,37 @@ def restore(path: str, *, step: Optional[int] = None,
     # Re-attach the target's tree structure (NamedTuple/custom nodes).
     return jax.tree.unflatten(jax.tree.structure(target),
                               jax.tree.leaves(restored))
+
+
+def restore_host(path: str, *, step: Optional[int] = None) -> Any:
+    """Restore every leaf as host numpy, regardless of how it was saved.
+
+    A checkpoint written by a DIFFERENT device geometry (more chips, a
+    different mesh) cannot be restored as jax.Arrays — orbax would look for
+    the original devices.  Forcing numpy reads all shards from (shared)
+    storage instead; the world-size resharding path of ``utils.elastic``
+    fits the result to the live geometry afterwards."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step:010d}")
+    ckpt = _checkpointer()
+    meta = ckpt.metadata(path).item_metadata.tree
+    restore_args = jax.tree.map(
+        lambda m: ocp.RestoreArgs(restore_type=np.ndarray), meta)
+    return ckpt.restore(path,
+                        args=ocp.args.PyTreeRestore(restore_args=restore_args))
+
+
+def leaf_shapes(path: str, *, step: Optional[int] = None) -> list:
+    """Shapes of the saved leaves in tree-leaf order, WITHOUT reading data
+    (orbax metadata only) — lets a restarting run detect that a checkpoint
+    was written by a different world geometry before attempting restore."""
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step:010d}")
+    meta = _checkpointer().metadata(path).item_metadata.tree
+    return [tuple(m.shape) for m in jax.tree.leaves(meta)]
 
 
 def list_steps(path: str) -> list:

@@ -94,6 +94,125 @@ def _discard_steps_above(ckpt_dir: str, start: int) -> None:
                           ignore_errors=True)
 
 
+def _proc_dirs(base: str) -> list:
+    """Old per-process checkpoint directories under ``base``, rank order."""
+    if not os.path.isdir(base):
+        return []
+    ds = [d for d in os.listdir(base)
+          if d.startswith("proc") and d[4:].isdigit()]
+    return [os.path.join(base, d)
+            for d in sorted(ds, key=lambda d: int(d[4:]))]
+
+
+def _foreign_frontier(base: str) -> int:
+    """Newest step common to the per-process directories under ``base``
+    (directories with no steps yet are excluded — their ranks resume from
+    peers' copies), or ``base``'s own newest step when no proc dirs exist
+    (an earlier single-process run).  0 = nothing to resume from."""
+    dirs = _proc_dirs(base)
+    if dirs:
+        per = [checkpoint.list_steps(d) for d in dirs]
+        per = [s for s in per if s]
+        return _max_common_step(per) if per else 0
+    steps = checkpoint.list_steps(base)
+    return steps[-1] if steps else 0
+
+
+def _stitch(base: str, step: int):
+    """Assemble the authoritative global state at ``step`` from every old
+    process's directory: rank-major rows are taken from their OWNING
+    process's copy (contiguous even blocks — uniform devices-per-proc, the
+    launcher's layout).  A directory missing the step contributes nothing;
+    its rows come from a donor's copy (at most one gossip round stale).
+    Requires ``base`` on storage every process can read."""
+    import numpy as np
+    dirs = _proc_dirs(base)
+    if not dirs:
+        # An old single-process or coordinated-layout run: one directory
+        # holds the full authoritative state (restore_host also handles
+        # checkpoints written as global arrays by a gone device geometry).
+        return checkpoint.restore_host(base, step=step)
+    raws = [checkpoint.restore_host(d, step=step)
+            if step in checkpoint.list_steps(d) else None for d in dirs]
+    donor = next(r for r in raws if r is not None)
+    donor_leaves = jax.tree.leaves(donor)
+    all_leaves = [jax.tree.leaves(r) if r is not None else None
+                  for r in raws]
+    out = []
+    for i, leaf in enumerate(donor_leaves):
+        s0 = np.asarray(leaf)
+        if s0.ndim == 0:
+            out.append(s0)
+            continue
+        blocks = np.array_split(np.arange(s0.shape[0]), len(dirs))
+        acc = s0.copy()
+        for k, rows in enumerate(blocks):
+            if all_leaves[k] is None or not len(rows):
+                continue
+            acc[rows] = np.asarray(all_leaves[k][i])[rows]
+        out.append(acc)
+    return jax.tree.unflatten(jax.tree.structure(donor), out)
+
+
+def _fit_leaf(saved, tgt):
+    """Fit one restored leaf to the live state's shape.  Equal shapes pass
+    through; a rank-major leaf whose leading (world-size) axis changed is
+    consensus-averaged over the old replicas and re-expanded by broadcast —
+    the consensus average is the decentralized iterates' best single
+    estimate (it is what the reference's papers evaluate), so every new
+    rank resumes from it."""
+    import numpy as np
+    s = np.asarray(saved)
+    tshape = tuple(np.shape(tgt))
+    if s.shape == tshape:
+        return s
+    if (s.ndim == len(tshape) and s.ndim >= 1
+            and s.shape[1:] == tshape[1:]):
+        avg = s.mean(axis=0).astype(s.dtype)
+        return np.broadcast_to(avg, tshape).copy()
+    raise ValueError(
+        f"elastic reshard: saved leaf shape {s.shape} does not map to the "
+        f"live state's {tshape} — only the leading rank-major axis may "
+        "change across world sizes")
+
+
+def _lookup(raw, path):
+    """Navigate a generically-restored orbax tree by a live-tree key path.
+
+    Orbax serializes NamedTuples as dicts keyed by field name and tuples/
+    lists as lists (sometimes dicts keyed by the stringified index), so a
+    plain ``jax.tree.leaves`` zip pairs leaves in a DIFFERENT order than
+    the live state whenever NamedTuple fields are not alphabetical —
+    silent state corruption.  Path navigation pairs by NAME instead."""
+    cur = raw
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            cur = cur[p.key]
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            cur = cur[p.name] if isinstance(cur, dict) \
+                else getattr(cur, p.name)
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            cur = cur[str(p.idx)] if isinstance(cur, dict) else cur[p.idx]
+        else:
+            raise TypeError(f"elastic reshard: unsupported tree key {p!r}")
+    return cur
+
+
+def _fit_state(raw, state):
+    """Fit a raw restored tree to the live state's structure, shapes and —
+    for globally-sharded target leaves — shardings.  Leaves are paired by
+    KEY PATH (see ``_lookup``), never by flat order.  The fitted values are
+    process-identical (consensus average of one shared view), so the
+    device_put's cross-process equality check holds by construction."""
+    fitted = []
+    for path, t in jax.tree_util.tree_flatten_with_path(state)[0]:
+        f = _fit_leaf(_lookup(raw, path), t)
+        if isinstance(t, jax.Array) and not t.is_fully_addressable:
+            f = jax.device_put(f, t.sharding)
+        fitted.append(f)
+    return jax.tree.unflatten(jax.tree.structure(state), fitted)
+
+
 def _agreed_start(ckpt_dir: str, per_process: bool) -> int:
     mine = checkpoint.list_steps(ckpt_dir)
     if not per_process or jax.process_count() == 1:
@@ -140,6 +259,7 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
     before returning (the "checkpoint saved" promise stays durable).
     """
     sharded = checkpoint.has_global_shards(state)
+    base_dir = ckpt_dir  # pre-suffix: where other world sizes' dirs live
     if jax.process_count() > 1:
         if sharded:
             # GSPMD state: ONE coordinated orbax checkpoint — every process
@@ -170,16 +290,79 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
     # allgather doubles as the barrier that keeps a fast process from
     # restoring while a late one still holds the old run's state.
     start = _agreed_start(ckpt_dir, per_process or sharded)
-    _discard_steps_above(ckpt_dir, start)
-    if start:
-        state = checkpoint.restore(ckpt_dir, step=start, target=state)
-        get_logger().info("elastic: resumed from step %d (%s)", start,
-                          ckpt_dir)
+    # WORLD-SIZE ELASTICITY (rank-major state only): a frontier left by a
+    # DIFFERENT incarnation geometry — more/fewer processes, or an old
+    # single-process run — that is newer than this geometry's own.  Stitch
+    # the authoritative rows from every old directory and fit the leaves to
+    # the live state (consensus-average + re-broadcast across the changed
+    # rank axis).  Needs shared storage; every process must see one view.
+    import numpy as np
+    live_shapes = sorted(tuple(np.shape(t)) for t in jax.tree.leaves(state))
+
+    def _geom_differs(dir_: str, s: int) -> bool:
+        # Multiset comparison: order-free (orbax metadata is key-sorted,
+        # the live tree is field-ordered) and a changed rank axis always
+        # changes the multiset.
+        return sorted(checkpoint.leaf_shapes(dir_, step=s)) != live_shapes
+
+    fstart = 0 if sharded else _foreign_frontier(base_dir)
+    if jax.process_count() > 1 and not sharded:
+        import zlib
+        from jax.experimental import multihost_utils
+        # The agreement must cover the VIEW, not just the frontier value:
+        # two hosts on non-shared storage can hold disjoint proc-dir
+        # subsets with equal frontiers and would stitch DIFFERENT states.
+        view = repr((fstart, sorted(os.path.basename(d)
+                                    for d in _proc_dirs(base_dir))))
+        views = np.asarray(multihost_utils.process_allgather(
+            np.int64(zlib.crc32(view.encode()))))
+        if not (views == views[0]).all():
+            # Non-shared storage: cross-geometry resume is impossible —
+            # degrade to the this-geometry agreement (the pre-elastic-
+            # resize behavior).
+            get_logger().warning(
+                "elastic: processes see different checkpoint directory "
+                "views (ckpt_dir not on shared storage?); world-size "
+                "elastic resume disabled for this restart")
+            fstart = 0
+    # The foreign path also covers a SAME-frontier geometry change: after a
+    # resharded resume crashes before its first new-geometry save, the old
+    # dirs still hold the frontier in the old shapes — without this check
+    # every restart would feed old-shape leaves to a new-shape restore and
+    # the job could never come back up.
+    if fstart and fstart >= start and not sharded \
+            and (fstart > start or _geom_differs(ckpt_dir, start)):
+        state = _fit_state(_stitch(base_dir, fstart), state)
+        start = fstart
+        _discard_steps_above(ckpt_dir, start)
+        get_logger().info(
+            "elastic: resumed from step %d with a world-size change "
+            "(resharded from %s)", start, base_dir)
         if on_restore is not None:
-            # Re-install side-band state the pytree cannot carry by itself
-            # (e.g. window-store buffers via
-            # ``opt.load_window_state_dict(state[...])``).
             on_restore(state, start)
+    else:
+        _discard_steps_above(ckpt_dir, start)
+        if start:
+            if sharded and _geom_differs(ckpt_dir, start):
+                # The coordinated (shared-dir) layout's world-size change:
+                # the old geometry's global arrays are read in full from
+                # shared storage, consensus-averaged over the changed rank
+                # axis, and re-placed into the live shardings.
+                state = _fit_state(
+                    checkpoint.restore_host(ckpt_dir, step=start), state)
+                get_logger().info(
+                    "elastic: resumed from step %d with a world-size "
+                    "change (coordinated layout, %s)", start, ckpt_dir)
+            else:
+                state = checkpoint.restore(ckpt_dir, step=start,
+                                           target=state)
+                get_logger().info("elastic: resumed from step %d (%s)",
+                                  start, ckpt_dir)
+            if on_restore is not None:
+                # Re-install side-band state the pytree cannot carry by
+                # itself (e.g. window-store buffers via
+                # ``opt.load_window_state_dict(state[...])``).
+                on_restore(state, start)
     if start >= num_steps:
         return state
 

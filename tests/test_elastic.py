@@ -199,14 +199,17 @@ def test_max_common_step_survives_pruned_frontiers():
 def test_restart_below_frontier_discards_stale_checkpoints(monkeypatch,
                                                            tmp_path):
     """A veteran forced to restart at step 0 (replacement peer had nothing
-    in common) must drop its stale newer dirs, or pruning would delete
-    every new save and the job would never checkpoint durably again."""
+    in common AND storage is not shared, so the cross-geometry frontier
+    degrades to 0 too) must drop its stale newer dirs, or pruning would
+    delete every new save and the job would never checkpoint durably
+    again."""
     from bluefog_tpu.utils import elastic
     step_fn, state0 = _make_step()
     d = str(tmp_path / "vet")
     for s in (98, 99, 100):  # veteran frontier from a previous life
         checkpoint.save(d, state0, step=s)
     monkeypatch.setattr(elastic, "_agreed_start", lambda *a: 0)
+    monkeypatch.setattr(elastic, "_foreign_frontier", lambda *a: 0)
     out = run_elastic(step_fn, state0, ckpt_dir=d, num_steps=5,
                       save_every=2, keep=2)
     assert int(out["count"]) == 5
@@ -341,3 +344,172 @@ def test_multiprocess_crash_and_resume(tmp_path):
     assert second.returncode == 0, (
         f"stdout={second.stdout}\nstderr={second.stderr}")
     assert second.stdout.count("ELASTIC-OK") == 2, second.stdout
+
+
+def test_world_size_reshard_unit(tmp_path):
+    """Resume at a different world size (single-process harness): four old
+    per-process dirs hold n=8 rank-major state with DISTINCT authoritative
+    rows (others stale); the new n=4 run stitches the authoritative rows,
+    consensus-averages them, and resumes from the old frontier.  The state
+    includes a NamedTuple with NON-alphabetical same-shape fields — the
+    reshard must pair leaves by key path, not flat order."""
+    import collections
+    St = collections.namedtuple("St", ["zz", "aa"])  # sorts to aa, zz
+    base = str(tmp_path / "ws")
+    n_old, P_old, D = 8, 4, 3
+    true = np.arange(n_old * D, dtype=np.float32).reshape(n_old, D)
+    for k in range(P_old):
+        copy = np.full((n_old, D), -1000.0, np.float32)  # stale poison
+        rows = np.array_split(np.arange(n_old), P_old)[k]
+        copy[rows] = true[rows]  # only owned rows authoritative
+        checkpoint.save(
+            os.path.join(base, f"proc{k}"),
+            {"w": copy, "count": np.int32(6),
+             "nt": St(zz=np.float32(11.0), aa=np.float32(22.0))}, step=6)
+
+    seen = {}
+
+    def on_restore(state, start):
+        seen["start"] = start
+        seen["w"] = np.asarray(state["w"]).copy()
+        seen["nt"] = state["nt"]
+
+    def step_fn(state, step):
+        return {"w": state["w"] + 1.0, "count": state["count"],
+                "nt": state["nt"]}
+
+    state0 = {"w": jnp.zeros((4, D), jnp.float32), "count": np.int32(0),
+              "nt": St(zz=np.float32(0.0), aa=np.float32(0.0))}
+    out = run_elastic(step_fn, state0, ckpt_dir=base, num_steps=8,
+                      save_every=100, on_restore=on_restore)
+    assert seen["start"] == 6
+    # Every new row is the consensus average of the 8 AUTHORITATIVE rows —
+    # the stale poison rows must not leak into the average.
+    np.testing.assert_allclose(seen["w"],
+                               np.broadcast_to(true.mean(0), (4, D)),
+                               rtol=1e-6)
+    # NamedTuple fields restored by NAME, not by sorted-key flat order.
+    assert float(seen["nt"].zz) == 11.0 and float(seen["nt"].aa) == 22.0
+    assert int(out["count"]) == 6  # non-rank-major leaf passes through
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               seen["w"] + 2.0, rtol=1e-6)  # steps 7, 8
+
+
+def test_world_size_reshard_survives_crash_before_first_save(tmp_path):
+    """After a world-size resume, a crash BEFORE the first new-geometry
+    save leaves only old-shape checkpoints at the frontier; the next
+    restart must reshard again (same frontier, differing geometry), not
+    wedge on a shape-mismatched restore."""
+    base = str(tmp_path / "ws2")
+    n_old, P_old, D = 8, 2, 3
+    true = np.arange(n_old * D, dtype=np.float32).reshape(n_old, D)
+    for k in range(P_old):
+        copy = np.full((n_old, D), -7.0, np.float32)
+        rows = np.array_split(np.arange(n_old), P_old)[k]
+        copy[rows] = true[rows]
+        checkpoint.save(os.path.join(base, f"proc{k}"), {"w": copy}, step=6)
+
+    def step_fn(state, step):
+        return {"w": state["w"] + 1.0}
+
+    state0 = {"w": jnp.zeros((4, D), jnp.float32)}
+    # First incarnation "crashes before saving": num_steps == frontier, so
+    # run_elastic restores (resharded) and returns without writing.
+    first = run_elastic(step_fn, state0, ckpt_dir=base, num_steps=6,
+                        save_every=100)
+    expect = np.broadcast_to(true.mean(0), (4, D))
+    np.testing.assert_allclose(np.asarray(first["w"]), expect, rtol=1e-6)
+    # Second incarnation: old dirs still hold the only frontier (old
+    # shapes); it must reshard again and complete.
+    second = run_elastic(step_fn, state0, ckpt_dir=base, num_steps=8,
+                         save_every=100)
+    np.testing.assert_allclose(np.asarray(second["w"]), expect + 2.0,
+                               rtol=1e-6)
+
+
+_WORLD_SIZE_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, "@REPO@")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import optax
+import bluefog_tpu as bf
+from bluefog_tpu.utils.elastic import run_elastic
+
+bf.init_distributed()
+n = bf.size()
+DIM, SAMPLES = 4, 16
+rng = np.random.RandomState(0)
+w_star = rng.randn(DIM, 1)
+A_all = rng.randn(8, SAMPLES, DIM)          # 8 shards, defined for n=8
+y_all = A_all @ w_star + 0.01 * rng.randn(8, SAMPLES, 1)
+A = jnp.asarray(A_all[:n])                   # this world size's shards
+y = jnp.asarray(y_all[:n])
+
+def compute_grads(params):
+    def loss(w_leaf, A_r, y_r):
+        return jnp.mean((A_r @ w_leaf - y_r) ** 2)
+    return {"w": jax.vmap(jax.grad(loss))(params["w"], A, y)}
+compute_grads = jax.jit(compute_grads)
+
+opt = bf.optim.DistributedNeighborAllreduceOptimizer(optax.sgd(0.05))
+params0 = {"w": jnp.asarray(
+    np.random.RandomState(1).randn(n, DIM, 1).astype(np.float32) * 2.0)}
+state0 = {"p": params0, "o": opt.init(params0)}
+
+def step_fn(state, step):
+    p, o = opt.step(state["p"], compute_grads(state["p"]), state["o"])
+    return {"p": p, "o": o}
+
+resumed_at = []
+def on_restore(state, start):
+    resumed_at.append(start)
+
+NUM = int(os.environ["NUM_STEPS"])
+# The collective optimizer's state is globally sharded over the mesh, so
+# the coordinated (shared-dir) checkpoint layout applies.
+out = run_elastic(step_fn, state0, ckpt_dir=os.environ["CKDIR"],
+                  num_steps=NUM, save_every=20, per_process=False,
+                  on_restore=on_restore)
+if os.environ.get("EXPECT_RESUME"):
+    assert resumed_at == [int(os.environ["EXPECT_RESUME"])], resumed_at
+w = bf.to_numpy(out["p"]["w"])
+pred = np.einsum('msd,ndo->mnso', np.asarray(A), w)
+mse = float(np.mean((pred - np.asarray(y)[:, None]) ** 2))
+assert mse < 0.05, f"world-size elastic MSE {mse}"
+print("WS-ELASTIC-OK", jax.process_index(), "mse", round(mse, 4), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_world_size_elastic_resume_under_bfrun(tmp_path):
+    """True elasticity (neither framework had it): train decentralized at
+    n=8 over 4 processes, stop, resume the SAME ckpt_dir at n=4 over 2
+    processes — the new gang stitches the old authoritative rows,
+    consensus-averages across the shrunk rank axis, resumes at the old
+    frontier, and converges."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "ws.py"
+    script.write_text(_WORLD_SIZE_SCRIPT.replace("@REPO@", repo))
+    env = dict(os.environ, CKDIR=str(tmp_path / "ck"))
+
+    def run(np_procs, steps, expect_resume=""):
+        e = dict(env, NUM_STEPS=str(steps))
+        if expect_resume:
+            e["EXPECT_RESUME"] = expect_resume
+        return subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.run", "-np", str(np_procs),
+             "--devices-per-proc", "2", sys.executable, str(script)],
+            capture_output=True, text=True, timeout=600, cwd=repo, env=e)
+
+    first = run(4, 60)
+    assert first.returncode == 0, \
+        f"stdout={first.stdout}\nstderr={first.stderr[-4000:]}"
+    assert first.stdout.count("WS-ELASTIC-OK") == 4, first.stdout
+
+    second = run(2, 140, expect_resume="60")
+    assert second.returncode == 0, \
+        f"stdout={second.stdout}\nstderr={second.stderr[-4000:]}"
+    assert second.stdout.count("WS-ELASTIC-OK") == 2, second.stdout
