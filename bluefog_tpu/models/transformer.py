@@ -121,7 +121,8 @@ class SwitchMlp(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from bluefog_tpu.parallel.moe import switch_dispatch
+        from bluefog_tpu.parallel.moe import (load_balance_loss,
+                                              switch_dispatch)
         cfg = self.cfg
         B, S, d = x.shape
         E = cfg.num_experts
@@ -140,17 +141,16 @@ class SwitchMlp(nn.Module):
         capacity = max(1, int(cfg.expert_capacity_factor * g / E))
         logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                           name="router")(xt.astype(jnp.float32))
+        # Padding tokens route nowhere: without the mask their all-zero
+        # logit rows argmax to expert 0, eat its capacity in the last
+        # group, and skew the balance statistic toward it.
+        valid = (jnp.arange(G * g) < T).astype(jnp.float32).reshape(G, g)
         combine, dispatch = jax.vmap(
-            lambda lg: switch_dispatch(lg, E, capacity))(logits)
-        # Load balance (Switch eq. 4 per group): E * sum_e f_e p_e with f_e
-        # the fraction of tokens ROUTED to e (pre-capacity argmax — the
-        # clipped dispatch would saturate the gradient exactly when an
-        # expert overflows).
-        probs = jax.nn.softmax(logits, axis=-1)             # (G, g, E)
-        routed = jax.nn.one_hot(jnp.argmax(probs, axis=-1), E,
-                                dtype=probs.dtype)
-        frac = routed.mean(axis=1)                          # (G, E)
-        aux = (E * (frac * probs.mean(axis=1)).sum(-1)).mean()
+            lambda lg, v: switch_dispatch(lg, E, capacity, v))(logits,
+                                                               valid)
+        # Load balance (Switch eq. 4, per routing group, mean over groups);
+        # single-sourced in parallel.moe.load_balance_loss.
+        aux = jax.vmap(load_balance_loss)(logits, valid).mean()
         self.sow("intermediates", "moe_aux_loss", aux)
         # batch_axis keeps fan_in per expert (= d / hidden), not E*d.
         init = nn.initializers.lecun_normal(batch_axis=(0,))

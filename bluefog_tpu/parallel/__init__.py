@@ -24,4 +24,5 @@ from bluefog_tpu.parallel.tensor_parallel import (  # noqa: F401
     tp_param_specs, tp_shard_params)
 from bluefog_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply, pipeline_train_step, pipeline_train_step_interleaved)
-from bluefog_tpu.parallel.moe import moe_apply, switch_dispatch  # noqa: F401
+from bluefog_tpu.parallel.moe import (  # noqa: F401
+    load_balance_loss, moe_apply, switch_dispatch)

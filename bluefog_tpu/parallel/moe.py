@@ -25,23 +25,53 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["moe_apply", "switch_dispatch"]
+__all__ = ["moe_apply", "switch_dispatch", "load_balance_loss"]
 
 
-def switch_dispatch(router_logits, n_experts: int, capacity: int):
+def load_balance_loss(router_logits, valid=None):
+    """Switch Transformer load-balancing auxiliary loss (eq. 4):
+    ``E * sum_e f_e * p_e`` over (T, E) logits, where ``f_e`` is the
+    fraction of tokens whose top-1 choice is expert ``e`` (PRE-capacity —
+    the clipped dispatch would saturate the gradient exactly when an
+    expert overflows) and ``p_e`` the mean router probability.  Minimized
+    (= 1) at a perfectly uniform router; add ``aux_weight *`` this to the
+    training loss or the router collapses onto one expert and capacity
+    drops become the only regularizer.
+
+    ``valid``: optional (T,) {0,1} mask — padding tokens are excluded from
+    both statistics (an all-zero pad row argmaxes to expert 0 and would
+    otherwise skew the balance toward it)."""
+    E = router_logits.shape[-1]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    routed = jax.nn.one_hot(jnp.argmax(probs, axis=-1), E,
+                            dtype=probs.dtype)
+    if valid is None:
+        return E * (routed.mean(axis=0) * probs.mean(axis=0)).sum()
+    w = valid.astype(probs.dtype)
+    w = w / jnp.maximum(w.sum(), 1.0)
+    f = (routed * w[:, None]).sum(axis=0)
+    p = (probs * w[:, None]).sum(axis=0)
+    return E * (f * p).sum()
+
+
+def switch_dispatch(router_logits, n_experts: int, capacity: int,
+                    valid=None):
     """Top-1 dispatch plan: ``(combine, dispatch)`` from (T, E) logits.
 
     ``dispatch``: (E, C, T) one-hot — slot c of expert e takes token t.
     ``combine``: (T, E, C) — same plan weighted by the router probability
     (the gradient path to the router).  Tokens past ``capacity`` for their
-    expert are dropped (all-zero rows), per Switch semantics."""
-    gate, keep, slot = _plan(router_logits, n_experts, capacity)
+    expert are dropped (all-zero rows), per Switch semantics.  ``valid``:
+    optional (T,) {0,1} mask — padding tokens route nowhere and occupy no
+    capacity slots (otherwise an all-zero pad row argmaxes to expert 0 and
+    real tokens behind it in the queue get dropped)."""
+    gate, keep, slot = _plan(router_logits, n_experts, capacity, valid)
     dispatch = jnp.einsum("te,tc->ect", keep, slot)         # (E, C, T)
     combine = jnp.einsum("t,ect->tec", gate, dispatch)      # (T, E, C)
     return combine, dispatch
 
 
-def _plan(router_logits, n_experts: int, capacity: int):
+def _plan(router_logits, n_experts: int, capacity: int, valid=None):
     """O(T*(E+C)) routing plan: ``(gate, keep, slot)`` — ranks slice out
     their own expert's column instead of materializing the dense (E, C, T)
     tensors (which are O(T^2) at the default capacity)."""
@@ -53,7 +83,10 @@ def _plan(router_logits, n_experts: int, capacity: int):
     probs = jax.nn.softmax(router_logits, axis=-1)          # (T, E)
     expert = jnp.argmax(probs, axis=-1)                     # (T,)
     onehot = jax.nn.one_hot(expert, E, dtype=probs.dtype)   # (T, E)
-    # Position of each token within its expert's queue.
+    if valid is not None:
+        onehot = onehot * valid.astype(probs.dtype)[:, None]
+    # Position of each token within its expert's queue (masked-out tokens
+    # are routed nowhere, so they consume no queue positions).
     pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot    # (T, E)
     keep = (pos < capacity) * onehot                        # (T, E)
     slot = jax.nn.one_hot(pos.sum(-1), capacity,
@@ -63,12 +96,27 @@ def _plan(router_logits, n_experts: int, capacity: int):
 
 
 def moe_apply(expert_fn, expert_params, x, router_logits, *,
-              axis_name: str = "ep", capacity: int | None = None):
+              axis_name: str = "ep", capacity: int | None = None,
+              with_aux: bool = False):
     """Apply this rank's expert within an ``axis_name``-wide MoE layer.
 
     ``x``: (T, d) tokens, replicated over the axis; ``router_logits``:
     (T, E) from a replicated router (E == axis size).  Returns (T, d) — the
-    gated sum of expert outputs, identical on every rank."""
+    gated sum of expert outputs, identical on every rank — or, with
+    ``with_aux=True``, ``(y, aux)`` where ``aux`` is the Switch
+    load-balancing loss for these logits (replicated; fold
+    ``aux_weight * aux`` into the training objective).
+
+    **Gradient convention.**  When every rank computes the SAME loss from
+    the psum'd output, differentiating that per-rank loss inflates every
+    gradient by ``axis_size`` (the psum transpose psums the replicated
+    cotangent — you are differentiating the sum of E identical losses).
+    Divide the per-rank objective by ``lax.axis_size(axis_name)``:
+    local-expert grads then come out exact with no extra collective, and
+    replicated-router grads are exact after a ``psum`` over the axis.
+    Report ``lax.psum(loss, axis_name)`` to recover the true loss value.
+    ``tests/test_parallel.py::test_moe_composes_with_decentralized_dp``
+    pins this against a dense single-device oracle."""
     E = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     T = x.shape[0]
@@ -83,4 +131,7 @@ def moe_apply(expert_fn, expert_params, x, router_logits, *,
     ye = expert_fn(expert_params, xe)                        # (C, d)
     my_combine = (gate * my_keep)[:, None] * slot            # (T, C)
     y = my_combine @ ye                                      # (T, d)
-    return lax.psum(y, axis_name)
+    y = lax.psum(y, axis_name)
+    if with_aux:
+        return y, load_balance_loss(router_logits)
+    return y

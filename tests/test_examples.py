@@ -214,3 +214,13 @@ def test_resource_allocation_methods(method, maxerr, iters, capsys):
     out = capsys.readouterr().out
     err = float(out.strip().split()[-1])
     assert err < maxerr, f"{method}: {err}"
+
+
+@pytest.mark.parametrize("combine", ["neighbor", "allreduce"])
+def test_moe_training_example(capsys, combine):
+    """ep x dp MoE training (switch routing + load-balance aux loss +
+    decentralized dp combine in one shard_map program): loss falls."""
+    run_example(f"{EXAMPLES}/moe_training.py",
+                ["--steps", "60", "--combine", combine])
+    out = capsys.readouterr().out
+    assert "MOE-TRAINING-OK" in out

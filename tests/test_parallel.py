@@ -725,3 +725,129 @@ def test_decentralized_combine_over_tp_sharded_params(devices):
     expected = np.einsum("sd,s...->d...", w_uni, np.asarray(W))
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_moe_composes_with_decentralized_dp(devices):
+    """ep x dp in ONE shard_map program: each dp rank trains its own
+    replica of a router + an ep-sharded expert bank, the Switch
+    load-balance aux loss in the objective, and the decentralized combine
+    on the dp axis (VERDICT r3 next-round #5).
+
+    Oracles: (a) one composed train step with identical data and an
+    allreduce dp-combine matches the DENSE single-device step (task +
+    aux gradients, incl. the 1/E psum scaling for replicated-router
+    grads) exactly; (b) with per-rank data and a static neighbor combine,
+    replicas move toward consensus and losses stay finite."""
+    from bluefog_tpu.ops import collective as C
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.parallel.moe import (load_balance_loss, moe_apply,
+                                          switch_dispatch)
+    from jax import lax
+
+    dp, E, T, d, CAP = 2, 4, 16, 6, 8
+    AUXW = 0.01
+    lr = 0.1
+    mesh = Mesh(np.asarray(jax.devices()[:dp * E]).reshape(dp, E),
+                ("dp", "ep"))
+    rng = np.random.RandomState(0)
+    Ws0 = jnp.asarray(rng.randn(E, d, d) * 0.5, jnp.float32)
+    Wr0 = jnp.asarray(rng.randn(d, E) * 0.5, jnp.float32)
+    x1 = jnp.asarray(rng.randn(T, d), jnp.float32)
+    t1 = jnp.asarray(rng.randn(T, d), jnp.float32)
+
+    # -- dense single-device reference step -------------------------------
+    def dense_loss(Ws, Wr, x, t):
+        lg = x @ Wr
+        combine, dispatch = switch_dispatch(lg, E, CAP)
+        y = jnp.zeros_like(x)
+        for e in range(E):
+            ye = jnp.tanh((dispatch[e] @ x) @ Ws[e])
+            y = y + jnp.moveaxis(combine, 1, 0)[e] @ ye
+        return jnp.mean((y - t) ** 2) + AUXW * load_balance_loss(lg)
+
+    dWs, dWr = jax.grad(dense_loss, argnums=(0, 1))(Ws0, Wr0, x1, t1)
+    ref_Ws = np.asarray(Ws0 - lr * dWs)
+    ref_Wr = np.asarray(Wr0 - lr * dWr)
+
+    # -- composed ep x dp step --------------------------------------------
+    def body(Ws, Wr, x, t, step, combine):
+        # shapes inside: Ws (1, 1, d, d) [dp, ep sharded]; Wr (1, d, E);
+        # x/t (1, T, d) [dp sharded].
+        def loss_fn(Ws, Wr):
+            lg = x[0] @ Wr[0]
+            y, aux = moe_apply(lambda w, z: jnp.tanh(z @ w[0, 0]),
+                               Ws, x[0], lg, axis_name="ep",
+                               capacity=CAP, with_aux=True)
+            # Per-rank objective = global loss / E (the moe_apply gradient
+            # convention: the psum transpose otherwise inflates every
+            # grad by E).
+            return ((jnp.mean((y - t[0]) ** 2) + AUXW * aux)
+                    / lax.axis_size("ep"))
+        loss, (gWs, gWr) = jax.value_and_grad(loss_fn,
+                                              argnums=(0, 1))(Ws, Wr)
+        gWr = lax.psum(gWr, "ep")  # replicated router: sum ep partials
+        loss = lax.psum(loss, "ep")  # true global loss for reporting
+        Ws = Ws - lr * gWs
+        Wr = Wr - lr * gWr
+        # Decentralized combine over the dp axis (replica mixing).
+        Ws = combine(Ws, step)
+        Wr = combine(Wr, step)
+        return Ws, Wr, loss[None]  # (1,): this dp rank's loss
+
+    def make_step(combine):
+        return jax.jit(jax.shard_map(
+            lambda Ws, Wr, x, t, step: body(Ws, Wr, x, t, step, combine),
+            mesh=mesh,
+            in_specs=(P("dp", "ep"), P("dp"), P("dp"), P("dp"), P()),
+            out_specs=(P("dp", "ep"), P("dp"), P("dp")),
+            check_vma=False))
+
+    # (a) identical data + allreduce over dp == the dense step
+    ar = make_step(lambda a, s: C.allreduce(a, "dp", average=True))
+    Ws = Ws0[None].repeat(dp, 0)                       # (dp, E, d, d)
+    Wr = Wr0[None].repeat(dp, 0)                       # (dp, d, E)
+    xs = x1[None].repeat(dp, 0)
+    ts = t1[None].repeat(dp, 0)
+    Ws1, Wr1, loss = ar(Ws, Wr, xs, ts, jnp.asarray(0, jnp.int32))
+    for r in range(dp):
+        np.testing.assert_allclose(np.asarray(Ws1[r]), ref_Ws,
+                                   rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(Wr1[r]), ref_Wr,
+                                   rtol=2e-5, atol=2e-6)
+
+    # (b) per-rank data + neighbor combine: finite, converging replicas
+    sched = S.compile_static(topo.RingGraph(dp), use_topo_weights=False)
+    nar = make_step(lambda a, s: C.neighbor_allreduce(a, sched, "dp"))
+    xs2 = jnp.asarray(rng.randn(dp, T, d), jnp.float32)
+    ts2 = jnp.asarray(rng.randn(dp, T, d), jnp.float32)
+    Ws, Wr = Ws0[None].repeat(dp, 0), Wr0[None].repeat(dp, 0)
+    Ws = Ws + jnp.asarray(rng.randn(dp, E, d, d) * 0.1, jnp.float32)
+    for s in range(5):
+        Ws, Wr, loss = nar(Ws, Wr, xs2, ts2, jnp.asarray(s, jnp.int32))
+        assert np.isfinite(float(loss.sum())), s
+    spread0 = float(np.abs(np.asarray(Ws)[0] - np.asarray(Ws)[1]).max())
+    assert spread0 < 0.1 * 2  # replicas pulled together by the combine
+
+
+def test_switch_dispatch_mask_excludes_padding():
+    """Padding tokens (all-zero logits, argmax -> expert 0) must not occupy
+    capacity slots, receive routing, or skew the load-balance statistic
+    when the validity mask is supplied."""
+    from bluefog_tpu.parallel.moe import load_balance_loss, switch_dispatch
+    E, C = 2, 2
+    logits = jnp.concatenate([jnp.zeros((3, E), jnp.float32),
+                              jnp.asarray([[2.0, 0.0]] * 3, jnp.float32)])
+    valid = jnp.asarray([0.0, 0.0, 0.0, 1.0, 1.0, 1.0])
+    cm, dm = switch_dispatch(logits, E, C, valid)
+    _, du = switch_dispatch(logits, E, C)
+    # UNMASKED: the pads fill expert 0's queue, real tokens are dropped.
+    assert float(du[0, :, 3:].sum()) == 0.0
+    # MASKED: pads route nowhere; the first two real tokens get the slots.
+    assert float(dm[0, :, :3].sum()) == 0.0
+    assert float(dm[0, :, 3:5].sum()) == 2.0
+    assert float(cm[:3].sum()) == 0.0
+    # The masked aux loss equals the loss over the real tokens alone.
+    np.testing.assert_allclose(float(load_balance_loss(logits, valid)),
+                               float(load_balance_loss(logits[3:])),
+                               rtol=1e-6)
