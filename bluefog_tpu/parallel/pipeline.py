@@ -73,7 +73,8 @@ def pipeline_apply(stage_fn, stage_params, microbatches, *,
 
 
 def pipeline_train_step(stage_fn, stage_params, microbatches, targets,
-                        loss_fn, *, axis_name: str = "pp"):
+                        loss_fn, *, axis_name: str = "pp",
+                        split_backward: bool = False):
     """One 1F1B training step: returns ``(loss, stage_grads)``.
 
     GPipe via reverse-mode AD (``jax.grad`` through :func:`pipeline_apply`)
@@ -108,13 +109,14 @@ def pipeline_train_step(stage_fn, stage_params, microbatches, targets,
     chunk_params = jax.tree.map(lambda x: x[None], stage_params)
     loss, grads = pipeline_train_step_interleaved(
         stage_fn, chunk_params, microbatches, targets, loss_fn,
-        axis_name=axis_name)
+        axis_name=axis_name, split_backward=split_backward)
     return loss, jax.tree.map(lambda g: g[0], grads)
 
 
 def pipeline_train_step_interleaved(stage_fn, chunk_params, microbatches,
                                     targets, loss_fn, *,
-                                    axis_name: str = "pp"):
+                                    axis_name: str = "pp",
+                                    split_backward: bool = False):
     """Interleaved (virtual-stage) 1F1B: each rank holds ``v`` NON-adjacent
     stage chunks, shrinking the pipeline bubble from O(n/M) to O(n/(vM)).
 
@@ -135,6 +137,22 @@ def pipeline_train_step_interleaved(stage_fn, chunk_params, microbatches,
     ``chunk_params``.  Same uniform-activation-shape constraint as the
     non-interleaved schedule; per-rank stash is O(v·S) = O(n·v²) microbatch
     inputs (vs O(M) for GPipe-through-AD).
+
+    ``split_backward=True`` is the zero-bubble (ZB-H1) refinement: the
+    backward tick computes ONLY the input gradient (recompute + dx — the
+    inter-stage critical path), pushing ``(x, cotangent)`` onto a small
+    per-chunk ring; the weight-gradient work (recompute + dp) pops from
+    the ring on forward/idle ticks, where the plain schedule leaves the
+    rank under-loaded.  In the lock-step scan model the tick count is
+    unchanged but the per-tick critical path drops from fwd+dx+dp (the
+    combined vjp) to fwd+dx, and the cooldown's idle parity slots absorb
+    the deferred W work — the ZB-H1 bubble-filling effect.  Parity
+    alternation bounds the ring depth at 2 (every B tick pushes one task,
+    every intervening non-B tick pops one), and a short drain tail
+    finishes the last tasks.  Gradients are bit-identical to the combined
+    schedule: the same (x, cot) pairs reach the same vjp, only later.
+    Cost: one extra stage-forward recompute per microbatch-stage (the
+    standard remat trade, extended to the split).
     """
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
@@ -150,8 +168,15 @@ def pipeline_train_step_interleaved(stage_fn, chunk_params, microbatches,
     def chunk_param(c):
         return jax.tree.map(lambda x: x[c], chunk_params)
 
+    K = 2  # W-ring capacity == the provable depth bound (parity alternation)
+
     def tick(carry, t):
-        stash, fwd_lanes, bwd_lanes, gparams, loss_acc = carry
+        if split_backward:
+            (stash, fwd_lanes, bwd_lanes, gparams, loss_acc,
+             wq_x, wq_cot, wq_head, wq_tail) = carry
+        else:
+            stash, fwd_lanes, bwd_lanes, gparams, loss_acc = carry
+            wq_x = wq_cot = wq_head = wq_tail = None
         # One (v, ...)-shaped hop per direction serves every chunk: lane c
         # carries stage c*n+r's output toward stage c*n+r+1.  A payload
         # leaving rank n-1 on lane c is CONSUMED by rank 0's chunk c+1, so
@@ -194,27 +219,67 @@ def pipeline_train_step_interleaved(stage_fn, chunk_params, microbatches,
             new_fwd = new_fwd.at[c].set(y_out)
 
             def do_bwd(op, c=c, s=s, j=j, p_c=p_c):
-                gparams, loss_acc = op
+                if split_backward:
+                    gparams, loss_acc, wq_x, wq_cot, wq_tail = op
+                else:
+                    gparams, loss_acc = op
                 x = lax.dynamic_index_in_dim(stash, c * S + j % S, 0,
                                              keepdims=False)
-                y, vjp_fn = jax.vjp(stage_fn, p_c, x)
+                if split_backward:
+                    # B pass only: dx via a vjp closed over the params —
+                    # dp's work is deferred to a W pop on a non-B tick.
+                    y, vjp_x = jax.vjp(lambda xx: stage_fn(p_c, xx), x)
+                else:
+                    y, vjp_fn = jax.vjp(stage_fn, p_c, x)
                 tgt = lax.dynamic_index_in_dim(
                     targets, jnp.minimum(j, M - 1), 0, keepdims=False)
                 lval, gy = jax.value_and_grad(loss_fn)(y, tgt)
                 cot = jnp.where(s == S - 1, gy, cot_in[c]).astype(y.dtype)
+                loss_acc = loss_acc + jnp.where(
+                    s == S - 1, lval.astype(jnp.float32), 0.0)
+                if split_backward:
+                    (dx,) = vjp_x(cot)
+                    wq_x = wq_x.at[c, wq_tail[c] % K].set(x)
+                    wq_cot = wq_cot.at[c, wq_tail[c] % K].set(cot)
+                    wq_tail = wq_tail.at[c].add(1)
+                    return gparams, loss_acc, wq_x, wq_cot, wq_tail, dx
                 dp, dx = vjp_fn(cot)
                 gparams = jax.tree.map(
                     lambda g, d, c=c: g.at[c].add(d), gparams, dp)
-                loss_acc = loss_acc + jnp.where(
-                    s == S - 1, lval.astype(jnp.float32), 0.0)
                 return gparams, loss_acc, dx
 
-            gparams, loss_acc, dx_out = lax.cond(
-                bwd_on, do_bwd, lambda op: (op[0], op[1], zero_act),
-                (gparams, loss_acc))
+            if split_backward:
+                (gparams, loss_acc, wq_x, wq_cot, wq_tail,
+                 dx_out) = lax.cond(
+                    bwd_on, do_bwd, lambda op: op + (zero_act,),
+                    (gparams, loss_acc, wq_x, wq_cot, wq_tail))
+            else:
+                gparams, loss_acc, dx_out = lax.cond(
+                    bwd_on, do_bwd, lambda op: op + (zero_act,),
+                    (gparams, loss_acc))
             new_bwd = new_bwd.at[c].set(dx_out)
 
-        return (stash, new_fwd, new_bwd, gparams, loss_acc), None
+            if split_backward:
+                # Deferred W work drains on any tick without a B for this
+                # chunk (forward ticks and the warmup/cooldown bubbles).
+                def do_w(op, c=c, p_c=p_c):
+                    gparams, wq_head = op
+                    x = wq_x[c, wq_head[c] % K]
+                    cot = wq_cot[c, wq_head[c] % K]
+                    _, vjp_p = jax.vjp(lambda pp: stage_fn(pp, x), p_c)
+                    (dp,) = vjp_p(cot)
+                    gparams = jax.tree.map(
+                        lambda g, d, c=c: g.at[c].add(d), gparams, dp)
+                    return gparams, wq_head.at[c].add(1)
+
+                gparams, wq_head = lax.cond(
+                    (~bwd_on) & (wq_head[c] < wq_tail[c]), do_w,
+                    lambda op: op, (gparams, wq_head))
+
+        out = (stash, new_fwd, new_bwd, gparams, loss_acc)
+        if split_backward:
+            out = out + (wq_x, wq_cot, wq_head, wq_tail)
+        return out, None
 
     # Stash: S slots per chunk — an early stage s holds up to S - s
     # in-flight microbatches (its backward trails by 2(S - s) - 1 ticks),
@@ -224,8 +289,18 @@ def pipeline_train_step_interleaved(stage_fn, chunk_params, microbatches,
               zero_lane, zero_lane,
               jax.tree.map(jnp.zeros_like, chunk_params),
               jnp.zeros((), jnp.float32))
-    (_, _, _, gparams, loss_acc), _ = lax.scan(
-        tick, carry0, jnp.arange(2 * M + 2 * S - 2))
+    if split_backward:
+        wq_shape = (v, K) + act_shape
+        carry0 = carry0 + (
+            jnp.zeros(wq_shape, microbatches.dtype),
+            jnp.zeros(wq_shape, microbatches.dtype),
+            jnp.zeros((v,), jnp.int32), jnp.zeros((v,), jnp.int32))
+    # Split mode appends a short drain tail: after the final original tick
+    # the per-chunk ring holds at most one deferred W task, and every extra
+    # all-idle tick pops one per chunk (2 ticks = one plus margin).
+    ticks = 2 * M + 2 * S - 2 + (2 if split_backward else 0)
+    final_carry, _ = lax.scan(tick, carry0, jnp.arange(ticks))
+    gparams, loss_acc = final_carry[3], final_carry[4]
     loss = lax.psum(jnp.where(me == n - 1, loss_acc, 0.0), axis_name) / M
     grads = jax.tree.map(lambda g: g / M, gparams)
     return loss, grads

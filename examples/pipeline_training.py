@@ -26,7 +26,7 @@ def main():
     ap.add_argument("--microbatches", type=int, default=8)
     ap.add_argument("--microbatch-size", type=int, default=16)
     ap.add_argument("--width", type=int, default=32)
-    ap.add_argument("--schedule", choices=["gpipe", "1f1b"],
+    ap.add_argument("--schedule", choices=["gpipe", "1f1b", "zb"],
                     default="gpipe",
                     help="gpipe: AD through pipeline_apply (O(M) "
                          "residuals); 1f1b: in-scan manual VJP "
@@ -75,13 +75,16 @@ def main():
               jax.device_put(bs, NamedSharding(mesh, P("pp"))))
     state = opt.init(params)
 
-    if args.schedule == "1f1b":
+    if args.schedule in ("1f1b", "zb"):
         def mb_loss(out, tb):
             return jnp.mean((out - tb) ** 2)
 
+        # "zb" = ZB-H1 split backward: input-grad on the B tick, deferred
+        # weight-grad filling forward/idle ticks (same gradients).
         onef1b = jax.shard_map(
             lambda p, xb, tb: pipeline_train_step(
-                stage_fn, p, xb, tb, mb_loss, axis_name="pp"),
+                stage_fn, p, xb, tb, mb_loss, axis_name="pp",
+                split_backward=(args.schedule == "zb")),
             mesh=mesh, in_specs=((P("pp"), P("pp")), P(), P()),
             out_specs=(P(), (P("pp"), P("pp"))), check_vma=False)
 

@@ -94,7 +94,7 @@ def test_tensor_parallel_training_example(capsys):
     assert "kernel sharding PartitionSpec(None, 'tp')" in out
 
 
-@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "zb"])
 def test_pipeline_training_example(capsys, schedule):
     """Pipelined training (GPipe-through-AD and 1F1B): one stage per
     device, loss falls, pipelined forward equals the sequential stack."""
@@ -102,7 +102,7 @@ def test_pipeline_training_example(capsys, schedule):
                 ["--steps", "60", "--schedule", schedule])
     out = capsys.readouterr().out
     assert "matches the sequential stack" in out
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "zb"):
         assert "compiled temp memory" in out
 
 

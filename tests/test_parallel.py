@@ -381,9 +381,11 @@ def test_moe_grads_flow_to_router_and_experts(devices):
     assert float(jnp.abs(g_r).max()) > 0
 
 
-def test_1f1b_pipeline_matches_sequential_grads():
-    """pipeline_train_step (1F1B, manual in-scan VJP) must reproduce the
-    loss and per-stage gradients of running the stages sequentially."""
+@pytest.mark.parametrize("split_backward", [False, True])
+def test_1f1b_pipeline_matches_sequential_grads(split_backward):
+    """pipeline_train_step (1F1B, manual in-scan VJP; with and without the
+    ZB-H1 split backward) must reproduce the loss and per-stage gradients
+    of running the stages sequentially."""
     n, M, mb, d = 4, 8, 3, 5
     mesh = Mesh(np.asarray(jax.devices()[:n]), ("pp",))
     rng = np.random.RandomState(0)
@@ -402,7 +404,8 @@ def test_1f1b_pipeline_matches_sequential_grads():
     from bluefog_tpu.parallel import pipeline_train_step
     loss_pp, grads_pp = jax.jit(jax.shard_map(
         lambda p, xb, tb: pipeline_train_step(
-            stage_fn, p, xb, tb, loss_fn, axis_name="pp"),
+            stage_fn, p, xb, tb, loss_fn, axis_name="pp",
+            split_backward=split_backward),
         mesh=mesh, in_specs=((P("pp"), P("pp")), P(), P()),
         out_specs=(P(), (P("pp"), P("pp"))), check_vma=False))(
             (Ws, bs), x, tgt)
@@ -567,9 +570,11 @@ def test_1f1b_composes_with_decentralized_dp():
     assert spread < first_spread, (spread, first_spread)
 
 
-def test_interleaved_1f1b_matches_sequential_grads():
-    """Interleaved 1F1B (v virtual stage chunks per rank): loss and
-    per-chunk gradients must reproduce the sequential n*v-stage stack."""
+@pytest.mark.parametrize("split_backward", [False, True])
+def test_interleaved_1f1b_matches_sequential_grads(split_backward):
+    """Interleaved 1F1B (v virtual stage chunks per rank; plain and ZB-H1
+    split-backward): loss and per-chunk gradients must reproduce the
+    sequential n*v-stage stack."""
     n, v, M, mb, d = 4, 2, 6, 3, 5
     S = n * v
     mesh = Mesh(np.asarray(jax.devices()[:n]), ("pp",))
@@ -599,7 +604,7 @@ def test_interleaved_1f1b_matches_sequential_grads():
         # strip the shard axis: per-device leaves are (1, v, ...)
         loss, g = pipeline_train_step_interleaved(
             stage_fn, jax.tree.map(lambda a: a[0], p), xb, tb, loss_fn,
-            axis_name="pp")
+            axis_name="pp", split_backward=split_backward)
         return loss, jax.tree.map(lambda a: a[None], g)
 
     loss_pp, grads_pp = jax.jit(jax.shard_map(
