@@ -856,3 +856,102 @@ def test_switch_dispatch_mask_excludes_padding():
     np.testing.assert_allclose(float(load_balance_loss(logits, valid)),
                                float(load_balance_loss(logits[3:])),
                                rtol=1e-6)
+
+
+def test_dp_tp_pp_composed_in_one_program(devices):
+    """dp x tp x pp in ONE shard_map program (VERDICT r3 next-round #10):
+    each dp replica runs a pp-deep pipeline whose stages are tp-sharded
+    Megatron MLPs (column-parallel in, row-parallel out, one psum), with
+    the decentralized combine on the dp axis after the optimizer step.
+
+    Oracle at (dp, tp, pp) = (2, 2, 2): identical data + the uniform
+    2-ring neighbor combine (== the exact average at dp=2) must reproduce
+    the DENSE sequential stack's loss and updated parameters exactly (the
+    tp replicated-loss convention — divide the microbatch loss by the tp
+    axis size — keeps gradients unscaled)."""
+    from bluefog_tpu.ops import collective as C
+    from bluefog_tpu.parallel import pipeline_train_step
+    from jax import lax
+
+    dp, tp, pp, M, mb, d, hid = 2, 2, 2, 4, 3, 6, 8
+    lr = 0.1
+    mesh = Mesh(np.asarray(jax.devices()[:dp * tp * pp]).reshape(dp, tp, pp),
+                ("dp", "tp", "pp"))
+    rng = np.random.RandomState(0)
+    Wi = jnp.asarray(rng.randn(pp, d, hid) * 0.4, jnp.float32)
+    Wo = jnp.asarray(rng.randn(pp, hid, d) * 0.4, jnp.float32)
+    x = jnp.asarray(rng.randn(M, mb, d), jnp.float32)
+    tgt = jnp.asarray(rng.randn(M, mb, d), jnp.float32)
+
+    # -- dense sequential reference --------------------------------------
+    def seq_loss(params):
+        Wi, Wo = params
+        def per_mb(xb, tb):
+            h = xb
+            for s in range(pp):
+                h = jnp.maximum(h @ Wi[s], 0.0) @ Wo[s]
+            return jnp.mean((h - tb) ** 2)
+        return jnp.mean(jax.vmap(per_mb)(x, tgt))
+
+    loss_ref, g_ref = jax.value_and_grad(seq_loss)((Wi, Wo))
+    ref_Wi = np.asarray(Wi - lr * g_ref[0])
+    ref_Wo = np.asarray(Wo - lr * g_ref[1])
+
+    # -- composed program -------------------------------------------------
+    def stage_fn(p, xb):
+        wi, wo = p  # local: (1, 1, 1, d, hid/tp), (1, 1, 1, hid/tp, d)
+        h = jnp.maximum(xb @ wi[0, 0, 0], 0.0)    # column-parallel
+        return lax.psum(h @ wo[0, 0, 0], "tp")    # row-parallel + combine
+
+    def mb_loss(y, t):
+        # tp replicated-loss convention: every tp rank computes the same
+        # loss from the psum'd activation; dividing by the axis size keeps
+        # the psum-transposed gradients exact.
+        return jnp.mean((y - t) ** 2) / lax.axis_size("tp")
+
+    # The DECENTRALIZED combine on dp: at dp=2 on a uniform-weight ring,
+    # neighbor averaging equals the exact average, so the dense oracle
+    # covers the real gossip path (schedule + ppermute pairing on a
+    # 3-axis mesh), not just C.allreduce.
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu import topology as topo
+    sched = S.compile_static(topo.RingGraph(dp), use_topo_weights=False)
+
+    def body(p, xb, tb):
+        loss, g = pipeline_train_step(
+            stage_fn, p, xb[0], tb[0], mb_loss, axis_name="pp")
+        p = jax.tree.map(lambda a, b: a - lr * b, p, g)
+        p = jax.tree.map(
+            lambda a: C.neighbor_allreduce(a, sched, "dp"), p)
+        return p, (loss * lax.axis_size("tp"))[None]
+
+    step = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=((P("dp", "tp", "pp"), P("dp", "tp", "pp")),
+                  P("dp"), P("dp")),
+        out_specs=((P("dp", "tp", "pp"), P("dp", "tp", "pp")), P("dp")),
+        check_vma=False))
+
+    # Layouts: Wi (dp, tp, pp, d, hid/tp) — tp shards the HIDDEN axis; the
+    # shard_map in_spec shards the leading replica axes, so pre-split the
+    # hidden axis into the tp position.
+    Wi_l = jnp.stack([Wi[:, :, k * (hid // tp):(k + 1) * (hid // tp)]
+                      for k in range(tp)])               # (tp, pp, d, h/tp)
+    Wo_l = jnp.stack([Wo[:, k * (hid // tp):(k + 1) * (hid // tp), :]
+                      for k in range(tp)])               # (tp, pp, h/tp, d)
+    Wi_g = Wi_l[None].repeat(dp, 0)                      # (dp, tp, pp, ...)
+    Wo_g = Wo_l[None].repeat(dp, 0)
+    xs = x[None].repeat(dp, 0)
+    ts = tgt[None].repeat(dp, 0)
+
+    (Wi1, Wo1), loss = step((Wi_g, Wo_g), xs, ts)
+    np.testing.assert_allclose(float(loss[0]), float(loss_ref), rtol=1e-5)
+    # Reassemble the tp shards and compare every dp replica to the dense
+    # sequential update.
+    for r in range(dp):
+        got_Wi = np.concatenate([np.asarray(Wi1[r, k]) for k in range(tp)],
+                                axis=-1)
+        got_Wo = np.concatenate([np.asarray(Wo1[r, k]) for k in range(tp)],
+                                axis=-2)
+        np.testing.assert_allclose(got_Wi, ref_Wi, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(got_Wo, ref_Wo, rtol=2e-5, atol=2e-6)
