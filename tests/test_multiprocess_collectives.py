@@ -391,12 +391,19 @@ init_w = (np.random.RandomState(1).randn(n, DIM, 1) * 2.0).astype(np.float32)
 params = {"w": jnp.asarray(init_w)}
 opt = bf.optim.DistributedPushSumOptimizer(optax.sgd(0.05))
 state = opt.init(params)
-for _ in range(150):
+for s in range(150):
     # SGP dynamics: gradients at the DE-BIASED iterates (optimizer
     # docstring; Assran et al.) — under real transport delay the biased
     # iterates can carry tiny P mass, where raw-params gradients explode.
     params, state = opt.step(params, compute_grads(opt.debias(params)),
                              state)
+    if (s + 1) % 25 == 0:
+        # Bound the staleness: on a contended host one process can stall
+        # while peers race ahead, leaving most of its P mass in flight for
+        # many rounds (p -> 0, de-bias blows up).  A periodic collect is
+        # the push-sum analogue of the reference examples' periodic
+        # barriers.
+        params = opt.collect(params)
 # Evaluation-time collect: drain ALL in-flight gossip mass (fence+barrier)
 # so the de-bias snapshot is exact, not mid-flight.
 params = opt.collect(params)
