@@ -555,30 +555,34 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         # rank mutex (a remote holder's REL would be queued behind us —
         # deadlock).  Slot atomicity comes from win.lock; writer exclusion
         # is the sender's job via the distributed mutex (_remote_mutex).
-        row = _payload_row(win, payload, compressed)
-        with win.lock:
-            if (dst, src) not in win.staging:
-                return
-            if op == OP_ACCUMULATE:
-                win.staging[(dst, src)] += row * win.dtype.type(weight)
-            else:
-                win.staging[(dst, src)] = row * win.dtype.type(weight)
-            win.versions[dst, src] += 1
-            if _store.associated_p_enabled:
+        from bluefog_tpu.utils.timeline import op_span
+        with op_span(f"win_apply.{name}.{src}->{dst}", "COMMUNICATE"):
+            row = _payload_row(win, payload, compressed)
+            with win.lock:
+                if (dst, src) not in win.staging:
+                    return
                 if op == OP_ACCUMULATE:
-                    win.p_staging[(dst, src)] += p_weight
+                    win.staging[(dst, src)] += row * win.dtype.type(weight)
                 else:
-                    win.p_staging[(dst, src)] = p_weight
+                    win.staging[(dst, src)] = row * win.dtype.type(weight)
+                win.versions[dst, src] += 1
+                if _store.associated_p_enabled:
+                    if op == OP_ACCUMULATE:
+                        win.p_staging[(dst, src)] += p_weight
+                    else:
+                        win.p_staging[(dst, src)] = p_weight
     elif op == OP_GET_REQ:
         _store.svc_pool.submit(_reply_get, name, src, dst, weight)
     elif op == OP_GET_REPLY:
-        row = _payload_row(win, payload, compressed)
-        with win.lock:
-            if (dst, src) in win.staging:
-                win.staging[(dst, src)] = row * win.dtype.type(weight)
-                win.versions[dst, src] += 1
-                if _store.associated_p_enabled:
-                    win.p_staging[(dst, src)] = p_weight
+        from bluefog_tpu.utils.timeline import op_span
+        with op_span(f"win_apply.{name}.{src}->{dst}", "COMMUNICATE"):
+            row = _payload_row(win, payload, compressed)
+            with win.lock:
+                if (dst, src) in win.staging:
+                    win.staging[(dst, src)] = row * win.dtype.type(weight)
+                    win.versions[dst, src] += 1
+                    if _store.associated_p_enabled:
+                        win.p_staging[(dst, src)] = p_weight
         with d.cv:
             key = (name, dst, src)
             d.pending_gets[key] = d.pending_gets.get(key, 0) - 1
@@ -600,7 +604,8 @@ def _neighbors_from_topology():
 
 
 def _resolve_edge_weights(weights, nbrs_of, default: float, *,
-                          peer_is_src: bool = False) -> Dict[tuple, float]:
+                          peer_is_src: bool = False,
+                          ranks=None) -> Dict[tuple, float]:
     """Normalize dst/src weight arguments to ``{(rank, peer): w}``.
 
     ``weights`` may be None (every edge gets ``default``), a full (n, n)
@@ -609,24 +614,28 @@ def _resolve_edge_weights(weights, nbrs_of, default: float, *,
     reference's per-process dicts).  ``peer_is_src`` marks in-neighbor
     callers (win_get / win_update), where ``r`` is the destination, so the
     matrix lookup is ``W[peer, r]`` instead of ``W[r, peer]``.
-    """
+
+    ``ranks`` restricts the ``r`` enumeration (callers pass the window's
+    owned ranks: non-owned edges would be filtered later anyway, and at pod
+    scale an O(n·deg) python dict per op call is real latency)."""
     out: Dict[tuple, float] = {}
     n = len(nbrs_of)
+    rs = range(n) if ranks is None else ranks
     if weights is None:
-        for r in range(n):
+        for r in rs:
             for peer in nbrs_of[r]:
                 out[(r, peer)] = default
     elif isinstance(weights, dict):
         if weights and isinstance(next(iter(weights)), tuple):
             return {k: float(v) for k, v in weights.items()}
-        for r in range(n):
+        for r in rs:
             for peer in nbrs_of[r]:
                 if peer in weights:
                     out[(r, peer)] = float(weights[peer])
     else:
         w = np.asarray(weights, dtype=float)
         assert w.shape == (n, n), "weight matrix must be (size, size)"
-        for r in range(n):
+        for r in rs:
             for peer in nbrs_of[r]:
                 out[(r, peer)] = float(w[peer, r] if peer_is_src else w[r, peer])
     return out
@@ -727,73 +736,99 @@ def _validate_payload(win: _Window, t: np.ndarray, op: str) -> None:
 
 def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
             require_mutex: bool, accumulate: bool, self_weight=None) -> None:
+    from bluefog_tpu.utils.timeline import op_span
     try:
         win = _store.get(name)
     except KeyError:
         return  # window freed after dispatch; put becomes a no-op
     op = OP_ACCUMULATE if accumulate else OP_PUT
+    kind = "win_accumulate" if accumulate else "win_put"
     for (src, dst), w in edges.items():
         if not _owns(src):
             continue  # src's owner performs this edge
         row = win.row_of[src]  # caller-side row index of the source rank
-        if not _owns(dst):
-            # Remote edge: ship the raw row + weight; the owner's drain
-            # thread scales and applies (one-sided put completion = local
-            # send completion; remote visibility is ordered by win_fence /
-            # win_update, as with MPI_Put).  require_mutex maps to the
-            # writer-side distributed mutex, as in the reference.
-            with win.lock:
-                p_w = w * float(win.p_main[src]) \
-                    if _store.associated_p_enabled else 0.0
-            # Cast to the window dtype: the receiver reconstructs the row
-            # with frombuffer(win.dtype), so a mismatched payload would be
-            # dropped on exactly the cross-process edges.
-            payload = np.ascontiguousarray(tensor[row], dtype=win.dtype)
-            if require_mutex:
-                with _remote_mutex(name, dst, src):
-                    _send_to_rank_owner(dst, op, name, src, dst, w, p_w,
-                                        payload)
-            else:
-                _send_to_rank_owner(dst, op, name, src, dst, w, p_w, payload)
-            continue
-        payload = tensor[row] * win.dtype.type(w)
-        mutex = win.mutexes[dst] if require_mutex else None
-        if mutex:
-            mutex.acquire()
-        try:
-            with win.lock:
-                if (dst, src) not in win.staging:
-                    continue  # window freed concurrently
-                if accumulate:
-                    win.staging[(dst, src)] += payload
-                else:
-                    win.staging[(dst, src)] = payload.copy()
-                win.versions[dst, src] += 1
-                if _store.associated_p_enabled:
-                    if accumulate:
-                        win.p_staging[(dst, src)] += w * win.p_main[src]
-                    else:
-                        win.p_staging[(dst, src)] = w * win.p_main[src]
-        finally:
-            if mutex:
-                mutex.release()
+        # Per-edge span: the host-side path can show what one fused XLA
+        # program cannot — each (src, dst) transfer individually (the
+        # reference's per-phase timeline granularity, applied per edge).
+        with op_span(f"{kind}.{name}.{src}->{dst}", "COMMUNICATE"):
+            _do_put_edge(win, name, tensor, row, src, dst, w, op,
+                         accumulate, require_mutex)
     if self_weight is not None:
-        # Self-scaling happens AFTER the edge sends so outgoing payloads carry
-        # the PRE-scaled associated-P mass (column-stochastic conservation:
-        # self_weight + sum of dst weights == 1 must hold on p_old).  Only
-        # owned rows are authoritative here.
-        sw = np.asarray(self_weight, dtype=float)
+        _publish_self(win, tensor, self_weight)
+
+
+def _do_put_edge(win, name, tensor, row, src, dst, w, op, accumulate,
+                 require_mutex) -> None:
+    """One (src, dst) edge of a put/accumulate (src owned here)."""
+    if not _owns(dst):
+        # Remote edge: ship the raw row + weight; the owner's drain
+        # thread scales and applies (one-sided put completion = local
+        # send completion; remote visibility is ordered by win_fence /
+        # win_update, as with MPI_Put).  require_mutex maps to the
+        # writer-side distributed mutex, as in the reference.
         with win.lock:
-            sw_vec = sw if sw.ndim else np.full(win.n, float(sw))
-            for r in win.owned:
-                # Explicit cast: a float64 payload on a float32 window must
-                # not leak wider rows into main (cross-process GET replies
-                # and state-dict round trips size rows by win.dtype).
-                win.main[r] = np.asarray(
-                    tensor[win.row_of[r]] * sw_vec[r], dtype=win.dtype)
-                win.main_versions[r] += 1
-                if _store.associated_p_enabled:
-                    win.p_main[r] *= sw_vec[r]
+            p_w = w * float(win.p_main[src]) \
+                if _store.associated_p_enabled else 0.0
+        # Cast to the window dtype: the receiver reconstructs the row
+        # with frombuffer(win.dtype), so a mismatched payload would be
+        # dropped on exactly the cross-process edges.
+        payload = np.ascontiguousarray(tensor[row], dtype=win.dtype)
+        if require_mutex:
+            with _remote_mutex(name, dst, src):
+                _send_to_rank_owner(dst, op, name, src, dst, w, p_w,
+                                    payload)
+        else:
+            _send_to_rank_owner(dst, op, name, src, dst, w, p_w, payload)
+        return
+    # Cast once: a float64 input on a float32 window must not widen the
+    # staging slot (same invariant as _publish_self and the remote path).
+    payload = np.asarray(tensor[row] * w, dtype=win.dtype)
+    mutex = win.mutexes[dst] if require_mutex else None
+    if mutex:
+        mutex.acquire()
+    try:
+        with win.lock:
+            if (dst, src) not in win.staging:
+                return  # window freed concurrently
+            if accumulate:
+                win.staging[(dst, src)] += payload
+            else:
+                win.staging[(dst, src)] = payload.copy()
+            win.versions[dst, src] += 1
+            if _store.associated_p_enabled:
+                if accumulate:
+                    win.p_staging[(dst, src)] += w * win.p_main[src]
+                else:
+                    win.p_staging[(dst, src)] = w * win.p_main[src]
+    finally:
+        if mutex:
+            mutex.release()
+
+
+def _publish_self(win, tensor, self_weight) -> None:
+    # Self-scaling happens AFTER the edge sends so outgoing payloads carry
+    # the PRE-scaled associated-P mass (column-stochastic conservation:
+    # self_weight + sum of dst weights == 1 must hold on p_old).  Only
+    # owned rows are authoritative here.
+    sw = np.asarray(self_weight, dtype=float)
+    if sw.ndim and sw.shape != (win.n,):
+        # The vector form is GLOBAL-rank indexed (n,), even for owned-
+        # layout windows — an owned-length vector would silently mis-scale
+        # on process 0 and index out of bounds everywhere else.
+        raise ValueError(
+            f"self_weight vector must have shape ({win.n},) — one entry "
+            f"per global rank — got {sw.shape}")
+    with win.lock:
+        sw_vec = sw if sw.ndim else np.full(win.n, float(sw))
+        for r in win.owned:
+            # Explicit cast: a float64 payload on a float32 window must
+            # not leak wider rows into main (cross-process GET replies
+            # and state-dict round trips size rows by win.dtype).
+            win.main[r] = np.asarray(
+                tensor[win.row_of[r]] * sw_vec[r], dtype=win.dtype)
+            win.main_versions[r] += 1
+            if _store.associated_p_enabled:
+                win.p_main[r] *= sw_vec[r]
 
 
 def win_put_nonblocking(tensor, name: str, *, self_weight=None,
@@ -809,7 +844,8 @@ def win_put_nonblocking(tensor, name: str, *, self_weight=None,
     t = _to_numpy(tensor)
     win = _store.get(name)  # raise early on unknown window
     _validate_payload(win, t, "win_put")
-    edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0)
+    edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0,
+                                  ranks=win.owned)
     _validate_edges(edges, win.out_nbrs, peer_is_src=False, op="win_put")
     from bluefog_tpu.utils.timeline import op_span
 
@@ -838,7 +874,8 @@ def win_accumulate_nonblocking(tensor, name: str, *, self_weight=None,
     t = _to_numpy(tensor)
     win = _store.get(name)  # raise early on unknown window
     _validate_payload(win, t, "win_accumulate")
-    edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0)
+    edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0,
+                                  ranks=win.owned)
     _validate_edges(edges, win.out_nbrs, peer_is_src=False,
                     op="win_accumulate")
     from bluefog_tpu.utils.timeline import op_span
@@ -859,6 +896,7 @@ def win_accumulate(tensor, name: str, *, self_weight=None,
 
 
 def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
+    from bluefog_tpu.utils.timeline import op_span
     try:
         win = _store.get(name)
     except KeyError:
@@ -871,20 +909,22 @@ def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
         if not _owns(src):
             remote.append((dst, src, w))
             continue
-        mutex = win.mutexes[src] if require_mutex else None
-        if mutex:
-            mutex.acquire()
-        try:
-            with win.lock:
-                if (dst, src) not in win.staging:
-                    continue
-                win.staging[(dst, src)] = win.main[src] * win.dtype.type(w)
-                win.versions[dst, src] += 1
-                if _store.associated_p_enabled:
-                    win.p_staging[(dst, src)] = w * win.p_main[src]
-        finally:
+        with op_span(f"win_get.{name}.{src}->{dst}", "COMMUNICATE"):
+            mutex = win.mutexes[src] if require_mutex else None
             if mutex:
-                mutex.release()
+                mutex.acquire()
+            try:
+                with win.lock:
+                    if (dst, src) not in win.staging:
+                        continue
+                    win.staging[(dst, src)] = (win.main[src]
+                                               * win.dtype.type(w))
+                    win.versions[dst, src] += 1
+                    if _store.associated_p_enabled:
+                        win.p_staging[(dst, src)] = w * win.p_main[src]
+            finally:
+                if mutex:
+                    mutex.release()
     if remote:
         # One-sided pull: request each remote row, then wait for the replies
         # (the blocking analogue of chunked MPI_Get, mpi_controller.cc:1123).
@@ -893,7 +933,8 @@ def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
                 key = (name, dst, src)
                 d.pending_gets[key] = d.pending_gets.get(key, 0) + 1
         for (dst, src, w) in remote:
-            _send_to_rank_owner(src, OP_GET_REQ, name, src, dst, w)
+            with op_span(f"win_get_req.{name}.{src}->{dst}", "COMMUNICATE"):
+                _send_to_rank_owner(src, OP_GET_REQ, name, src, dst, w)
         deadline_keys = [(name, dst, src) for (dst, src, _) in remote]
         with d.cv:
             ok = d.cv.wait_for(
@@ -914,7 +955,7 @@ def win_get_nonblocking(name: str, *, src_weights=None,
     """Pull ``w * main[src]`` from each in-neighbor into my staging (async)."""
     win = _store.get(name)
     edges = _resolve_edge_weights(src_weights, win.in_nbrs, 1.0,
-                                  peer_is_src=True)
+                                  peer_is_src=True, ranks=win.owned)
     _validate_edges(edges, win.in_nbrs, peer_is_src=True, op="win_get")
     from bluefog_tpu.utils.timeline import op_span
 
@@ -935,17 +976,21 @@ def win_get(name: str, *, src_weights=None, require_mutex: bool = False) -> bool
 # ---------------------------------------------------------------------------
 
 def _default_update_weights(win: _Window):
+    """Topology-default combine weights — OWNED edges only (non-owned dst
+    rows are combined by their owners; enumerating them here would cost
+    O(n·indeg) python work per update at pod scale)."""
     from bluefog_tpu import basics
     from bluefog_tpu import topology as topology_util
     if basics.is_topo_weighted():
         wmat = topology_util.weight_matrix(basics.load_topology())
         self_w = np.diag(wmat)
         nbr_w = {(dst, src): wmat[src, dst]
-                 for dst in range(win.n) for src in win.in_nbrs[dst]}
+                 for dst in win.owned for src in win.in_nbrs[dst]}
     else:
-        self_w = np.array([1.0 / (len(win.in_nbrs[r]) + 1) for r in range(win.n)])
+        self_w = np.array([1.0 / (len(win.in_nbrs[r]) + 1)
+                           for r in range(win.n)])
         nbr_w = {(dst, src): 1.0 / (len(win.in_nbrs[dst]) + 1)
-                 for dst in range(win.n) for src in win.in_nbrs[dst]}
+                 for dst in win.owned for src in win.in_nbrs[dst]}
     return self_w, nbr_w
 
 
@@ -984,7 +1029,7 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
     and the pending counters account for it exactly)."""
     from bluefog_tpu.utils.timeline import op_span
     win = _store.get(name)
-    owned = _owned_ranks(win.n)
+    owned = win.owned
     acquired = []
     if require_mutex:
         for r in owned:  # only owned mutexes matter — remote writers to my
@@ -1004,7 +1049,8 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
                 n = win.n
                 self_w = np.full(n, 1.0 if self_weight is None else self_weight)
                 nbr_w = _resolve_edge_weights(
-                    neighbor_weights, win.in_nbrs, 1.0, peer_is_src=True)
+                    neighbor_weights, win.in_nbrs, 1.0, peer_is_src=True,
+                    ranks=win.owned)
             self_w_vec = self_w if isinstance(self_w, np.ndarray) \
                 else np.full(win.n, float(self_w))
             # -- snapshot (under lock; moves for reset, copies otherwise) ---
@@ -1137,8 +1183,9 @@ def win_update_then_collect(name: str, *, require_mutex: bool = True):
     """Sum self memory with all received contributions and zero the staging
     buffers — the push-sum collect step (``torch/mpi_ops.py:1206-1260``)."""
     win = _store.get(name)
+    # Owned edges only: collects of non-owned ranks run at their owners.
     all_edges = {(dst, src): 1.0
-                 for dst in range(win.n) for src in win.in_nbrs[dst]}
+                 for dst in win.owned for src in win.in_nbrs[dst]}
     return win_update(name, self_weight=1.0, neighbor_weights=all_edges,
                       reset_weights=True, require_mutex=require_mutex)
 
@@ -1279,6 +1326,14 @@ def win_load_state_dict(name: str, state: Dict[str, object]) -> None:
     overwrites its buffers in place (serialized against in-flight updates,
     as in :func:`win_state_dict`)."""
     win = _store.get(name)
+    if isinstance(state.get("main"), np.ndarray) or (
+            hasattr(state.get("main"), "ndim")
+            and getattr(state["main"], "ndim", 0) >= 1):
+        raise ValueError(
+            f"win_load_state_dict({name!r}): snapshot uses the pre-owned-"
+            "slice array format (rank-major 'main'); re-snapshot with this "
+            "version's win_state_dict — formats are not cross-version "
+            "compatible")
     main = {int(r): np.asarray(v) for r, v in dict(state["main"]).items()}
     if set(main) != set(win.owned):
         raise ValueError(
