@@ -793,7 +793,8 @@ def _do_put_edge(win, name, tensor, row, src, dst, w, op, accumulate,
             if accumulate:
                 win.staging[(dst, src)] += payload
             else:
-                win.staging[(dst, src)] = payload.copy()
+                # payload is freshly allocated above — no aliasing, no copy
+                win.staging[(dst, src)] = payload
             win.versions[dst, src] += 1
             if _store.associated_p_enabled:
                 if accumulate:
@@ -805,11 +806,12 @@ def _do_put_edge(win, name, tensor, row, src, dst, w, op, accumulate,
             mutex.release()
 
 
-def _publish_self(win, tensor, self_weight) -> None:
-    # Self-scaling happens AFTER the edge sends so outgoing payloads carry
-    # the PRE-scaled associated-P mass (column-stochastic conservation:
-    # self_weight + sum of dst weights == 1 must hold on p_old).  Only
-    # owned rows are authoritative here.
+def _validate_self_weight(win: _Window, self_weight) -> None:
+    """Dispatch-time check (BEFORE the async submit): a bad vector must
+    fail loudly at the call site, not inside a worker after remote edge
+    sends already landed at peers."""
+    if self_weight is None:
+        return
     sw = np.asarray(self_weight, dtype=float)
     if sw.ndim and sw.shape != (win.n,):
         # The vector form is GLOBAL-rank indexed (n,), even for owned-
@@ -818,6 +820,14 @@ def _publish_self(win, tensor, self_weight) -> None:
         raise ValueError(
             f"self_weight vector must have shape ({win.n},) — one entry "
             f"per global rank — got {sw.shape}")
+
+
+def _publish_self(win, tensor, self_weight) -> None:
+    # Self-scaling happens AFTER the edge sends so outgoing payloads carry
+    # the PRE-scaled associated-P mass (column-stochastic conservation:
+    # self_weight + sum of dst weights == 1 must hold on p_old).  Only
+    # owned rows are authoritative here.
+    sw = np.asarray(self_weight, dtype=float)
     with win.lock:
         sw_vec = sw if sw.ndim else np.full(win.n, float(sw))
         for r in win.owned:
@@ -844,6 +854,7 @@ def win_put_nonblocking(tensor, name: str, *, self_weight=None,
     t = _to_numpy(tensor)
     win = _store.get(name)  # raise early on unknown window
     _validate_payload(win, t, "win_put")
+    _validate_self_weight(win, self_weight)
     edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0,
                                   ranks=win.owned)
     _validate_edges(edges, win.out_nbrs, peer_is_src=False, op="win_put")
@@ -874,6 +885,7 @@ def win_accumulate_nonblocking(tensor, name: str, *, self_weight=None,
     t = _to_numpy(tensor)
     win = _store.get(name)  # raise early on unknown window
     _validate_payload(win, t, "win_accumulate")
+    _validate_self_weight(win, self_weight)
     edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0,
                                   ranks=win.owned)
     _validate_edges(edges, win.out_nbrs, peer_is_src=False,

@@ -505,7 +505,10 @@ m = pathlib.Path(sys.argv[1])
 if os.environ["BFTPU_PROCESS_ID"] == "1" and not m.exists():
     m.write_text("crashed")
     sys.exit(7)
-print("RSH-RESTART-OK", os.environ["BFTPU_PROCESS_ID"], flush=True)
+# One atomic write: the gang's ranks share stdout, and a torn multi-arg
+# print can interleave mid-line under load.
+sys.stdout.write("RSH-RESTART-OK-%s\n" % os.environ["BFTPU_PROCESS_ID"])
+sys.stdout.flush()
 """
 
 
@@ -527,7 +530,7 @@ def test_rsh_crash_relaunch(tmp_path):
     assert marker.exists()
     # Second incarnation: both ranks print (rank 0's first-incarnation line
     # may or may not land before the gang kill).
-    assert "RSH-RESTART-OK 1" in out.stdout, out.stdout
+    assert "RSH-RESTART-OK-1" in out.stdout, out.stdout
     assert out.stdout.count("RSH-RESTART-OK") >= 2, out.stdout
 
 
