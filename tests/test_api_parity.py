@@ -110,10 +110,24 @@ def test_beyond_reference_surface_pinned():
     from bluefog_tpu import parallel, models
     for name in ["pipeline_apply", "pipeline_train_step",
                  "pipeline_train_step_interleaved", "ring_attention",
-                 "ulysses_attention", "tp_param_specs", "moe_apply"]:
+                 "ulysses_attention", "tp_param_specs", "moe_apply",
+                 "load_balance_loss", "switch_dispatch"]:
         assert hasattr(parallel, name), f"parallel.{name} missing"
     for name in ["ViT", "TransformerLM", "ResNet50", "VGG16", "LeNet5"]:
         assert hasattr(models, name), f"models.{name} missing"
+    # round-4 surface: ZB-H1 schedule, push-sum evaluation collect, sharded
+    # checkpoints, world-size elastic, rsh launcher hook
+    import inspect as _inspect
+    assert "split_backward" in _inspect.signature(
+        parallel.pipeline_train_step).parameters
+    from bluefog_tpu.optim.window_optimizers import DistributedPushSumOptimizer
+    assert hasattr(DistributedPushSumOptimizer, "collect")
+    from bluefog_tpu.utils import checkpoint as _ck
+    for name in ["restore_host", "leaf_shapes", "has_global_shards"]:
+        assert hasattr(_ck, name), f"checkpoint.{name} missing"
+    from bluefog_tpu.run.run import build_parser
+    assert any(a.dest == "rsh" for a in build_parser()._actions), \
+        "bfrun lost --rsh"
     # optimizer knobs the docs advertise
     import inspect
     from bluefog_tpu.optim.optimizers import DistributedOptimizer
