@@ -7,7 +7,8 @@ PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench native
 
-# Fast gate: < 3 min on the CPU mesh; run on every change.
+# Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
+# grew a few oracle tests in round 4); run on every change.
 test: test-fast
 test-fast:
 	$(PYTEST) tests/ -m "not slow"

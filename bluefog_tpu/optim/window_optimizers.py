@@ -409,9 +409,29 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         """(n,) push-sum weight vector (identical across leaves/windows)."""
         return W.win_associated_p(self._names[0])
 
-    def debias(self, params):
-        """Divide each rank's slice by its associated-P scalar."""
-        p = np.asarray(self.associated_p())
+    def debias(self, params, *, p_min: float = 1e-3):
+        """Divide each rank's slice by its associated-P scalar.
+
+        ``p_min`` floors the divisor: under heavy scheduling skew a rank's
+        P mass can be almost entirely in flight (P → 0), and dividing by it
+        turns one delayed packet into inf/NaN iterates.  The floor keeps
+        the estimate finite (it is inaccurate exactly when most of the
+        rank's information is in flight — bound the staleness with a
+        periodic :meth:`collect` for an exact de-bias).  Push-sum theory
+        assumes bounded delays, under which P stays bounded away from 0
+        and the floor never engages; when it DOES engage, a warning is
+        logged (the clipped estimate is finite but biased — monitoring
+        that watched for inf/NaN would otherwise miss it)."""
+        raw = np.asarray(self.associated_p())
+        p = np.maximum(raw, p_min)
+        clipped = np.nonzero(raw < p_min)[0]
+        if clipped.size:
+            from bluefog_tpu.utils.logging import get_logger
+            get_logger().warning(
+                "push-sum debias: associated-P below p_min=%g for rank(s) "
+                "%s — most of their mass is in flight; the de-biased "
+                "estimate is clipped (finite but biased). Bound the "
+                "staleness with opt.collect().", p_min, clipped.tolist())
 
         def div(leaf):
             shape = (-1,) + (1,) * (np.ndim(leaf) - 1)

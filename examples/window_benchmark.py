@@ -153,8 +153,16 @@ def multiproc_child(args):
     n = bf.size()
     P = args.elements
     mb = P * 4 / 1e6
-    x = np.random.RandomState(0).randn(n, P).astype(np.float32)
-    assert bf.win_create(x, "mp")
+    owned = bf.owned_ranks()
+    owned_layout = os.environ.get("BFTPU_BENCH_OWNED") == "1"
+    if owned_layout:
+        # Owned-rows layout: the caller-side array is (owned, P), not
+        # (n, P) — at large n the host working set stays O(owned).
+        x = np.random.RandomState(0).randn(len(owned), P).astype(np.float32)
+        assert bf.win_create(x, "mp", zero_init=True)
+    else:
+        x = np.random.RandomState(0).randn(n, P).astype(np.float32)
+        assert bf.win_create(x, "mp")
     # Cross-process edges: with 2 procs on a ring every rank has one
     # in-neighbor owned by the peer (and one local).
     my = jax.process_index()
@@ -166,24 +174,26 @@ def multiproc_child(args):
     dt = (time.perf_counter() - t0) / args.rounds
     # Ring over 2 procs: each process sends its owned ranks' rows along 2
     # edges each; half the edges cross the process boundary.
-    owned = [i for i, d in enumerate(jax.devices())
-             if d.process_index == my]
     edges_out = sum(len(bf.out_neighbor_ranks(r)) for r in owned)
     cross = sum(1 for r in owned for t_ in bf.out_neighbor_ranks(r)
                 if t_ not in owned)
     comp = os.environ.get("BLUEFOG_TPU_WIN_COMPRESSION", "none")
     wire_mb = mb * (0.5 if comp == "bf16" else 1.0)
+    layout = "owned" if owned_layout else "rank-major"
+    host_mb = x.nbytes / 1e6
     print(f"proc{my}: win_put round {dt*1e3:.1f} ms "
           f"({edges_out} edges, {cross} cross-process, "
           f"{cross * wire_mb / dt / 1e3:.2f} GB/s DCN payload, "
-          f"compression={comp})", flush=True)
+          f"compression={comp}, layout={layout}, "
+          f"caller array {host_mb:.0f} MB)", flush=True)
     bf.win_free("mp")
 
 
 def multiproc_parent(args):
     here = os.path.abspath(__file__)
-    for comp in ("none", "bf16"):
-        env = dict(os.environ, BLUEFOG_TPU_WIN_COMPRESSION=comp)
+    for comp, owned in (("none", "0"), ("bf16", "0"), ("none", "1")):
+        env = dict(os.environ, BLUEFOG_TPU_WIN_COMPRESSION=comp,
+                   BFTPU_BENCH_OWNED=owned)
         env[_MP_CHILD] = "1"
         out = subprocess.run(
             [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",

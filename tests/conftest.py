@@ -47,3 +47,49 @@ def _reset_bluefog_state():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running multi-process integration test")
+
+
+_TPU_PROBE: dict = {}
+
+
+def tpu_subprocess_env():
+    """Env for a clean real-backend subprocess (the in-process suite pins
+    CPU), with a session-cached reachability probe.
+
+    Outcomes: skip when no TPU is attached; skip when the accelerator
+    tunnel hangs backend init (infra outage, not a code regression); FAIL
+    when the probe subprocess errors — a crashing plugin or broken install
+    must not masquerade as a skip and silently stop the only tests that
+    run the real Mosaic kernels."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "BFTPU_LOCAL_DEVICES")}
+    # PREPEND to PYTHONPATH: TPU plugins can ride site hooks living there.
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    if not _TPU_PROBE:
+        try:
+            ping = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('BACKEND', jax.default_backend())"],
+                env=env, capture_output=True, text=True, timeout=120)
+            if ping.returncode != 0:
+                _TPU_PROBE.update(status="error",
+                                  detail=ping.stderr[-2000:])
+            elif "BACKEND tpu" in ping.stdout:
+                _TPU_PROBE.update(status="tpu", detail="")
+            else:
+                _TPU_PROBE.update(status="other", detail=ping.stdout)
+        except subprocess.TimeoutExpired:
+            _TPU_PROBE.update(status="hang", detail="")
+    status = _TPU_PROBE["status"]
+    if status == "hang":
+        pytest.skip("accelerator backend unreachable (init hang)")
+    if status == "error":
+        raise AssertionError(
+            "backend probe subprocess failed (broken install/plugin?):\n"
+            + _TPU_PROBE["detail"])
+    if status != "tpu":
+        pytest.skip("no TPU attached")
+    return env

@@ -93,11 +93,8 @@ def test_flash_compiled_mosaic_on_tpu():
     import os
     import subprocess
     import sys
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "BFTPU_LOCAL_DEVICES")}
-    # PREPEND to PYTHONPATH: TPU plugins can ride site hooks living there.
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    from conftest import tpu_subprocess_env
+    env = tpu_subprocess_env()  # skip on outage/no-TPU, FAIL on broken env
     probe = """
 import jax, jax.numpy as jnp, numpy as np, sys
 if jax.default_backend() != "tpu":

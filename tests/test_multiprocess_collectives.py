@@ -402,7 +402,7 @@ for s in range(150):
     # iterates can carry tiny P mass, where raw-params gradients explode.
     params, state = opt.step(params, compute_grads(opt.debias(params)),
                              state)
-    if (s + 1) % 25 == 0:
+    if (s + 1) % 10 == 0:
         # Bound the staleness: on a contended host one process can stall
         # while peers race ahead, leaving most of its P mass in flight for
         # many rounds (p -> 0, de-bias blows up).  A periodic collect is

@@ -113,10 +113,8 @@ def test_ring_flash_compiled_on_tpu_default_vma():
     import os
     import subprocess
     import sys
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "BFTPU_LOCAL_DEVICES")}
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    from conftest import tpu_subprocess_env
+    env = tpu_subprocess_env()  # skip on outage/no-TPU, FAIL on broken env
     probe = """
 import jax, jax.numpy as jnp, numpy as np, sys
 if jax.default_backend() != "tpu":
