@@ -147,3 +147,93 @@ def test_helloworld_notebook_cells_execute():
     dev = float(out.split("max deviation from mean:")[1].split()[0])
     assert dev < 1e-3, out
     assert not ns["bf"].suspended()
+
+
+def test_cluster_repl_wire_roundtrip():
+    """Length-prefixed JSON framing survives chunked reads."""
+    import socket
+
+    from bluefog_tpu.run.cluster_repl import _recv_msg, _send_msg
+    a, b = socket.socketpair()
+    try:
+        msg = {"op": "exec", "src": "x = 1\n" * 100, "seq": 7}
+        _send_msg(a, msg)
+        assert _recv_msg(b) == msg
+    finally:
+        a.close()
+        b.close()
+
+
+def test_cluster_console_acks_and_error_reporting(capsys):
+    """The REPL pairs acks by sequence number, reports worker errors per
+    rank, drains stale acks from a slow cell, and drops a dead worker
+    without killing the session."""
+    import socket
+    import threading
+
+    from bluefog_tpu.run import cluster_repl as CR
+
+    # Fail fast: a broken helper thread must not park _collect_acks for
+    # the 600s production timeout.
+    orig_timeout = CR._ACK_TIMEOUT
+    CR._ACK_TIMEOUT = 3.0
+    repl_sock, worker_sock = socket.socketpair()
+    console = CR.ClusterConsole([(1, repl_sock)], locals={})
+
+    def worker_one_cell(reply_ok=True, extra_stale=None):
+        msg = CR._recv_msg(worker_sock)
+        assert msg["op"] == "exec"
+        if extra_stale is not None:
+            # ok=False: if seq pairing regressed to first-reply-wins, the
+            # stale ack would print 'raised' and fail the step directly.
+            CR._send_msg(worker_sock, {"ok": False, "seq": extra_stale,
+                                       "tb": "STALE"})
+        if reply_ok:
+            CR._send_msg(worker_sock, {"ok": True, "seq": msg["seq"]})
+        else:
+            CR._send_msg(worker_sock, {"ok": False, "seq": msg["seq"],
+                                       "tb": "Trace\nValueError: boom"})
+
+    try:
+        # Normal cell: ack consumed, nothing printed.
+        t = threading.Thread(target=worker_one_cell)
+        t.start()
+        assert console.runsource("a = 1") is False
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert "[ibfrun]" not in capsys.readouterr().err
+
+        # Worker error: reported with the rank and the traceback tail.
+        t = threading.Thread(target=worker_one_cell, kwargs={"reply_ok": False})
+        t.start()
+        console.runsource("a = 2")
+        t.join(timeout=5)
+        assert not t.is_alive()
+        err = capsys.readouterr().err
+        assert "rank 1 raised: ValueError: boom" in err
+
+        # A stale ack from an earlier slow cell is drained, the current
+        # cell's ack still pairs correctly.
+        t = threading.Thread(target=worker_one_cell,
+                             kwargs={"extra_stale": 0})
+        t.start()
+        console.runsource("a = 3")
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert "raised" not in capsys.readouterr().err
+
+        # Dead worker: dropped with a notice; the next cell still runs.
+        worker_sock.close()
+        console.runsource("a = 4")
+        err = capsys.readouterr().err
+        assert "control channel lost" in err
+        assert console._workers == []
+        assert console.runsource("a = 5") is False  # solo REPL keeps going
+        assert console.locals["a"] == 5
+    finally:
+        CR._ACK_TIMEOUT = orig_timeout
+        repl_sock.close()
+        try:
+            worker_sock.close()
+        except OSError:
+            pass
