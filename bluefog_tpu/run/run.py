@@ -29,6 +29,7 @@ import shlex
 import socket
 import subprocess
 import sys
+import threading
 import time
 import uuid
 
@@ -94,6 +95,50 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))
         return s.getsockname()[1]
+
+
+_TAG_LOCK = threading.Lock()
+
+
+def _spawn_tagged(cmd_or_argv, env, rank: int):
+    """Popen with pump threads that prefix each output line with ``[rank]``
+    (mpirun ``--tag-output`` parity: stdout stays stdout, stderr stays
+    stderr).  Whole lines are written under one lock, so ranks can no
+    longer tear each other's lines on the shared streams.  The threads are
+    joined by ``_join_tag_pumps`` after the child exits — they must drain
+    the pipes fully or trailing output would be lost at interpreter
+    shutdown; ``errors='replace'`` keeps one bad byte (native crash dumps)
+    from killing a pump and deadlocking the child on a full pipe."""
+    p = subprocess.Popen(cmd_or_argv, env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, bufsize=1, errors="replace")
+
+    def pump(stream, sink):
+        for line in stream:
+            if not line.endswith("\n"):
+                line += "\n"  # unterminated final write: keep tags per-line
+            with _TAG_LOCK:
+                sink.write(f"[{rank}]{line}")
+                sink.flush()
+        stream.close()
+
+    threads = [
+        threading.Thread(target=pump, args=(p.stdout, sys.stdout),
+                         daemon=True, name=f"bfrun-tag-{rank}"),
+        threading.Thread(target=pump, args=(p.stderr, sys.stderr),
+                         daemon=True, name=f"bfrun-tag-err-{rank}"),
+    ]
+    for t in threads:
+        t.start()
+    p._bf_tag_threads = threads
+    return p
+
+
+def _join_tag_pumps(entries, timeout: float = 10.0) -> None:
+    """Drain tagged-output pumps after their children exited."""
+    for p, _, _ in entries:
+        for t in getattr(p, "_bf_tag_threads", ()):
+            t.join(timeout=timeout)
 
 
 # Env vars forwarded to remote ranks (the remote login shell supplies the
@@ -171,6 +216,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint)")
     p.add_argument("--timeline", default=None,
                    help="timeline file prefix (sets BLUEFOG_TIMELINE)")
+    p.add_argument("--tag-output", action="store_true",
+                   help="prefix every output line with [rank] (mpirun "
+                        "--tag-output parity); also prevents ranks' lines "
+                        "interleaving mid-line on the shared stdout")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program to launch")
     return p
@@ -236,13 +285,17 @@ def main(argv=None) -> int:
                                  host_slots[host])
                 env["BFTPU_GANG_TAG"] = tag
                 if is_local_host(host):
-                    entries.append((subprocess.Popen(cmd, env=env), host,
-                                    False))
+                    proc = (_spawn_tagged(cmd, env, rank) if args.tag_output
+                            else subprocess.Popen(cmd, env=env))
+                    entries.append((proc, host, False))
                 else:
                     remote = _launch_shell(tag, rank, remote_run_cmd(env,
                                                                      cmd))
-                    entries.append((subprocess.Popen(rsh + [host, remote]),
-                                    host, True))
+                    rsh_cmd = rsh + [host, remote]
+                    proc = (_spawn_tagged(rsh_cmd, None, rank)
+                            if args.tag_output
+                            else subprocess.Popen(rsh_cmd))
+                    entries.append((proc, host, True))
             rc = _wait_gang(entries, rsh, tag)
         except KeyboardInterrupt:
             print("bfrun: interrupted; stopping the gang", file=sys.stderr)
@@ -329,10 +382,12 @@ def _wait_gang(entries, rsh: list, tag: str) -> int:
         bad = next((r for r in rcs if r not in (None, 0)), None)
         if bad is None:
             if all(r is not None for r in rcs):
+                _join_tag_pumps(entries)
                 return 0
             time.sleep(0.2)
             continue
         _kill_gang(entries, rsh, tag)
+        _join_tag_pumps(entries)
         return bad
 
 

@@ -610,3 +610,71 @@ def test_ibfrun_multi_machine_repl(tmp_path):
         f"stdout={out.stdout}\nstderr={out.stderr[-4000:]}"
     assert "rank(s) across" in out.stdout, out.stdout
     assert "IBF-CELL-OK 0.5" in out.stdout, out.stdout
+
+
+@pytest.mark.slow
+def test_rsh_timeline_reaches_remote_ranks(tmp_path):
+    """bfrun --timeline: the BLUEFOG_TIMELINE env rides the remote-shell
+    export list, so ranks launched over the rsh transport write their own
+    per-rank chrome-trace files too."""
+    import json
+    rsh = _write_fakersh(tmp_path)
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {_REPO!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import bluefog_tpu as bf\n"
+        "from bluefog_tpu import topology as topo\n"
+        "bf.init_distributed()\n"
+        "n = bf.size()\n"
+        "bf.set_topology(topo.RingGraph(n))\n"
+        "x = np.ones((n, 2), np.float32)\n"
+        "bf.win_create(x, 'w', zero_init=True)\n"
+        "bf.win_put(x, 'w')\n"
+        "bf.win_fence()\n"
+        "from bluefog_tpu.utils import timeline as tl\n"
+        "tl.stop_timeline()\n"
+        "import sys as s2; s2.stdout.write('TLRSH-OK\\n'); s2.stdout.flush()\n")
+    prefix = str(tmp_path / "tl_")
+    out = _bfrun_rsh(tmp_path, [
+        "-np", "2", "-H", "127.0.0.2:1,127.0.0.3:1", "--rsh", rsh,
+        "--devices-per-proc", "2", "--timeline", prefix,
+        sys.executable, str(prog)])
+    assert out.returncode == 0, \
+        f"stdout={out.stdout}\nstderr={out.stderr[-4000:]}"
+    assert out.stdout.count("TLRSH-OK") == 2, out.stdout
+    for rank in (0, 1):
+        path = tmp_path / f"tl_{rank}.json"
+        assert path.exists(), list(tmp_path.iterdir())
+        events = json.load(open(path))
+        assert any("->" in ev["cat"] for ev in events), \
+            f"rank {rank}: no per-edge spans"
+
+
+@pytest.mark.slow
+def test_bfrun_tag_output(tmp_path):
+    """--tag-output prefixes every line with [rank] and whole lines are
+    written atomically (mpirun --tag-output parity; untagged gangs can
+    tear each other's lines on the shared stdout)."""
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import os, sys\n"
+        "for i in range(20):\n"
+        "    sys.stdout.write('line%d rank%s\\n'\n"
+        "                     % (i, os.environ['BFTPU_PROCESS_ID']))\n"
+        "sys.stdout.flush()\n")
+    out = _bfrun_rsh(tmp_path, ["-np", "2", "--tag-output",
+                                sys.executable, str(prog)])
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln]
+    assert len(lines) == 40, lines
+    for ln in lines:
+        assert ln.startswith(("[0]", "[1]")), ln
+        rank = ln[1]
+        assert ln == f"[{rank}]line{ln.split('line')[1].split(' ')[0]} " \
+                     f"rank{rank}", ln
+    # stderr stays on stderr (mpirun parity), tagged likewise.
+    assert "[0]" not in out.stderr and "[1]" not in out.stderr
