@@ -42,8 +42,17 @@ def _to_np(t: torch.Tensor) -> np.ndarray:
 
 
 def _like(t: torch.Tensor, arr) -> torch.Tensor:
-    return torch.from_numpy(np.asarray(arr)).to(dtype=t.dtype,
-                                                device=t.device)
+    # Host numpy passes through untouched (zero-copy, dtype-preserving —
+    # some backwards build plain numpy results; jnp.asarray would truncate
+    # float64).  Jax collective results go through to_numpy, not
+    # np.asarray: in a multi-process run they are GLOBAL arrays whose
+    # shards span processes — plain asarray raises on the non-addressable
+    # rows, while to_numpy gathers them over the coordinator (the torch
+    # frontend keeps rank-major host tensors on every process, same as
+    # single-controller mode).
+    if not isinstance(arr, np.ndarray):
+        arr = _b.to_numpy(arr)
+    return torch.from_numpy(arr).to(dtype=t.dtype, device=t.device)
 
 
 # ---------------------------------------------------------------------------
