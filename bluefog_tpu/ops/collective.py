@@ -38,6 +38,7 @@ __all__ = [
     "neighbor_allgather",
     "neighbor_allreduce",
     "neighbor_allreduce_matrix",
+    "sparse_neighbor_allreduce",
     "dynamic_neighbor_allreduce",
     "pair_gossip",
     "hierarchical_neighbor_allreduce",
@@ -108,6 +109,81 @@ def neighbor_allreduce(x: jnp.ndarray, sched: StaticSchedule,
     (Exp2 over n ranks: log2(n) permutes, all riding ICI concurrently).
     """
     return _apply_rounds(x, sched, axis_name, _axis_index(axis_name))
+
+
+def sparse_neighbor_allreduce(x: jnp.ndarray, sched: StaticSchedule,
+                              axis_name: str, *, k: int = None,
+                              indices: jnp.ndarray = None,
+                              valid: jnp.ndarray = None,
+                              aligned: bool = False,
+                              return_sent: bool = False):
+    """Top-k SPARSIFIED weighted neighbor averaging (beyond the reference).
+
+    Each rank ships only its ``k`` largest-magnitude entries — a
+    ``(k,)`` values array plus ``(k,)`` int32 indices per edge round —
+    so the per-edge wire bytes are ``k * 8`` instead of ``4 * x.size``
+    (a 50× cut at 1% density).  The combine runs entirely on the
+    compressed representation ``q_i = scatter(vals_i, idx_i)``::
+
+        out_i = W[i,i] * q_i  +  sum_{j -> i} W[j,i] * q_j
+
+    — the self term uses ``q_i`` too, so the difference-compression
+    wrapper ``out + (x - q)`` is EXACT at consensus (every row of W sums
+    to 1 on q, and the dropped mass re-enters locally).  The optimizer
+    family exposes this as ``compression="sparse:<frac>"`` with a
+    step-ROTATING aligned index block: per-rank magnitude picks disagree
+    across ranks and never-picked coordinates would never mix (measured:
+    the spread stalls), while the aligned rotating block is exact dense
+    gossip per block and sweeps every coordinate each ceil(1/frac)
+    rounds — consensus to machine precision.
+
+    ``return_sent=True`` also returns the dense representation ``q`` of
+    this rank's own outgoing payload (zeros except the top-k entries) —
+    what the residual ``x - q`` must be computed against.
+
+    ``indices`` overrides the magnitude selection with a caller-chosen
+    (k,) int32 index set (may be traced — e.g. a step-rotating block);
+    ``valid`` is an optional (k,) bool mask zeroing individual slots
+    (dropping duplicate picks without a dynamic shape).  ``aligned=True``
+    asserts every rank passes the SAME index set (the rotating-block
+    case): the per-round index permute is skipped — receivers scatter at
+    their own ``indices`` — halving the wire bytes to ``k * 4`` per edge.
+
+    Static-shape by construction (``k`` is a Python int), so the whole
+    exchange jits into the same ppermute-per-round schedule as the dense
+    op; ranks without an edge in a round receive ppermute's zero fill
+    (a scatter-add of 0.0 at index 0 — harmless)."""
+    idx = _axis_index(axis_name)
+    dt = x.dtype
+    flat = x.reshape(-1)
+    if indices is None:
+        if k is None:
+            raise ValueError("pass k= (top-k selection) or indices=")
+        _, pos = lax.top_k(jnp.abs(flat), k)
+    else:
+        pos = indices
+    vals = flat[pos]
+    if valid is not None:
+        vals = vals * valid.astype(dt)
+    # scatter-ADD, exactly like the receivers: with duplicate indices a
+    # .set would drop one contribution from q while the wire still carried
+    # it — the residual x - q would then re-add sent mass (divergence).
+    q_flat = jnp.zeros_like(flat).at[pos].add(vals)
+    out = q_flat * _const(sched.self_scale, dt)[idx]
+    if aligned and indices is None:
+        raise ValueError("aligned=True requires caller-provided indices "
+                         "(identical on every rank)")
+    for rnd in sched.rounds:
+        sv = vals * _const(rnd.send_scale, dt)[idx]
+        rv = lax.ppermute(sv, axis_name, rnd.pairs)
+        # Aligned indices are identical everywhere: scatter at our own pos
+        # instead of shipping k int32s per edge that equal it anyway.
+        rp = pos if aligned else lax.ppermute(pos, axis_name, rnd.pairs)
+        out = out.at[rp].add(rv)
+    out = out.reshape(x.shape)
+    if return_sent:
+        return out, q_flat.reshape(x.shape)
+    return out
 
 
 def neighbor_allreduce_matrix(x: jnp.ndarray, w: jnp.ndarray,

@@ -93,9 +93,11 @@ class DistributedOptimizer:
                  compression: str = "none", donate: bool = False):
         if isinstance(communication_type, str):
             communication_type = CommunicationType(communication_type)
-        if compression not in ("none", "bf16"):
+        if compression not in ("none", "bf16") and not (
+                isinstance(compression, str)
+                and compression.startswith(("sparse", "topk"))):
             raise ValueError(f"unknown compression {compression!r}; "
-                             "expected 'none' or 'bf16'")
+                             "expected 'none', 'bf16' or 'sparse:<frac>'")
         self.base = base
         self.communication_type = communication_type
         self.order = order
