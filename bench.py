@@ -22,7 +22,30 @@ import numpy as np
 BASELINE_PER_GPU = 4310.6 / 16  # img/s per V100, reference docs/performance.rst
 
 
+def _probe_backend(timeout_s: float = 180.0) -> None:
+    """Fail FAST when the accelerator tunnel is down: a dead backend hangs
+    jax's init inside a C call no signal can interrupt, so probe it in a
+    disposable subprocess first and exit with a clear error instead of
+    wedging the benchmark run for hours (observed live outage)."""
+    import subprocess
+    import sys
+    try:
+        ping = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('NDEV', len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print("bench: accelerator backend unreachable (init hang) — "
+              "not printing a bogus metric", file=sys.stderr)
+        raise SystemExit(3)
+    if ping.returncode != 0:
+        print("bench: backend probe failed:\n" + ping.stderr[-2000:],
+              file=sys.stderr)
+        raise SystemExit(3)
+
+
 def main():
+    _probe_backend()
     import jax
     import jax.numpy as jnp
     import optax

@@ -227,8 +227,6 @@ def compress_combiner(combine: Combiner, compression: str,
         return combine
     if isinstance(compression, str) and (compression.startswith("sparse")
                                          or compression.startswith("topk")):
-        if getattr(combine, "is_identity", False):
-            return combine
         if compression.startswith("topk"):
             raise ValueError(
                 "magnitude-only top-k gossip does not converge under the "
@@ -260,6 +258,8 @@ def compress_combiner(combine: Combiner, compression: str,
         if not 0.0 < frac <= 1.0:
             raise ValueError(
                 f"sparse fraction must be in (0, 1], got {frac}")
+        if getattr(combine, "is_identity", False):
+            return combine  # empty communication: string validated above
         args = getattr(combine, "_sparse_args", None)
         if args is None:
             raise ValueError(
@@ -298,6 +298,9 @@ def compress_combiner(combine: Combiner, compression: str,
     if compression != "bf16":
         raise ValueError(f"unknown compression {compression!r}; "
                          "expected 'none', 'bf16' or 'sparse:<frac>'")
+    if getattr(combine, "is_identity", False):
+        return combine  # keep _tree_combine's identity fast path
+
     def wrapped(x, **kw):
         q = x.astype(jnp.bfloat16)
         out = combine(q, **kw).astype(x.dtype)

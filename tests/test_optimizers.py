@@ -483,3 +483,18 @@ def test_sparse_compression_malformed_fraction_rejected():
             optax.sgd(0.05), compression=bad)
         with pytest.raises(ValueError, match="frac|fraction"):
             opt.step(params, grad_fn(A, y)(params), opt.init(params))
+
+
+def test_compression_string_validated_even_for_empty_communication():
+    """Malformed/rejected compression strings fail fast regardless of the
+    communication type — and a valid compression on empty communication
+    keeps the identity fast path (no wasted wrap)."""
+    from bluefog_tpu.optim import functional as F
+    bf.init(lambda: topo.ExponentialGraph(N))
+    ident = F.make_combiner(F.CommunicationType.empty, axis_name="bf_rank")
+    for bad in ("sparse:abc", "sparse", "topk:0.25", "garbage"):
+        with pytest.raises(ValueError):
+            F.compress_combiner(ident, bad)
+    for ok in ("bf16", "sparse:0.25", "none"):
+        out = F.compress_combiner(ident, ok)
+        assert getattr(out, "is_identity", False), ok
