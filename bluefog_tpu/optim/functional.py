@@ -114,6 +114,10 @@ def make_combiner(
                     for ph in dyn_sched.phases]
                 return lax.switch(step % dyn_sched.period, branches,
                                   (x, weights))
+            # Lets compress_combiner run the aligned rotating-block sparse
+            # exchange under the same lax.switch of phases
+            # (compression="sparse:<frac>" on dynamic topologies).
+            _dyn._sparse_dyn_args = (dyn_sched, axis_name)
             return _dyn
         assert sched is not None, "static neighbor_allreduce needs a schedule"
 
@@ -261,9 +265,10 @@ def compress_combiner(combine: Combiner, compression: str,
         if getattr(combine, "is_identity", False):
             return combine  # empty communication: string validated above
         args = getattr(combine, "_sparse_args", None)
-        if args is None:
+        dyn_args = getattr(combine, "_sparse_dyn_args", None)
+        if args is None and dyn_args is None:
             raise ValueError(
-                "compression='sparse:<frac>' needs a STATIC "
+                "compression='sparse:<frac>' needs a (static or dynamic) "
                 "neighbor_allreduce combiner (the sparse exchange rides "
                 "the compiled edge schedule); use 'bf16' for the other "
                 "communication types")
@@ -272,7 +277,6 @@ def compress_combiner(combine: Combiner, compression: str,
                 "sparse compression requires residual error feedback "
                 "(decentralized orders); it cannot keep an allreduce "
                 "replica-identical")
-        sched, axis_name = args
 
         def wrapped_sparse(x, step=None, weights=None):
             if weights is not None:
@@ -290,9 +294,16 @@ def compress_combiner(combine: Combiner, compression: str,
             rnd_idx = s // max(1, int(steps_per_comm))
             rot = ((jnp.arange(kk, dtype=jnp.int32) + rnd_idx * kk)
                    % x.size)
-            out, q = C.sparse_neighbor_allreduce(
-                x, sched, axis_name, indices=rot, aligned=True,
-                return_sent=True)
+            if args is not None:
+                sched, axis_name = args
+                out, q = C.sparse_neighbor_allreduce(
+                    x, sched, axis_name, indices=rot, aligned=True,
+                    return_sent=True)
+            else:
+                dyn_sched, axis_name = dyn_args
+                out, q = C.dynamic_sparse_neighbor_allreduce(
+                    x, s, dyn_sched, axis_name, indices=rot,
+                    return_sent=True)
             return out + (x - q)
         return wrapped_sparse
     if compression != "bf16":

@@ -534,3 +534,69 @@ def test_sparse_neighbor_allreduce_topk_semantics(devices):
     np.testing.assert_allclose(np.asarray(q),
                                np.stack([topk_dense(r) for r in x]),
                                rtol=1e-6)
+
+
+def test_dynamic_sparse_neighbor_allreduce_full_k_matches_dense(devices):
+    """Full index block (k == size): the dynamic sparse exchange equals
+    the dense dynamic neighbor averaging at EVERY phase of the period,
+    and the sent representation q equals x (zero residual)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from bluefog_tpu.ops import collective as C
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu import topology as topo
+    n, D = 8, 12
+    dyn = S.compile_dynamic(topo.one_peer_exp2_phases(n), n)
+    x = jnp.asarray(np.random.RandomState(3).randn(n, D), jnp.float32)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    pos = jnp.arange(D, dtype=jnp.int32)
+    for step in range(dyn.period * 2):
+        t = jnp.asarray(step, jnp.int32)
+        dense = jax.jit(jax.shard_map(
+            lambda a: C.dynamic_neighbor_allreduce(a, t, dyn, "dp"),
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            check_vma=False))(x)
+        sparse, q = jax.jit(jax.shard_map(
+            lambda a: tuple(r[None] for r in C.dynamic_sparse_neighbor_allreduce(
+                a[0], t, dyn, "dp", indices=pos, return_sent=True)),
+            mesh=mesh, in_specs=P("dp"), out_specs=(P("dp"), P("dp")),
+            check_vma=False))(x)
+        np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(q), np.asarray(x), rtol=1e-6)
+
+
+def test_dynamic_sparse_partial_block_oracle(devices):
+    """k < size on a one-peer phase: the combine equals the dense one-peer
+    averaging restricted to the aligned block; off-block coordinates carry
+    0.5 * x_i (the self scale applied to q_i which is zero there)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from bluefog_tpu.ops import collective as C
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu import topology as topo
+    n, D, K = 8, 12, 5
+    dyn = S.compile_dynamic(topo.one_peer_exp2_phases(n), n)
+    rng = np.random.RandomState(4)
+    x = rng.randn(n, D).astype(np.float32)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    for step in range(dyn.period):
+        pos_np = (np.arange(K) + step * K) % D
+        pos = jnp.asarray(pos_np, jnp.int32)
+        t = jnp.asarray(step, jnp.int32)
+        out, q = jax.jit(jax.shard_map(
+            lambda a: tuple(r[None] for r in C.dynamic_sparse_neighbor_allreduce(
+                a[0], t, dyn, "dp", indices=pos, return_sent=True)),
+            mesh=mesh, in_specs=P("dp"), out_specs=(P("dp"), P("dp")),
+            check_vma=False))(jnp.asarray(x))
+        d = 2 ** (step % dyn.period)
+        mask = np.zeros(D, np.float32)
+        mask[pos_np] = 1.0
+        for i in range(n):
+            qi, qj = x[i] * mask, x[(i - d) % n] * mask
+            np.testing.assert_allclose(np.asarray(out)[i],
+                                       0.5 * qi + 0.5 * qj,
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(q)[i], qi, rtol=1e-6)
