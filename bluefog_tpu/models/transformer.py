@@ -86,9 +86,26 @@ class TransformerConfig:
         # for O(num_layers) less activation HBM, the standard long-context
         # training knob (pairs with the O(S)-memory flash attention).
         self.remat = remat
-        if remat_policy not in ("full", "dots"):
+        if remat_policy not in ("full", "dots") and not (
+                isinstance(remat_policy, str)
+                and remat_policy.startswith("dots:")):
             raise ValueError(f"remat_policy {remat_policy!r} not in "
-                             "('full', 'dots')")
+                             "('full', 'dots', 'dots:<K>')")
+        if isinstance(remat_policy, str) and remat_policy.startswith("dots:"):
+            # Mixed policy: the first K blocks keep their dot_general
+            # outputs resident ('dots' — less backward recompute), the
+            # remaining blocks use full per-block remat.  The HBM knob for
+            # models where all-dots exceeds memory but full remat leaves
+            # MFU on the table (the 1.3B headline: dots is +13% where it
+            # fits; K dials resident-activation memory continuously).
+            try:
+                k = int(remat_policy.split(":", 1)[1])
+            except ValueError:
+                raise ValueError(
+                    f"malformed {remat_policy!r}: use 'dots:<int>'"
+                ) from None
+            if k < 0:
+                raise ValueError(f"remat_policy dots:K needs K >= 0, got {k}")
         self.remat_policy = remat_policy
         # causal=False gives BIDIRECTIONAL attention (encoder mode — the
         # ViT uses it); the KV-cache decode path requires causal=True.
@@ -181,13 +198,22 @@ def apply_rope(x, positions, theta: float = 10000.0):
                             x1 * sin + x2 * cos], -1).astype(x.dtype)
 
 
-def block_class(cfg):
+def block_class(cfg, layer_idx: int = None):
     """The (possibly remat-wrapped) Block class for a config — shared by
     ``TransformerLM`` and ``models.vit.ViT`` so ``remat_policy`` behaves
-    identically in both."""
+    identically in both.  ``layer_idx`` selects the per-layer class under
+    the mixed ``"dots:<K>"`` policy (None = single-policy configs)."""
     if not cfg.remat:
         return Block
-    if getattr(cfg, "remat_policy", "full") == "dots":
+    policy = getattr(cfg, "remat_policy", "full")
+    if isinstance(policy, str) and policy.startswith("dots:"):
+        k = int(policy.split(":", 1)[1])
+        if layer_idx is None:
+            raise ValueError(
+                "remat_policy='dots:<K>' is per-layer — call "
+                "block_class(cfg, layer_idx=i)")
+        policy = "dots" if layer_idx < k else "full"
+    if policy == "dots":
         # Save every dot_general output, recompute only non-dot ops in
         # the backward: less recompute than full remat at the cost of
         # keeping dot activations resident.  NOTE: with dense
@@ -347,9 +373,9 @@ class TransformerLM(nn.Module):
             x = x + pos
         positions = jnp.broadcast_to(positions,
                                      (tokens.shape[0], tokens.shape[1]))
-        block_cls = Block if cache is not None else block_class(cfg)
         new_cache = []
         for i in range(cfg.num_layers):
+            block_cls = Block if cache is not None else block_class(cfg, i)
             blk = block_cls(cfg, attn, name=f"block_{i}")
             if cache is not None:
                 x, blk_cache = blk(x, positions, cache[i])

@@ -75,9 +75,8 @@ class ViT(nn.Module):
         x = x + pos.astype(x.dtype)
         attn = self.attn_impl if self.attn_impl is not None \
             else local_attention
-        block_cls = block_class(cfg)
         for i in range(self.num_layers):
-            x = block_cls(cfg, attn, name=f"block_{i}")(x)
+            x = block_class(cfg, i)(cfg, attn, name=f"block_{i}")(x)
         x = nn.RMSNorm(dtype=self.dtype)(x)
         # Classify from the [CLS] token (f32 head, as in the LM's lm_head).
         return nn.Dense(self.num_classes, dtype=jnp.float32,

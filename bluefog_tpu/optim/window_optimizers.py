@@ -32,6 +32,15 @@ are frozen at their value from the previous step's input — they are never
 silently installed from stale window copies (each process trains its own
 ranks, exactly like the reference's one-tensor-per-process model).  Use
 :meth:`gather` to materialize every rank's fresh parameters for evaluation.
+
+Owned layout (pod scale): pass parameter trees with leading dim
+``len(bf.owned_ranks())`` instead of the world size (row ``i`` = rank
+``owned_ranks()[i]``) and the optimizer steps over owned rows ONLY — per-
+process state is O(owned + indegree), never O(n), matching the window
+layer's owned-slice storage and the reference's one-tensor-per-process
+model (``torch/optimizers.py:844-1024``).  Layout is auto-detected from the
+leading dim (or forced via ``layout=``); :meth:`gather` materializes the
+rank-major view from either layout.
 """
 
 from __future__ import annotations
@@ -64,25 +73,32 @@ class _WindowOptimizerBase:
 
     def __init__(self, base: optax.GradientTransformation, *,
                  window_prefix: str, num_steps_per_communication: int = 1,
-                 fuse: bool = True):
+                 fuse: bool = True, layout: str = "auto"):
+        if layout not in ("auto", "rank", "owned"):
+            raise ValueError(
+                f"layout must be 'auto', 'rank' or 'owned', got {layout!r}")
         self.base = base
         self.window_prefix = window_prefix
         self.num_steps_per_communication = int(num_steps_per_communication)
         self.fuse = bool(fuse)
+        self.layout = layout
+        self._layout = None   # resolved at init(): "rank" or "owned"
         self._names: List[str] = None
         self._update_fn = None
         self._n = 0
-        self._shapes = None   # per-leaf (n, *rest) shapes, fused mode
+        self._rows = 0        # leading dim of caller trees (n or len(owned))
+        self._owned: List[int] = []
+        self._shapes = None   # per-leaf (rows, *rest) shapes, fused mode
         self._dtypes = None   # per-leaf dtypes (concatenate promotes; cast back)
         self._splits = None   # np.cumsum of per-leaf flat sizes, fused mode
 
     # -- payload layout ----------------------------------------------------
     def _payloads(self, tree) -> List[np.ndarray]:
-        """Rank-major arrays to ship, one per window (1 when fused)."""
+        """Row-major arrays to ship, one per window (1 when fused)."""
         leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
         if not self.fuse:
             return leaves
-        return [np.concatenate([x.reshape(self._n, -1) for x in leaves],
+        return [np.concatenate([x.reshape(self._rows, -1) for x in leaves],
                                axis=1)]
 
     def _rebuild(self, arrays: List, like):
@@ -101,13 +117,14 @@ class _WindowOptimizerBase:
             treedef, [jnp.asarray(x) for x in leaves])
 
     def _merge_owned(self, prev, new):
-        """Freeze non-owned rows (multi-process): rows of ranks owned by
-        other processes keep their previous value instead of receiving
-        stale window copies."""
-        if W._store.distrib is None:
+        """Freeze non-owned rows (multi-process, rank-major layout): rows of
+        ranks owned by other processes keep their previous value instead of
+        receiving stale window copies.  Owned layout carries owned rows
+        only, so every row is authoritative — identity."""
+        if W._store.distrib is None or self._layout == "owned":
             return new
         mask = np.zeros(self._n, bool)
-        mask[W._owned_ranks(self._n)] = True
+        mask[self._owned] = True
 
         def one(p, q):
             m = jnp.asarray(mask.reshape((-1,) + (1,) * (jnp.ndim(q) - 1)))
@@ -115,26 +132,74 @@ class _WindowOptimizerBase:
         return jax.tree.map(one, prev, new)
 
     def gather(self, params):
-        """Materialize every rank's authoritative rows (for evaluation):
-        allgathers owned rows across processes; identity single-process."""
+        """Materialize every rank's authoritative rows in RANK-MAJOR order
+        (for evaluation): allgathers owned rows across processes; identity
+        single-process rank-major."""
         d = W._store.distrib
         if d is None:
             return params
         from jax.experimental import multihost_utils
         owner = np.array([d.rank_owner[r] for r in range(self._n)])
-        rows = np.arange(self._n)
+        if self._layout == "rank":
+            rows = np.arange(self._n)
+
+            def one(leaf):
+                g = np.asarray(multihost_utils.process_allgather(
+                    np.asarray(leaf)))
+                return jnp.asarray(g[owner, rows])
+            return jax.tree.map(one, params)
+        # Owned layout: processes may own different rank counts (non-uniform
+        # --hosts placements), and process_allgather needs uniform shapes —
+        # pad each process's owned rows to the max count, gather, then take
+        # rank r from (owner[r], position of r in owner[r]'s owned list).
+        nproc = max(owner) + 1
+        owned_of = [[r for r in range(self._n) if owner[r] == p]
+                    for p in range(nproc)]
+        maxrows = max(len(lst) for lst in owned_of)
+        pos = np.zeros(self._n, np.int64)
+        for lst in owned_of:
+            for i, r in enumerate(lst):
+                pos[r] = i
 
         def one(leaf):
+            x = np.asarray(leaf)
+            pad = np.zeros((maxrows - x.shape[0],) + x.shape[1:], x.dtype)
             g = np.asarray(multihost_utils.process_allgather(
-                np.asarray(leaf)))
-            return jnp.asarray(g[owner, rows])
+                np.concatenate([x, pad], axis=0)))
+            return jnp.asarray(g[owner, pos])
         return jax.tree.map(one, params)
 
     # -- lifecycle ---------------------------------------------------------
     def init(self, params) -> DistOptState:
         basics._require_init()
         self._n = basics.size()
+        self._owned = W._owned_ranks(self._n)
         leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
+        rows = leaves[0].shape[0]
+        if any(x.shape[0] != rows for x in leaves):
+            raise ValueError(
+                "window optimizer trees must share one leading (row) dim; "
+                f"got {[x.shape[0] for x in leaves]}")
+        if self.layout == "auto":
+            if rows == self._n:
+                self._layout = "rank"
+            elif (W._store.distrib is not None
+                  and rows == len(self._owned)):
+                self._layout = "owned"
+            else:
+                raise ValueError(
+                    f"{type(self).__name__}.init: leading dim {rows} is "
+                    f"neither the world size ({self._n}, rank-major) nor "
+                    f"this process's owned-rank count ({len(self._owned)}, "
+                    "owned layout)")
+        else:
+            self._layout = self.layout
+            want = self._n if self._layout == "rank" else len(self._owned)
+            if rows != want:
+                raise ValueError(
+                    f"{type(self).__name__}.init: layout={self._layout!r} "
+                    f"expects leading dim {want}, got {rows}")
+        self._rows = rows
         if self.fuse:
             self._shapes = [x.shape for x in leaves]
             self._dtypes = [x.dtype for x in leaves]
@@ -143,8 +208,20 @@ class _WindowOptimizerBase:
             self._names = [f"{self.window_prefix}.fused"]
         else:
             self._names = _leaf_names(params, self.window_prefix)
+        # Owned-layout creation tensors carry no neighbor rows, so the
+        # window layer cannot seed staging from them (it requires
+        # zero_init).  Restore the rank layout's seeded-staging semantics
+        # with one explicit identity put below instead.
+        zero = self._zero_init or self._layout == "owned"
         for name, payload in zip(self._names, self._payloads(params)):
-            W.win_create(payload, name, zero_init=self._zero_init)
+            W.win_create(payload, name, zero_init=zero)
+        if self._layout == "owned" and not self._zero_init:
+            for name, payload in zip(self._names, self._payloads(params)):
+                W.win_put(payload, name)
+            # All seeds applied everywhere before the first step's
+            # win_update — otherwise it would combine zeros for edges
+            # whose seed is still in flight (transient pull toward 0).
+            W.win_fence()
         base = self.base
 
         def init_one(p):
@@ -239,10 +316,10 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
 
     def __init__(self, base, *, window_prefix: str = "winput",
                  num_steps_per_communication: int = 1, fuse: bool = True,
-                 overlap: bool = False):
+                 overlap: bool = False, layout: str = "auto"):
         super().__init__(base, window_prefix=window_prefix,
                          num_steps_per_communication=num_steps_per_communication,
-                         fuse=fuse)
+                         fuse=fuse, layout=layout)
         self.overlap = bool(overlap)
         self._pending: List[int] = []
 
@@ -291,10 +368,11 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
     ``torch/optimizers.py:1225``)."""
 
     def __init__(self, base, *, window_prefix: str = "pullget",
-                 num_steps_per_communication: int = 1, fuse: bool = True):
+                 num_steps_per_communication: int = 1, fuse: bool = True,
+                 layout: str = "auto"):
         super().__init__(base, window_prefix=window_prefix,
                          num_steps_per_communication=num_steps_per_communication,
-                         fuse=fuse)
+                         fuse=fuse, layout=layout)
 
     def step(self, params, grads, state: DistOptState, *,
              src_weights=None, require_mutex: bool = True):
@@ -335,10 +413,12 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
     _zero_init = True
 
     def __init__(self, base, *, window_prefix: str = "pushsum",
-                 num_steps_per_communication: int = 1, fuse: bool = True):
+                 num_steps_per_communication: int = 1, fuse: bool = True,
+                 layout: str = "auto", auto_collect_rounds: int = 8):
         super().__init__(base, window_prefix=window_prefix,
                          num_steps_per_communication=num_steps_per_communication,
-                         fuse=fuse)
+                         fuse=fuse, layout=layout)
+        self.auto_collect_rounds = int(auto_collect_rounds)
 
     def init(self, params) -> DistOptState:
         W.turn_on_win_ops_with_associated_p()
@@ -370,18 +450,37 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         if dst_weights is None:
             dst_weights = self._outgoing_weights()
         self_share = self._self_share()
-        collected = []
+        t = int(state.step)
+        # Flow control: every ``auto_collect_rounds`` communication rounds
+        # the step fences the transport before folding — no process can run
+        # more than that many rounds ahead of a stalled peer (the fence is a
+        # barrier), so the fraction of a rank's P mass that can ever be in
+        # flight is bounded and de-bias stays well-conditioned WITHOUT
+        # caller-side periodic collect().  The reference gets the analogous
+        # bound for free from MPI's passive-target progress/ordering
+        # (``mpi_controller.cc:953-1034``); a TCP transport must make it
+        # explicit.  The fence is collective — every process calls step the
+        # same number of times (the SPMD training loop), so the fences line
+        # up.  auto_collect_rounds=0 disables.
+        fence_now = (self.auto_collect_rounds > 0
+                     and W._store.distrib is not None
+                     and (t + 1) % self.auto_collect_rounds == 0)
+        handles = []
         for name, payload in zip(self._names, self._payloads(new_params)):
             # win_accumulate applies self_weight AFTER the edge sends, so the
             # out-edges carry w * p_old and per-source mass
             # (self_share + sum_out w == 1) is conserved — the push-sum
             # column-stochastic invariant.
-            h = W.win_accumulate_nonblocking(
+            handles.append(W.win_accumulate_nonblocking(
                 payload, name, self_weight=self_share,
-                dst_weights=dst_weights, require_mutex=require_mutex)
+                dst_weights=dst_weights, require_mutex=require_mutex))
+        for h in handles:
             W.win_wait(h)
-            collected.append(W.win_update_then_collect(
-                name, require_mutex=require_mutex))
+        if fence_now:
+            W.win_fence()
+        collected = [W.win_update_then_collect(name,
+                                               require_mutex=require_mutex)
+                     for name in self._names]
         new_params = self._rebuild(collected, params)
         return (self._merge_owned(params, new_params),
                 DistOptState(base_state, state.step + 1))
@@ -423,6 +522,12 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         logged (the clipped estimate is finite but biased — monitoring
         that watched for inf/NaN would otherwise miss it)."""
         raw = np.asarray(self.associated_p())
+        row_rank = np.arange(raw.shape[0])  # row index -> global rank
+        if self._layout == "owned":
+            # Owned-layout trees carry owned rows only; pick their P slots
+            # (associated_p is always global-rank indexed).
+            row_rank = np.asarray(self._owned, dtype=np.int64)
+            raw = raw[row_rank]
         p = np.maximum(raw, p_min)
         clipped = np.nonzero(raw < p_min)[0]
         if clipped.size:
@@ -431,7 +536,8 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
                 "push-sum debias: associated-P below p_min=%g for rank(s) "
                 "%s — most of their mass is in flight; the de-biased "
                 "estimate is clipped (finite but biased). Bound the "
-                "staleness with opt.collect().", p_min, clipped.tolist())
+                "staleness with opt.collect().", p_min,
+                row_rank[clipped].tolist())
 
         def div(leaf):
             shape = (-1,) + (1,) * (np.ndim(leaf) - 1)

@@ -1,19 +1,23 @@
-"""Multi-machine interactive sessions: a rank-0 REPL driving a worker fleet.
+"""Multi-machine interactive sessions: a rank-0 front-end driving a worker
+fleet — a line REPL (``repl_main``) or a real Jupyter KERNEL
+(``kernel_main``), sharing one cell-shipping channel.
 
 Parity: reference ``run/interactive_run.py:271-420`` (``ibfrun`` multi-machine
 mode boots an ipcontroller + ssh-launched ipengines so one notebook drives the
 MPI world).  The TPU-native counterpart has no ipyparallel: JAX multi-process
 SPMD requires every process to run the SAME program, so the "engine fleet" is
-a set of exec-loop workers and the "controller" is a rank-0 REPL that ships
-each complete cell to every worker over a TCP control channel, then executes
-it locally — collectives inside a cell line up across the gang exactly as in
-a batch run.
+a set of exec-loop workers and the "controller" is a rank-0 front-end that
+ships each complete cell to every worker over a TCP control channel, then
+executes it locally — collectives inside a cell line up across the gang
+exactly as in a batch run.  ``kernel_main`` puts an ipykernel in front of the
+same channel: a NOTEBOOK connected to the standard Jupyter connection file
+drives the whole multi-machine gang, the reference's ipyparallel role.
 
 Wire protocol (length-prefixed JSON): ``{"op": "exec", "src": ...}`` answered
 by ``{"ok": true}`` or ``{"ok": false, "tb": ...}``; ``{"op": "exit"}`` ends
-the session.  Cells run CONCURRENTLY on workers and the REPL — the ack is
-collected only after the local exec, because a collective would otherwise
-deadlock (workers blocked in the op, REPL blocked on acks).
+the session.  Cells run CONCURRENTLY on workers and the front-end — the ack
+is collected only after the local exec, because a collective would otherwise
+deadlock (workers blocked in the op, front-end blocked on acks).
 """
 
 from __future__ import annotations
@@ -28,9 +32,45 @@ import sys
 import time
 import traceback
 
-__all__ = ["main", "worker_main", "repl_main", "ClusterConsole"]
+__all__ = ["main", "worker_main", "repl_main", "kernel_main", "Fleet",
+           "ClusterConsole"]
 
 _ACK_TIMEOUT = float(os.environ.get("BLUEFOG_TPU_IBF_ACK_TIMEOUT", "600"))
+
+
+def _gang_token() -> str:
+    """Shared secret binding workers to THIS gang.
+
+    Workers exec() whatever arrives on the control channel, so both ends
+    must prove they were launched by the same ``ibfrun`` invocation — the
+    reference's ipyparallel mode gets this from keyed connection files
+    (``run/interactive_run.py:271-420``).  The launcher exports one random
+    token per gang (``BFTPU_IBF_TOKEN``); the wire carries only HMACs over
+    per-connection nonces (see ``_mac`` and the handshake in
+    ``worker_main``/``repl_main``), never the token itself — a rogue
+    listener on the ctrl port cannot harvest it from a connecting worker."""
+    return os.environ.get("BFTPU_IBF_TOKEN", "")
+
+
+def _mac(token: str, nonce: str) -> str:
+    import hashlib
+    import hmac
+    return hmac.new(token.encode(), nonce.encode(),
+                    hashlib.sha256).hexdigest()
+
+
+def _mac_ok(token: str, nonce: str, mac) -> bool:
+    import hmac
+    return isinstance(mac, str) and hmac.compare_digest(
+        _mac(token, nonce), mac)
+
+
+def _warn_if_unauthenticated(token: str, side: str) -> None:
+    if not token:
+        print(f"[ibfrun] {side}: BFTPU_IBF_TOKEN is not set — the control "
+              "channel is UNAUTHENTICATED (fine for manual single-machine "
+              "use; ibfrun's launcher always sets a per-gang token)",
+              file=sys.stderr)
 
 
 def _send_msg(sock: socket.socket, obj: dict) -> None:
@@ -69,8 +109,16 @@ def _boot_bf():
 
 def worker_main(ctrl: str) -> int:
     """Exec-loop worker (the reference's ipengine role): rendezvous, connect
-    to the REPL's control socket, run every shipped cell in a persistent
-    namespace."""
+    to the REPL's control socket, complete the mutual HMAC handshake, run
+    every shipped cell in a persistent namespace.
+
+    Handshake (nothing secret on the wire): the REPL sends a nonce
+    challenge; the worker answers with ``HMAC(token, repl_nonce)`` plus its
+    own nonce; the REPL's welcome carries ``HMAC(token, worker_nonce)``.
+    Each side proves possession of the gang token to the other, so neither
+    a rogue ctrl listener (which could otherwise harvest a plaintext
+    credential and replay it) nor a rogue client can enter the exec loop
+    — including its ``exit`` op."""
     bf = _boot_bf()
     host, port_s = ctrl.rsplit(":", 1)
     deadline = time.monotonic() + 120
@@ -82,7 +130,27 @@ def worker_main(ctrl: str) -> int:
             if time.monotonic() > deadline:
                 raise
             time.sleep(0.2)
-    _send_msg(sock, {"op": "hello", "rank": int(bf.rank())})
+    token = _gang_token()
+    _warn_if_unauthenticated(token, f"worker rank {int(bf.rank())}")
+    import secrets
+    sock.settimeout(30)
+    challenge = _recv_msg(sock)
+    if challenge.get("op") != "challenge" or "nonce" not in challenge:
+        raise ConnectionError(
+            "ibfrun worker: the ctrl endpoint did not issue a handshake "
+            "challenge — refusing to join (is something else listening "
+            "on the control port?)")
+    my_nonce = secrets.token_hex(16)
+    _send_msg(sock, {"op": "hello", "rank": int(bf.rank()),
+                     "nonce": my_nonce,
+                     "mac": _mac(token, str(challenge["nonce"]))})
+    welcome = _recv_msg(sock)
+    if (welcome.get("op") != "welcome"
+            or not _mac_ok(token, my_nonce, welcome.get("mac"))):
+        raise ConnectionError(
+            "ibfrun worker: the ctrl endpoint failed the gang-token "
+            "handshake — refusing to run cells from it")
+    sock.settimeout(None)
     ns: dict = {"bf": bf, "__name__": "__main__"}
     while True:
         try:
@@ -110,12 +178,12 @@ def worker_main(ctrl: str) -> int:
     return 0
 
 
-class ClusterConsole(code.InteractiveConsole):
-    """REPL that ships each COMPLETE cell to the worker fleet before running
-    it locally (concurrent SPMD execution), then surfaces worker errors."""
+class Fleet:
+    """The cell-shipping channel to the worker exec loops — shared by the
+    line REPL (:class:`ClusterConsole`) and the Jupyter kernel
+    (:func:`kernel_main`)."""
 
-    def __init__(self, workers, locals=None):  # noqa: A002 — stdlib name
-        super().__init__(locals=locals)
+    def __init__(self, workers):
         self._workers = list(workers)  # live [(rank, sock)]
         self._seq = 0
 
@@ -128,6 +196,82 @@ class ClusterConsole(code.InteractiveConsole):
             pass
         self._workers = [(r, s) for r, s in self._workers if s is not sock]
 
+    def ship(self, source: str) -> int:
+        """Send one cell to every worker (returns its sequence number).
+        The connections were mutually authenticated at handshake time, so
+        messages need no per-cell credential."""
+        self._seq += 1
+        for rank, sock in list(self._workers):
+            try:
+                _send_msg(sock, {"op": "exec", "src": source,
+                                 "seq": self._seq})
+            except OSError as e:
+                self._drop(rank, sock, e)
+        return self._seq
+
+    def collect_acks(self) -> None:
+        """One ack per worker for the LAST shipped cell.  Sequence numbers
+        keep the pairing exact: a late ack from a previous slow cell is
+        drained and discarded, never attributed to the current one; a
+        worker that exceeds the timeout stays in the fleet (its stale ack
+        is skipped on the next collect), while a closed channel removes
+        it."""
+        for rank, sock in list(self._workers):
+            # Scope the timeout to THIS recv loop: leaking it onto the
+            # socket would make later _send_msg sendall calls raise
+            # socket.timeout on a slow-but-healthy worker (long cell,
+            # full TCP buffer) and permanently drop it from the fleet —
+            # after which the SPMD gang deadlocks on the next collective.
+            sock.settimeout(_ACK_TIMEOUT)
+            try:
+                while True:
+                    try:
+                        reply = _recv_msg(sock)
+                    except socket.timeout:
+                        print(f"[ibfrun] rank {rank}: no ack within "
+                              f"{_ACK_TIMEOUT:.0f}s (cell still running "
+                              "there?)", file=sys.stderr)
+                        break
+                    except (EOFError, OSError) as e:
+                        self._drop(rank, sock, e)
+                        break
+                    if reply.get("seq") == self._seq:
+                        if not reply.get("ok"):
+                            tb = reply.get("tb", "").rstrip().splitlines()
+                            tail = tb[-1] if tb else "unknown error"
+                            print(f"[ibfrun] rank {rank} raised: {tail}",
+                                  file=sys.stderr)
+                        break
+                    # Stale ack from an earlier timed-out cell: drain it.
+            finally:
+                try:
+                    sock.settimeout(None)
+                except OSError:
+                    pass  # already closed by _drop
+
+    def close(self) -> None:
+        for _, sock in self._workers:
+            try:
+                _send_msg(sock, {"op": "exit"})
+                sock.close()
+            except OSError:
+                pass
+        self._workers = []
+
+
+class ClusterConsole(code.InteractiveConsole):
+    """REPL that ships each COMPLETE cell to the worker fleet before running
+    it locally (concurrent SPMD execution), then surfaces worker errors."""
+
+    def __init__(self, workers, locals=None):  # noqa: A002 — stdlib name
+        super().__init__(locals=locals)
+        self._fleet = workers if isinstance(workers, Fleet) \
+            else Fleet(workers)
+
+    @property
+    def _workers(self):  # introspection/tests
+        return self._fleet._workers
+
     def runsource(self, source, filename="<input>", symbol="single"):
         try:
             compiled = self.compile(source, filename, symbol)
@@ -136,77 +280,159 @@ class ClusterConsole(code.InteractiveConsole):
             return False
         if compiled is None:
             return True  # incomplete cell: keep buffering
-        self._seq += 1
-        for rank, sock in list(self._workers):
-            try:
-                _send_msg(sock, {"op": "exec", "src": source,
-                                 "seq": self._seq})
-            except OSError as e:
-                self._drop(rank, sock, e)
+        self._fleet.ship(source)
         self.runcode(compiled)
-        self._collect_acks()
+        self._fleet.collect_acks()
         return False
 
-    def _collect_acks(self):
-        """One ack per worker for THIS cell.  Sequence numbers keep the
-        pairing exact: a late ack from a previous slow cell is drained and
-        discarded, never attributed to the current one; a worker that
-        exceeds the timeout stays in the fleet (its stale ack is skipped on
-        the next collect), while a closed channel removes it."""
-        for rank, sock in list(self._workers):
-            sock.settimeout(_ACK_TIMEOUT)
-            while True:
-                try:
-                    reply = _recv_msg(sock)
-                except socket.timeout:
-                    print(f"[ibfrun] rank {rank}: no ack within "
-                          f"{_ACK_TIMEOUT:.0f}s (cell still running "
-                          "there?)", file=sys.stderr)
-                    break
-                except (EOFError, OSError) as e:
-                    self._drop(rank, sock, e)
-                    break
-                if reply.get("seq") == self._seq:
-                    if not reply.get("ok"):
-                        tb = reply.get("tb", "").rstrip().splitlines()
-                        tail = tb[-1] if tb else "unknown error"
-                        print(f"[ibfrun] rank {rank} raised: {tail}",
-                              file=sys.stderr)
-                    break
-                # Stale ack from an earlier timed-out cell: drain it.
+
+def _accept_fleet(ctrl: str, expect: int, side: str):
+    """Rank-0 side shared by the REPL and the kernel: boot the SPMD world,
+    listen on the ctrl endpoint, mutually authenticate ``expect`` workers
+    (HMAC challenge-response, see :func:`worker_main`).  Returns
+    ``(srv, workers, bf)`` with ``workers`` rank-sorted."""
+    host, port_s = ctrl.rsplit(":", 1)
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        # Bind the coordinator interface the workers were told to dial,
+        # not every interface on the machine.
+        srv.bind((host, int(port_s)))
+    except OSError as e:
+        import errno
+        if e.errno != errno.EADDRNOTAVAIL:
+            raise  # EADDRINUSE etc: surface the REAL cause, don't mask it
+        # The --ctrl host does not resolve to a local interface (NAT'd or
+        # misresolved name): fall back to a wildcard bind, LOUDLY — the
+        # exec() channel is now reachable on every interface.
+        print(f"[ibfrun] ctrl host {host!r} is not a local address; "
+              "binding ALL interfaces (the handshake still gates exec)",
+              file=sys.stderr)
+        srv.bind(("", int(port_s)))
+    srv.listen(expect)
+    bf = _boot_bf()
+    token = _gang_token()
+    _warn_if_unauthenticated(token, side)
+    import secrets
+    workers = []
+    # 120s of patience PER MISSING WORKER (as before this had a handshake),
+    # not a shared deadline a slow ssh fan-out could overrun.
+    srv.settimeout(120)
+    while len(workers) < expect:
+        conn, peer = srv.accept()
+        try:
+            conn.settimeout(10)  # a silent connection must not wedge accept
+            nonce = secrets.token_hex(16)
+            _send_msg(conn, {"op": "challenge", "nonce": nonce})
+            hello = _recv_msg(conn)
+        except (EOFError, OSError, ValueError):
+            hello = {}
+        if (hello.get("op") != "hello"
+                or not _mac_ok(token, nonce, hello.get("mac"))):
+            # A connection that cannot prove possession of this gang's
+            # secret is not a worker: close it and keep listening (it must
+            # not consume one of the ``expect`` fleet slots).
+            print(f"[ibfrun] rejected unauthenticated connection from "
+                  f"{peer}", file=sys.stderr)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            continue
+        # Prove OUR possession back (the worker refuses a rogue listener).
+        _send_msg(conn, {"op": "welcome",
+                         "mac": _mac(token, str(hello.get("nonce", "")))})
+        conn.settimeout(None)
+        workers.append((int(hello.get("rank", -1)), conn))
+    workers.sort()
+    return srv, workers, bf
 
 
 def repl_main(ctrl: str, expect: int) -> int:
     """Rank-0 side: listen for ``expect`` workers, rendezvous, drive the
     interactive session."""
-    host, port_s = ctrl.rsplit(":", 1)
-    srv = socket.socket()
-    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("", int(port_s)))
-    srv.listen(expect)
-    bf = _boot_bf()
-    workers = []
-    srv.settimeout(120)
-    for _ in range(expect):
-        conn, _ = srv.accept()
-        hello = _recv_msg(conn)
-        workers.append((int(hello.get("rank", -1)), conn))
-    workers.sort()
+    srv, workers, bf = _accept_fleet(ctrl, expect, "repl")
     print(f"bluefog_tpu interactive: {bf.size()} rank(s) across "
           f"{bf.machine_size()} process(es) ready; every cell runs SPMD on "
           "the whole gang", flush=True)
-    console = ClusterConsole(workers, locals={"bf": bf,
-                                              "__name__": "__main__"})
+    fleet = Fleet(workers)
+    console = ClusterConsole(fleet, locals={"bf": bf,
+                                            "__name__": "__main__"})
     try:
         console.interact(banner="", exitmsg="")
     except SystemExit:
         pass
-    for _, sock in workers:
-        try:
-            _send_msg(sock, {"op": "exit"})
-            sock.close()
-        except OSError:
-            pass
+    fleet.close()
+    srv.close()
+    bf.shutdown()
+    return 0
+
+
+def kernel_main(ctrl: str, expect: int, conn_file: str) -> int:
+    """Rank-0 side as a JUPYTER KERNEL: a notebook client connected to
+    ``conn_file`` (standard Jupyter connection file, written on startup)
+    drives the whole multi-machine gang — every executed cell is shipped
+    to the worker fleet before running in the kernel, so collectives line
+    up SPMD exactly as in the line REPL.  This is the reference's
+    multi-machine-notebook role (ipcontroller + ssh'd ipengines,
+    ``run/interactive_run.py:271-420``) on the one authenticated
+    cell-shipping channel; Jupyter's own connection-file HMAC key
+    authenticates the notebook client side."""
+    srv, workers, bf = _accept_fleet(ctrl, expect, "kernel")
+    fleet = Fleet(workers)
+
+    from ipykernel.ipkernel import IPythonKernel
+    from ipykernel.kernelapp import IPKernelApp
+
+    class ClusterKernel(IPythonKernel):
+        implementation = "bluefog_tpu-cluster"
+        banner = ("bluefog_tpu SPMD cluster kernel: every cell runs on "
+                  "the whole gang")
+
+        async def do_execute(self, code, silent, store_history=True,
+                             user_expressions=None, allow_stdin=False,
+                             **kwargs):
+            # IPython-only syntax (magics, !shell, obj?) would execute in
+            # THIS kernel but be a SyntaxError in the workers' plain
+            # exec() — the kernel could then enter a collective the
+            # workers never reach and hang the gang.  Reject such cells
+            # BEFORE shipping or executing anything, keeping both sides
+            # in lockstep.
+            transformed = self.shell.transform_cell(code)
+            if transformed.strip() != code.strip():
+                return await super().do_execute(
+                    "raise RuntimeError('ibfrun cluster kernel: "
+                    "IPython-only syntax (magics/!shell/?help) cannot run "
+                    "SPMD on the worker fleet — use plain Python in "
+                    "cluster cells')",
+                    silent, store_history=False,
+                    user_expressions=user_expressions,
+                    allow_stdin=allow_stdin, **kwargs)
+            fleet.ship(code)
+            try:
+                # Local exec runs CONCURRENTLY with the workers' —
+                # collectives inside the cell rendezvous across the gang.
+                return await super().do_execute(
+                    code, silent, store_history=store_history,
+                    user_expressions=user_expressions,
+                    allow_stdin=allow_stdin, **kwargs)
+            finally:
+                # Inside do_execute sys.stderr forwards to the client, so
+                # worker errors/timeouts surface in the notebook.
+                fleet.collect_acks()
+
+    app = IPKernelApp.instance(connection_file=conn_file,
+                               kernel_class=ClusterKernel)
+    app.initialize([])
+    app.kernel.shell.user_ns.update({"bf": bf})
+    print(f"bluefog_tpu cluster kernel: {bf.size()} rank(s) across "
+          f"{bf.machine_size()} process(es); connection file "
+          f"{app.abs_connection_file}", flush=True)
+    try:
+        app.start()  # returns after the client's shutdown_request
+    except SystemExit:
+        pass
+    fleet.close()
     srv.close()
     bf.shutdown()
     return 0
@@ -217,14 +443,19 @@ def main(argv=None) -> int:
     p.add_argument("--ctrl", required=True, help="rank-0 control host:port")
     p.add_argument("--repl", action="store_true",
                    help="run the rank-0 REPL (default: worker exec loop)")
+    p.add_argument("--kernel-file", default=None,
+                   help="run the rank-0 side as a Jupyter kernel writing "
+                        "this connection file (notebook front-end)")
     p.add_argument("--expect", type=int, default=None,
-                   help="worker connections the REPL waits for "
+                   help="worker connections the rank-0 side waits for "
                         "(default: processes - 1)")
     args = p.parse_args(argv)
-    if args.repl:
+    if args.repl or args.kernel_file:
         expect = args.expect
         if expect is None:
             expect = int(os.environ.get("BFTPU_NUM_PROCESSES", "1")) - 1
+        if args.kernel_file:
+            return kernel_main(args.ctrl, expect, args.kernel_file)
         return repl_main(args.ctrl, expect)
     return worker_main(args.ctrl)
 

@@ -27,6 +27,12 @@ reference's ``ibfrun start`` performed:
   SPMD across the gang (``run/cluster_repl.py``).  With ``--hosts``, ``-np``
   counts processes (as in bfrun) and ``--devices-per-proc`` adds a virtual
   mesh per process.
+* ``ibfrun -np 4 --hosts h1:2,h2:2 --kernel-file /tmp/bf-kernel.json`` —
+  multi-machine JUPYTER mode: rank 0 becomes a real ipykernel in front of
+  the same cell-shipping channel; connect any notebook/console client to
+  the connection file and every executed cell drives the whole gang (the
+  reference's ipcontroller+ipengines role).  See
+  ``examples/cluster_notebook.ipynb``.
 
 Inside the session, ``bf.suspend()`` / ``bf.resume()`` quiesce and re-enable
 communication between cells (reference ``common/basics.py:497-515``).
@@ -71,10 +77,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "exec-loop workers")
     p.add_argument("--rsh", default=None,
                    help="remote-shell command for --hosts workers "
-                        "(default: ssh -p <ssh-port>)")
+                        "(default: ssh -p <ssh-port>).  Must forward "
+                        "stdin to the remote command like ssh does — the "
+                        "per-gang auth token travels that way, never on "
+                        "a command line")
     p.add_argument("--ssh-port", type=int, default=22)
     p.add_argument("--devices-per-proc", type=int, default=None,
                    help="virtual CPU devices per process (--hosts mode)")
+    p.add_argument("--kernel-file", default=None,
+                   help="--hosts mode: run rank 0 as a JUPYTER KERNEL "
+                        "writing this connection file instead of a line "
+                        "REPL — connect a notebook client to it and every "
+                        "executed cell runs SPMD on the whole "
+                        "multi-machine gang")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run instead of a REPL")
     return p
@@ -99,6 +114,12 @@ def _cluster(args) -> int:
     coord = f"{coord_host}:{R._free_port()}"
     ctrl = f"{coord_host}:{R._free_port()}"
     tag = f"ibfrun-gang-{uuid.uuid4().hex[:12]}"
+    # Per-gang shared secret: workers exec() shipped cells, so both sides
+    # of the control channel prove possession via an HMAC challenge-
+    # response at connect time (cluster_repl handshake); rides
+    # remote_run_cmd's BFTPU_ env replication.
+    import secrets
+    token = secrets.token_hex(16)
     host_slots = {}
     for host, _ in placement:
         host_slots[host] = host_slots.get(host, 0) + 1
@@ -111,6 +132,7 @@ def _cluster(args) -> int:
         env["BFTPU_LOCAL_ID"] = str(local_rank)
         env["BFTPU_LOCAL_SIZE"] = str(local_size)
         env["BFTPU_GANG_TAG"] = tag
+        env["BFTPU_IBF_TOKEN"] = token
         if args.devices_per_proc:
             virtual_mesh_env(env, args.devices_per_proc)
         return env
@@ -124,16 +146,27 @@ def _cluster(args) -> int:
                 continue  # the REPL below
             env = child_env(rank, local_rank, host_slots[host])
             if R.is_local_host(host):
+                # Local children get the token via the env DICT (never a
+                # command line); remote ones read it from the rsh stdin
+                # below — remote_run_cmd refuses to inline it into argv,
+                # where /proc would expose it to every local user.
                 entries.append((subprocess.Popen(wcmd, env=env), host,
                                 False))
             else:
-                remote = R._launch_shell(tag, rank,
-                                         R.remote_run_cmd(env, wcmd))
-                entries.append((subprocess.Popen(rsh + [host, remote]),
-                                host, True))
+                run_cmd = ("IFS= read -r BFTPU_IBF_TOKEN && "
+                           "export BFTPU_IBF_TOKEN && "
+                           + R.remote_run_cmd(env, wcmd))
+                remote = R._launch_shell(tag, rank, run_cmd)
+                p = subprocess.Popen(rsh + [host, remote],
+                                     stdin=subprocess.PIPE, text=True)
+                p.stdin.write(token + "\n")
+                p.stdin.close()
+                entries.append((p, host, True))
+        front = (["--kernel-file", args.kernel_file] if args.kernel_file
+                 else ["--repl"])
         rc = subprocess.call(
-            [sys.executable, "-m", "bluefog_tpu.run.cluster_repl", "--repl",
-             "--ctrl", ctrl, "--expect", str(n - 1)],
+            [sys.executable, "-m", "bluefog_tpu.run.cluster_repl"] + front
+            + ["--ctrl", ctrl, "--expect", str(n - 1)],
             env=child_env(0, placement[0][1], host_slots[coord_host]))
     except KeyboardInterrupt:
         print("ibfrun: interrupted; stopping the gang", file=sys.stderr)
@@ -207,6 +240,11 @@ def main(argv=None) -> int:
                   "--no-init are not supported with it", file=sys.stderr)
             return 2
         return _cluster(args)
+    if args.kernel_file:
+        print("ibfrun: --kernel-file drives the multi-machine gang and "
+              "needs --hosts (single-machine notebooks just start any "
+              "kernel under `ibfrun -np N jupyter ...`)", file=sys.stderr)
+        return 2
     env, pin = _prepared_env(args.num_proc)
 
     try:

@@ -24,6 +24,7 @@ Modes
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import shlex
 import socket
@@ -146,13 +147,43 @@ def _join_tag_pumps(entries, timeout: float = 10.0) -> None:
 _ENV_EXPORT_PREFIXES = ("BFTPU_", "XLA_", "JAX_", "BLUEFOG")
 
 
+@functools.lru_cache(maxsize=None)
+def _local_addrs() -> frozenset:
+    addrs = {"127.0.0.1", "::1"}
+    try:
+        addrs.update(socket.gethostbyname_ex(socket.gethostname())[2])
+    except OSError:
+        pass
+    return frozenset(addrs)
+
+
+@functools.lru_cache(maxsize=None)
 def is_local_host(host: str) -> bool:
-    return host in ("127.0.0.1", "localhost", socket.gethostname())
+    """True when ``host`` names THIS machine — by shortname, FQDN, or any
+    address that resolves to a local interface.  A --hosts entry naming
+    the local machine by FQDN/IP must not be treated as remote: bfrun
+    would ssh-to-self needlessly, and ibfrun --hosts would refuse to
+    start ('the first --hosts entry must be this machine')."""
+    if host in ("127.0.0.1", "::1", "localhost",
+                socket.gethostname(), socket.getfqdn()):
+        return True
+    try:
+        resolved = {ai[4][0] for ai in socket.getaddrinfo(host, None)}
+    except OSError:
+        return False
+    return bool(resolved & _local_addrs())
 
 
 def rsh_argv(rsh_opt, ssh_port: int) -> list:
     """The remote transport argv prefix: ``--rsh`` override or ssh."""
     return shlex.split(rsh_opt) if rsh_opt else ["ssh", "-p", str(ssh_port)]
+
+
+# Secrets must NEVER ride a remote command line: argv is world-readable in
+# /proc on every gang machine for the whole session.  These keys are
+# excluded from remote_run_cmd's inline exports; their owners ship them out
+# of band (ibfrun pipes the gang token over the rsh client's stdin).
+_ENV_NEVER_INLINE = ("BFTPU_IBF_TOKEN",)
 
 
 def remote_run_cmd(env: dict, cmd: list) -> str:
@@ -161,7 +192,8 @@ def remote_run_cmd(env: dict, cmd: list) -> str:
     new env var cannot reach one launcher's remote ranks and not the
     other's."""
     exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items()
-                       if k.startswith(_ENV_EXPORT_PREFIXES))
+                       if k.startswith(_ENV_EXPORT_PREFIXES)
+                       and k not in _ENV_NEVER_INLINE)
     return (f"cd {shlex.quote(os.getcwd())} && {exports} "
             + " ".join(shlex.quote(c) for c in cmd))
 

@@ -118,13 +118,62 @@ def _foreign_frontier(base: str) -> int:
     return steps[-1] if steps else 0
 
 
+_OWNED_FILE = "owned_ranks.json"
+
+
+def _write_owned_ranks(proc_dir: str) -> None:
+    """Persist this process's rank-ownership alongside its checkpoints so a
+    world-size resume can attribute rank-major rows to their authoritative
+    owner even under non-uniform ``--hosts h1:3,h2:1`` placements (where an
+    even ``array_split`` would take rows from the wrong process)."""
+    import json
+    try:
+        # The framework's own rank directory (honors bf.init(devices=...)
+        # custom device lists, matching the window layer's rank_owner).
+        from bluefog_tpu import basics
+        owned = list(basics.owned_ranks())
+    except Exception:
+        owned = [i for i, d in enumerate(jax.devices())
+                 if d.process_index == jax.process_index()]
+    os.makedirs(proc_dir, exist_ok=True)
+    tmp = os.path.join(proc_dir, _OWNED_FILE + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(owned, fh)
+    os.replace(tmp, os.path.join(proc_dir, _OWNED_FILE))
+
+
+def _owned_rows_of(dirs, n_rows: int):
+    """Per-directory authoritative row lists for ``n_rows`` rank-major rows.
+
+    Uses each old process's persisted ``owned_ranks.json`` when every
+    directory has one and the lists exactly partition ``range(n_rows)``;
+    otherwise falls back to even contiguous blocks (pre-ownership-file
+    checkpoints, or a leaf whose leading dim is not the old world size)."""
+    import json
+    import numpy as np
+    maps = []
+    for d in dirs:
+        try:
+            with open(os.path.join(d, _OWNED_FILE)) as fh:
+                maps.append([int(r) for r in json.load(fh)])
+        except (OSError, ValueError):
+            maps.append(None)
+    if all(m is not None for m in maps):
+        flat = sorted(r for m in maps for r in m)
+        if flat == list(range(n_rows)):
+            return maps
+    return [rows.tolist()
+            for rows in np.array_split(np.arange(n_rows), len(dirs))]
+
+
 def _stitch(base: str, step: int):
     """Assemble the authoritative global state at ``step`` from every old
     process's directory: rank-major rows are taken from their OWNING
-    process's copy (contiguous even blocks — uniform devices-per-proc, the
-    launcher's layout).  A directory missing the step contributes nothing;
-    its rows come from a donor's copy (at most one gossip round stale).
-    Requires ``base`` on storage every process can read."""
+    process's copy (per the persisted ownership map; even contiguous
+    blocks for pre-map checkpoints).  A directory missing the step
+    contributes nothing; its rows come from a donor's copy (at most one
+    gossip round stale).  Requires ``base`` on storage every process can
+    read."""
     import numpy as np
     dirs = _proc_dirs(base)
     if not dirs:
@@ -138,15 +187,17 @@ def _stitch(base: str, step: int):
     donor_leaves = jax.tree.leaves(donor)
     all_leaves = [jax.tree.leaves(r) if r is not None else None
                   for r in raws]
+    owned_cache = {}
     out = []
     for i, leaf in enumerate(donor_leaves):
         s0 = np.asarray(leaf)
         if s0.ndim == 0:
             out.append(s0)
             continue
-        blocks = np.array_split(np.arange(s0.shape[0]), len(dirs))
+        if s0.shape[0] not in owned_cache:
+            owned_cache[s0.shape[0]] = _owned_rows_of(dirs, s0.shape[0])
         acc = s0.copy()
-        for k, rows in enumerate(blocks):
+        for k, rows in enumerate(owned_cache[s0.shape[0]]):
             if all_leaves[k] is None or not len(rows):
                 continue
             acc[rows] = np.asarray(all_leaves[k][i])[rows]
@@ -168,7 +219,12 @@ def _fit_leaf(saved, tgt):
         return s
     if (s.ndim == len(tshape) and s.ndim >= 1
             and s.shape[1:] == tshape[1:]):
-        avg = s.mean(axis=0).astype(s.dtype)
+        avg = s.mean(axis=0)
+        if np.issubdtype(s.dtype, np.integer):
+            # A truncating cast would bias per-rank counters toward zero
+            # (e.g. step counts averaging 99.5 -> 99); round to nearest.
+            avg = np.rint(avg)
+        avg = avg.astype(s.dtype)
         return np.broadcast_to(avg, tshape).copy()
     raise ValueError(
         f"elastic reshard: saved leaf shape {s.shape} does not map to the "
@@ -286,6 +342,10 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
                 "race), and resume must be agreed across processes")
         else:
             ckpt_dir = os.path.join(ckpt_dir, f"proc{jax.process_index()}")
+            # NOTE: this geometry's owned_ranks.json is written AFTER the
+            # resume decision below — writing it here would clobber the OLD
+            # run's ownership maps before _stitch reads them (a world-size
+            # resume at fewer processes reuses the same procN dirs).
     # Sharded mode shares one directory but still agrees explicitly — the
     # allgather doubles as the barrier that keeps a fast process from
     # restoring while a late one still holds the old run's state.
@@ -363,6 +423,11 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
                 # itself (e.g. window-store buffers via
                 # ``opt.load_window_state_dict(state[...])``).
                 on_restore(state, start)
+    if jax.process_count() > 1 and per_process and not sharded:
+        # The resume decision is made; NOW record this geometry's ownership
+        # for future world-size resumes (non-uniform placements attribute
+        # rows to the wrong process without it).
+        _write_owned_ranks(ckpt_dir)
     if start >= num_steps:
         return state
 

@@ -65,10 +65,13 @@ def build_parser():
                     help="transformer model: jax.checkpoint each block "
                          "(recompute activations in backward; long-context "
                          "memory knob)")
-    ap.add_argument("--remat-policy", choices=["full", "dots"],
-                    default="full",
-                    help="with --remat: 'dots' saves matmul outputs and "
-                         "recomputes only elementwise/attention")
+    ap.add_argument("--remat-policy", default="full",
+                    help="with --remat: 'full' recomputes everything; "
+                         "'dots' saves matmul outputs and recomputes only "
+                         "elementwise/attention; 'dots:<K>' applies dots "
+                         "to the first K blocks and full to the rest (the "
+                         "continuous HBM/MFU dial for models where "
+                         "all-dots exceeds memory)")
     ap.add_argument("--chunked-loss", action="store_true",
                     help="transformer model: chunked lm-head cross-entropy "
                          "(never materializes the S x vocab logits)")

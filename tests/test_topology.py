@@ -201,3 +201,38 @@ def test_weight_matrix_roundtrip():
     w = topo.weight_matrix(topo.MeshGrid2DGraph(6))
     G2 = topo.from_weight_matrix(w)
     assert topo.IsTopologyEquivalent(topo.MeshGrid2DGraph(6), G2)
+
+
+def test_pod_scale_phase_table_n128():
+    """Pod-scale (v4-128) schedule compilation, validated virtually: the
+    one-peer Exp2 phase table at n=128 compiles to exactly log2(n) = 7
+    one-ppermute phases, every phase is a permutation (column-stochastic
+    with 0.5/0.5 weights), and the 7-phase product mixes to EXACT uniform
+    consensus (0.5**7 == 1/128 is exact in binary floating point).
+    Nothing here needs 128 chips — the schedule and its mixing math are
+    device-count-free numpy."""
+    import numpy as np
+    from bluefog_tpu.ops import schedule as S
+    n = 128
+    phases = topo.one_peer_exp2_phases(n)
+    assert len(phases) == 7
+    for k, ph in enumerate(phases):
+        send = np.asarray(ph.send_to)
+        assert sorted(send) == list(range(n))  # a permutation: one peer each
+        np.testing.assert_array_equal(send, (np.arange(n) + 2 ** k) % n)
+    dyn = S.compile_dynamic(phases, n)
+    assert dyn.period == 7
+    W = np.eye(n)
+    for ph in dyn.phases:
+        assert len(ph.rounds) == 1  # one ppermute per phase
+        M = np.diag(ph.self_scale.astype(np.float64))
+        rnd = ph.rounds[0]
+        for s, d in rnd.pairs:
+            M[s, d] = rnd.send_scale[s]
+        np.testing.assert_array_equal(M.sum(axis=0), 1.0)  # column-stochastic
+        np.testing.assert_array_equal(M.sum(axis=1), 1.0)  # row-stochastic
+        W = W @ M
+    np.testing.assert_array_equal(W, np.full((n, n), 1.0 / n))
+    # The static Exp2 compiles to the same 7 shift classes as one program.
+    st = S.compile_static(topo.ExponentialTwoGraph(n), use_topo_weights=False)
+    assert len(st.rounds) == 7
