@@ -5,7 +5,8 @@
 
 PYTEST = python -m pytest -q
 
-.PHONY: test test-fast test-slow test-all test-onchip bench native
+.PHONY: test test-fast test-slow test-all test-onchip bench native \
+        telemetry-smoke
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change.
@@ -27,6 +28,13 @@ test-onchip:
 
 bench:
 	python bench.py
+
+# End-to-end telemetry check: start the /metrics endpoint, drive one
+# collective, scrape /metrics + /healthz and assert the core series exist.
+telemetry-smoke:
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    python -m bluefog_tpu.utils.telemetry
 
 native:
 	$(MAKE) -C bluefog_tpu/native

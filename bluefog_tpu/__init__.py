@@ -14,6 +14,7 @@ Public surface mirrors ``import bluefog.torch as bf`` (reference
 >>> y = bf.neighbor_allreduce(x)
 """
 
+from bluefog_tpu import _compat  # noqa: F401  — jax version shims first
 from bluefog_tpu import topology  # noqa: F401
 from bluefog_tpu import topology as topology_util  # parity alias  # noqa: F401
 
@@ -120,3 +121,6 @@ from bluefog_tpu.utils.timeline import (  # noqa: F401
     timeline_end_activity,
     timeline_context,
 )
+
+from bluefog_tpu.utils import telemetry  # noqa: F401
+from bluefog_tpu.utils.telemetry import telemetry_snapshot  # noqa: F401

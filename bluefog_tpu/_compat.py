@@ -1,0 +1,46 @@
+"""Cross-version jax shims, installed at package import.
+
+The codebase targets the modern ``jax.shard_map`` spelling; on jax
+releases where it still lives in ``jax.experimental.shard_map`` (< 0.5)
+every op would die with ``AttributeError`` at dispatch.  Alias it (with
+the ``check_vma`` → ``check_rep`` kwarg rename) so one import works on
+both sides of the move.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:  # renamed from check_rep after the move
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, *args, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    if hasattr(jax.lax, "axis_size"):
+        return
+    from jax._src import core as _core
+
+    def axis_size(axis_name):
+        """Static size of a named mesh axis (modern ``lax.axis_size``):
+        read off the ambient axis env, so it stays a python int under
+        shard_map (callers use it in shape arithmetic)."""
+        return _core.get_axis_env().axis_size(axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+_install_shard_map()
+_install_axis_size()

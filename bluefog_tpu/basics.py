@@ -172,6 +172,10 @@ def init(topology_fn=None, is_weighted: bool = False, *,
     if n // local_size > 1:
         set_machine_topology(
             topology_util.ExponentialGraph(n // local_size), is_weighted=False)
+    # Opt-in /metrics + /healthz endpoint (BLUEFOG_TPU_TELEMETRY_PORT);
+    # idempotent across re-init.
+    from bluefog_tpu.utils import telemetry
+    telemetry.maybe_start_endpoint()
 
 
 def _local_device_kwargs(env) -> dict:
@@ -532,6 +536,8 @@ def _throttle(out):
         dq.append(min(leaves, key=lambda x: getattr(x, "size", 0)))
         if len(dq) > _max_inflight():
             old = dq.popleft()
+            from bluefog_tpu.utils import telemetry
+            telemetry.inc("bf_throttle_waits_total")
             try:
                 jax.block_until_ready(old)
             except Exception:  # noqa: BLE001 — see below
@@ -567,12 +573,37 @@ def _jitted(key, build):
 
     Eager ops construct fresh closures every call; caching on a logical key
     keeps XLA's compile cache hot (one compile per op x schedule x shape)."""
+    from bluefog_tpu.utils import telemetry
     ctx = _require_init()
     with ctx._lock:
         cache = ctx.__dict__.setdefault("_jit_cache", {})
         if key not in cache:
+            telemetry.inc("bf_dispatch_cache_misses_total")
             cache[key] = build()
+        else:
+            telemetry.inc("bf_dispatch_cache_hits_total")
         return cache[key]
+
+
+def _record_dispatch(key, fn, x) -> None:
+    """Per-call comm counters, recorded at DISPATCH time — the op bodies in
+    ``ops/collective.py`` are traced into one XLA program, so this is the
+    only place every call crosses Python.  ``bf_comm_bytes_total`` counts
+    the element bytes of the rank-major input; rounds/edges/wire bytes come
+    from the compiled schedule (``collective.schedule_wire_stats``), pulled
+    off the partial the caller built (dynamic schedules report per-call
+    averages over their period)."""
+    from bluefog_tpu.utils import telemetry
+    if not telemetry.enabled():
+        return
+    op = str(key[0])
+    nbytes = getattr(x, "nbytes", None)
+    if nbytes is None:
+        nbytes = np.asarray(x).nbytes
+    sched = fn.keywords.get("sched") if isinstance(fn, partial) else None
+    telemetry.record_comm_traffic(
+        op, nbytes, size=size(),
+        sched_stats=None if sched is None else C.schedule_wire_stats(sched))
 
 
 def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
@@ -586,6 +617,7 @@ def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
             in_specs=(P(RANK_AXIS),) + (P(),) * n_extra,
             out_specs=P(RANK_AXIS)))
     from bluefog_tpu.utils.timeline import op_span
+    _record_dispatch(key, fn, x)
     with op_span(str(key[0]), "ENQUEUE"):
         return _throttle(
             _jitted(("flat", key, len(extra)), build)(_place(x), *extra))
@@ -602,6 +634,7 @@ def _dispatch_hier(key, fn, x, *extra) -> jnp.ndarray:
             in_specs=(P((MACHINE_AXIS, LOCAL_AXIS)),) + (P(),) * n_extra,
             out_specs=P((MACHINE_AXIS, LOCAL_AXIS))))
     from bluefog_tpu.utils.timeline import op_span
+    _record_dispatch(key, fn, x)
     with op_span(str(key[0]), "ENQUEUE"):
         return _throttle(
             _jitted(("hier", key, len(extra)), build)(_place(x), *extra))
@@ -834,6 +867,7 @@ def allgather_v(tensors, name: Optional[str] = None) -> jnp.ndarray:
             run, mesh=ctx.mesh, in_specs=(P(RANK_AXIS),),
             out_specs=P(RANK_AXIS)))
     from bluefog_tpu.utils.timeline import op_span
+    _record_dispatch(("allgather_v",), None, padded)
     with op_span("allgather_v", "ENQUEUE"):  # dispatch only (op-span parity)
         fn = _jitted(("allgather_v", lengths, padded.shape, str(padded.dtype)),
                      build)

@@ -44,7 +44,29 @@ __all__ = [
     "pair_gossip",
     "hierarchical_neighbor_allreduce",
     "dynamic_hierarchical_neighbor_allreduce",
+    "schedule_wire_stats",
 ]
+
+
+def schedule_wire_stats(sched) -> tuple:
+    """``(rounds, edges)`` of a compiled schedule — the per-call wire-cost
+    metadata telemetry records at dispatch time (the op bodies here are
+    traced into one XLA program, so Python-side counters cannot live in
+    them; the schedule is the ground truth for what the program moves).
+
+    ``StaticSchedule``/``PairGossipSchedule``: rounds is the ppermute count
+    per call, edges the total (src, dst) pairs across them.  A
+    ``DynamicSchedule`` executes ONE phase per call (``lax.switch``), so
+    rounds/edges are averaged over the period — the exact per-call value
+    for uniform phases (one-peer walks), the expectation otherwise."""
+    phases = getattr(sched, "phases", None)
+    if phases is not None:  # DynamicSchedule
+        per = [schedule_wire_stats(ph) for ph in phases]
+        k = max(len(per), 1)
+        return (sum(r for r, _ in per) / k, sum(e for _, e in per) / k)
+    rnd = getattr(sched, "round", None)
+    rounds = sched.rounds if rnd is None else [rnd]
+    return (len(rounds), sum(len(r.pairs) for r in rounds))
 
 
 def _axis_index(axis_name):

@@ -46,6 +46,17 @@ __all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
            "OP_GET_REPLY", "OP_FENCE_REQ", "OP_FENCE_ACK", "OP_MUTEX_ACQ",
            "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BF16_FLAG"]
 
+_OP_NAMES = {OP_PUT: "put", OP_ACCUMULATE: "accumulate",
+             OP_GET_REQ: "get_req", OP_GET_REPLY: "get_reply",
+             OP_FENCE_REQ: "fence_req", OP_FENCE_ACK: "fence_ack",
+             OP_MUTEX_ACQ: "mutex_acq", OP_MUTEX_GRANT: "mutex_grant",
+             OP_MUTEX_REL: "mutex_rel"}
+
+
+def _op_label(op: int) -> str:
+    """Telemetry label for a wire op code (compression flag stripped)."""
+    return _OP_NAMES.get(op & ~OP_BF16_FLAG, str(op))
+
 
 class WindowTransport:
     """One per-process TCP endpoint for window gossip.
@@ -80,19 +91,31 @@ class WindowTransport:
     def send(self, host: str, port: int, op: int, name: str, src: int,
              dst: int, weight: float, tensor: np.ndarray,
              p_weight: float = 0.0) -> None:
+        from bluefog_tpu.utils import telemetry
         payload = np.ascontiguousarray(tensor).view(np.uint8).reshape(-1)
+        # Guard BEFORE building labels: the disabled path must not pay the
+        # per-message f-string/op-name allocations on the gossip hot path.
+        if telemetry.enabled():
+            telemetry.inc("bf_win_tx_msgs_total", op=_op_label(op))
+            telemetry.inc("bf_win_tx_bytes_total", float(payload.size),
+                          peer=f"{host}:{port}")
         rc = self._lib.bf_winsvc_send(
             host.encode(), port, op, name.encode(), src, dst,
             float(weight), float(p_weight),
             payload.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             payload.size)
         if rc != 0:
+            if telemetry.enabled():
+                telemetry.inc("bf_win_tx_errors_total",
+                              peer=f"{host}:{port}")
             raise ConnectionError(
                 f"win transport send to {host}:{port} failed (code {rc})")
 
     # -- inbound -----------------------------------------------------------
     def _drain(self):
+        from bluefog_tpu.utils import telemetry
         msg = native.WinMsg()
+        burst = 0  # consecutive non-empty recvs: inbound-queue depth proxy
         while not self._stop.is_set():
             got = self._lib.bf_winsvc_recv(
                 self._svc, ctypes.byref(msg),
@@ -103,8 +126,20 @@ class WindowTransport:
                                      dtype=np.uint8)
                 continue
             if got == 0:
+                if burst:
+                    # The native layer exposes no queue-length API, so the
+                    # burst length — messages drained back-to-back before
+                    # the queue ran dry — is the depth proxy.
+                    telemetry.set_gauge("bf_win_rx_queue_depth", burst)
+                    burst = 0
                 self._stop.wait(self._interval)
                 continue
+            burst += 1
+            if telemetry.enabled():  # skip label rendering when off
+                telemetry.inc("bf_win_rx_msgs_total",
+                              op=_op_label(int(msg.op) & ~OP_BF16_FLAG))
+                telemetry.inc("bf_win_rx_bytes_total",
+                              float(msg.payload_len))
             payload = bytes(self._buf[:msg.payload_len])
             try:
                 self._apply(int(msg.op), msg.name.decode(), int(msg.src),

@@ -208,11 +208,13 @@ class _WindowStore:
 
     def submit(self, fn) -> int:
         from bluefog_tpu import basics
+        from bluefog_tpu.utils import telemetry
         basics._require_active()  # suspended sessions reject new async work
         with self.lock:
             h = self.next_handle
             self.next_handle += 1
             self.handles[h] = self.pool.submit(fn)
+            telemetry.set_gauge("bf_win_inflight_handles", len(self.handles))
             return h
 
 
@@ -385,6 +387,9 @@ def _probe_missing_ranks(timeout: float = 1.0) -> List[int]:
         if not ok:
             missing.extend(r for r, owner in d.rank_owner.items()
                            if owner == p)
+    from bluefog_tpu.utils import telemetry
+    telemetry.inc("bf_win_peer_probes_total")
+    telemetry.set_gauge("bf_win_unreachable_peers", len(missing))
     return sorted(missing)
 
 
@@ -405,6 +410,10 @@ def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
         # from the payload size.
         payload = payload.astype(_BF16)
         op |= OP_BF16_FLAG
+    from bluefog_tpu.utils import telemetry
+    if telemetry.enabled():
+        telemetry.inc("bf_win_proc_tx_bytes_total", float(payload.nbytes),
+                      proc=proc)
     d.transport.send(host, port, op, name, src, dst, weight, payload,
                      p_weight)
 
@@ -465,11 +474,17 @@ def _remote_mutex(name: str, rank: int, my_rank: int):
         with d.cv:
             d.grant_events[(name, rank)] = granted
         try:
+            import time as _time
+            from bluefog_tpu.utils import telemetry
+            t0 = _time.monotonic()
             _send_to_rank_owner(rank, OP_MUTEX_ACQ, name, my_rank, rank, 0.0)
             if not granted.wait(timeout=_MSG_TIMEOUT_SEC):
                 raise ConnectionError(
                     f"win_mutex({name!r}): rank {rank}'s owner did not grant "
                     f"within {_MSG_TIMEOUT_SEC:.0f}s")
+            telemetry.inc("bf_win_mutex_acquisitions_total", kind="remote")
+            telemetry.inc("bf_win_mutex_wait_seconds_total",
+                          _time.monotonic() - t0, kind="remote")
             yield
         finally:
             _send_to_rank_owner(rank, OP_MUTEX_REL, name, my_rank, rank, 0.0)
@@ -550,6 +565,14 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
             d.parked.setdefault(name, []).append(
                 (orig_op, name, src, dst, weight, p_weight, payload))
             return
+    if op in (OP_PUT, OP_ACCUMULATE, OP_GET_REPLY):
+        # Applied (not parked) data payload: inbound bytes per peer process
+        # (counted here, after the park checks, so a parked message's
+        # replay is not double-counted).
+        from bluefog_tpu.utils import telemetry
+        if telemetry.enabled():
+            telemetry.inc("bf_win_proc_rx_bytes_total", float(len(payload)),
+                          proc=d.rank_owner.get(src, -1))
     if op in (OP_PUT, OP_ACCUMULATE):
         # Deliberately mutex-free: the drain thread must never block on a
         # rank mutex (a remote holder's REL would be queued behind us —
@@ -707,6 +730,23 @@ def get_current_created_window_names() -> List[str]:
 # One-sided ops
 # ---------------------------------------------------------------------------
 
+def _count_win_op(op: str, nbytes: float, edges) -> None:
+    """Dispatch-time counters for one one-sided op: calls, topology edges
+    it touches, and the element bytes it moves (puts/accumulates: the
+    caller payload; gets: one window row per pulled edge; updates: the
+    combined owned rows)."""
+    from bluefog_tpu.utils import telemetry
+    if not telemetry.enabled():
+        return
+    telemetry.inc("bf_win_ops_total", op=op)
+    telemetry.inc("bf_win_edges_total", float(len(edges)), op=op)
+    telemetry.inc("bf_win_bytes_total", float(nbytes), op=op)
+
+
+def _row_nbytes(win: _Window) -> int:
+    return int(np.prod(win.shape, dtype=np.int64)) * win.dtype.itemsize
+
+
 def _validate_edges(edges: Dict[tuple, float], nbrs_of: List[List[int]],
                     *, peer_is_src: bool, op: str) -> None:
     """Reject edges absent from the window's topology — a put/get naming a
@@ -858,6 +898,7 @@ def win_put_nonblocking(tensor, name: str, *, self_weight=None,
     edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0,
                                   ranks=win.owned)
     _validate_edges(edges, win.out_nbrs, peer_is_src=False, op="win_put")
+    _count_win_op("put", t.nbytes, edges)
     from bluefog_tpu.utils.timeline import op_span
 
     def _work():
@@ -890,6 +931,7 @@ def win_accumulate_nonblocking(tensor, name: str, *, self_weight=None,
                                   ranks=win.owned)
     _validate_edges(edges, win.out_nbrs, peer_is_src=False,
                     op="win_accumulate")
+    _count_win_op("accumulate", t.nbytes, edges)
     from bluefog_tpu.utils.timeline import op_span
 
     def _work():
@@ -969,6 +1011,7 @@ def win_get_nonblocking(name: str, *, src_weights=None,
     edges = _resolve_edge_weights(src_weights, win.in_nbrs, 1.0,
                                   peer_is_src=True, ranks=win.owned)
     _validate_edges(edges, win.in_nbrs, peer_is_src=True, op="win_get")
+    _count_win_op("get", len(edges) * _row_nbytes(win), edges)
     from bluefog_tpu.utils.timeline import op_span
 
     def _work():
@@ -1041,6 +1084,7 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
     and the pending counters account for it exactly)."""
     from bluefog_tpu.utils.timeline import op_span
     win = _store.get(name)
+    _count_win_op("update", len(win.owned) * _row_nbytes(win), {})
     owned = win.owned
     acquired = []
     if require_mutex:
@@ -1195,6 +1239,8 @@ def win_update_then_collect(name: str, *, require_mutex: bool = True):
     """Sum self memory with all received contributions and zero the staging
     buffers — the push-sum collect step (``torch/mpi_ops.py:1206-1260``)."""
     win = _store.get(name)
+    _count_win_op("update_then_collect",  # + the inner "update"
+                  len(win.owned) * _row_nbytes(win), {})
     # Owned edges only: collects of non-owned ranks run at their owners.
     all_edges = {(dst, src): 1.0
                  for dst in win.owned for src in win.in_nbrs[dst]}
@@ -1207,8 +1253,10 @@ def win_update_then_collect(name: str, *, require_mutex: bool = True):
 # ---------------------------------------------------------------------------
 
 def win_wait(handle: int) -> bool:
+    from bluefog_tpu.utils import telemetry
     with _store.lock:
         fut = _store.handles.pop(handle, None)
+        telemetry.set_gauge("bf_win_inflight_handles", len(_store.handles))
     if fut is None:
         return True
     from bluefog_tpu.utils import stall
@@ -1246,11 +1294,17 @@ def win_mutex(name: str, *, for_self: bool = False,
         if for_self:
             ranks = sorted(set(ranks + [basics.rank()]))
     my_rank = basics.rank()
+    import time as _time
     from contextlib import ExitStack
+    from bluefog_tpu.utils import telemetry
     with ExitStack() as stack:
         for r in sorted(set(ranks)):  # ascending everywhere: no lock cycles
             if _owns(r):
+                t0 = _time.monotonic()
                 win.mutexes[r].acquire()
+                telemetry.inc("bf_win_mutex_acquisitions_total", kind="local")
+                telemetry.inc("bf_win_mutex_wait_seconds_total",
+                              _time.monotonic() - t0, kind="local")
                 stack.callback(win.mutexes[r].release)
             else:
                 stack.enter_context(_remote_mutex(name, r, my_rank))

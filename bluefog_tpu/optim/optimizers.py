@@ -111,6 +111,7 @@ class DistributedOptimizer:
         self.compression = compression
         self.donate = donate
         self._jitted = {}
+        self._steps_seen = 0  # host-side counter for telemetry sampling
 
     # -- schedule resolution ------------------------------------------------
     def _schedules(self):
@@ -221,9 +222,41 @@ class DistributedOptimizer:
         params, grads = placed
         fn = self._step_callable(with_weights=w is not None)
         if w is None:
-            return basics._throttle(fn(params, grads, state))
-        return basics._throttle(
-            fn(params, grads, state, jnp.asarray(w, jnp.float32)))
+            out = basics._throttle(fn(params, grads, state))
+        else:
+            out = basics._throttle(
+                fn(params, grads, state, jnp.asarray(w, jnp.float32)))
+        self._steps_seen += 1
+        from bluefog_tpu.utils import telemetry
+        # costs_communication: this sampler adds a combine + host sync,
+        # so it only runs when the consensus period was explicitly set.
+        k = telemetry.consensus_every(costs_communication=True)
+        if k and self._steps_seen % k == 0:
+            _sample_consensus_distance(out[0])
+        return out
+
+
+def _sample_consensus_distance(params) -> None:
+    """Record the consensus-distance gauge: per rank,
+    ``||x_r - (W^T x)_r||_2`` over the flattened parameter tree, where
+    ``W^T x`` is the weighted neighborhood mean the ACTIVE topology's
+    gossip pulls toward — the per-step disagreement the scaling-efficiency
+    claim rests on.  Rides the eager ``neighbor_allreduce`` path (so it is
+    exact in multi-process runs) and costs one extra combine of the
+    parameters every K steps; mean/max over ranks land in
+    ``bf_consensus_distance`` / ``bf_consensus_distance_max``."""
+    from bluefog_tpu.utils import telemetry
+    n = basics.size()
+    leaves = [jnp.reshape(jnp.asarray(x), (n, -1)).astype(jnp.float32)
+              for x in jax.tree_util.tree_leaves(params)]
+    if not leaves:
+        return
+    flat = jnp.concatenate(leaves, axis=1)
+    mean = basics.neighbor_allreduce(flat)
+    dist = np.asarray(basics.to_numpy(
+        jnp.linalg.norm(flat - mean, axis=1)))
+    telemetry.record_consensus_distance(float(dist.mean()),
+                                        float(dist.max()))
 
 
 # ---------------------------------------------------------------------------

@@ -236,6 +236,31 @@ class _WindowOptimizerBase:
         new_params = jax.tree.map(lambda p, u: p + u, params, updates)
         return new_params, base_state
 
+    def _maybe_sample_consensus(self, t: int, payloads, combined) -> None:
+        """Consensus-distance gauge for the async family: every K steps
+        (``BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY``) record, per owned rank,
+        the L2 distance between the locally adapted parameters (``payloads``,
+        pre-combine) and the ``win_update`` result (``combined``, the
+        weighted neighborhood mean) — the same gossip-health signal the
+        collective family samples, read off the combine this step already
+        performed (zero extra communication)."""
+        from bluefog_tpu.utils import telemetry
+        k = telemetry.consensus_every()
+        if not k or (t + 1) % k:
+            return
+        sq = None
+        for pre, post in zip(payloads, combined):
+            diff = (np.asarray(pre, np.float32)
+                    - np.asarray(post, np.float32))
+            diff = diff.reshape(diff.shape[0], -1)
+            s = np.einsum("ij,ij->i", diff, diff)
+            sq = s if sq is None else sq + s
+        dist = np.sqrt(sq)
+        if self._layout == "rank" and W._store.distrib is not None:
+            dist = dist[self._owned]  # non-owned rows are zero-filled
+        telemetry.record_consensus_distance(float(dist.mean()),
+                                            float(dist.max()))
+
     def free(self):
         for name in self._names or []:
             W.win_free(name)
@@ -344,6 +369,7 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
                     W.win_wait(h)
             combined = [W.win_update(name, require_mutex=require_mutex)
                         for name in self._names]
+            self._maybe_sample_consensus(t, payloads, combined)
             new_params = self._rebuild(combined, params)
         return (self._merge_owned(params, new_params),
                 DistOptState(base_state, state.step + 1))
@@ -394,6 +420,7 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
                 W.win_wait(h)
             combined = [W.win_update(name, require_mutex=require_mutex)
                         for name in self._names]
+            self._maybe_sample_consensus(t, payloads, combined)
             new_params = self._rebuild(combined, params)
         return (self._merge_owned(params, new_params),
                 DistOptState(base_state, state.step + 1))
@@ -466,7 +493,8 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
                      and W._store.distrib is not None
                      and (t + 1) % self.auto_collect_rounds == 0)
         handles = []
-        for name, payload in zip(self._names, self._payloads(new_params)):
+        payloads = self._payloads(new_params)
+        for name, payload in zip(self._names, payloads):
             # win_accumulate applies self_weight AFTER the edge sends, so the
             # out-edges carry w * p_old and per-source mass
             # (self_share + sum_out w == 1) is conserved — the push-sum
@@ -481,6 +509,7 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         collected = [W.win_update_then_collect(name,
                                                require_mutex=require_mutex)
                      for name in self._names]
+        self._maybe_sample_consensus(t, payloads, collected)
         new_params = self._rebuild(collected, params)
         return (self._merge_owned(params, new_params),
                 DistOptState(base_state, state.step + 1))

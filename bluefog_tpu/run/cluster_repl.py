@@ -33,9 +33,57 @@ import time
 import traceback
 
 __all__ = ["main", "worker_main", "repl_main", "kernel_main", "Fleet",
-           "ClusterConsole"]
+           "ClusterConsole", "bfstat_text"]
 
 _ACK_TIMEOUT = float(os.environ.get("BLUEFOG_TPU_IBF_ACK_TIMEOUT", "600"))
+
+# ``%bfstat``: the one status "magic" both front-ends understand.  It is
+# rewritten into this plain-Python cell and shipped like any other — every
+# rank (front-end AND workers) prints its own gossip-health line, so a
+# wedged worker is visible from the notebook (reference ibfrun had no
+# equivalent; the closest is mpirun users ssh-ing around the fleet).
+_BFSTAT_SRC = ("from bluefog_tpu.run.cluster_repl import bfstat_text as "
+               "_bf_stat_fn; print(_bf_stat_fn(), flush=True)")
+
+
+def bfstat_text() -> str:
+    """One process's status block: identity, topology, windows, health and
+    the comm-telemetry snapshot (``utils/telemetry``)."""
+    import bluefog_tpu as bf
+    from bluefog_tpu.utils import telemetry
+    if not bf.initialized():
+        return "[bfstat] bluefog_tpu not initialized"
+    import jax
+    lines = [
+        f"[bfstat] proc {jax.process_index()}/{jax.process_count()}: "
+        f"ranks {bf.owned_ranks()} of {bf.size()}"
+        + (" (SUSPENDED)" if bf.suspended() else "")]
+    topo = bf.load_topology()
+    if topo is not None:
+        lines.append(f"[bfstat] topology: {topo.number_of_nodes()} nodes, "
+                     f"{topo.number_of_edges()} edges"
+                     + (" (weighted)" if bf.basics.is_topo_weighted()
+                        else ""))
+    health = telemetry.health()
+    port = telemetry.server_port()
+    windows = bf.get_current_created_window_names()
+    lines.append(
+        f"[bfstat] health: {health['status']}"
+        + (f"; overdue: {health['overdue_ops']}"
+           if health["overdue_ops"] else "")
+        + (f"; unreachable ranks: {health['unreachable_peer_ranks']}"
+           if health.get("unreachable_peer_ranks") else "")
+        + (f"; windows: {', '.join(windows)}" if windows else "")
+        + (f"; /metrics on :{port}" if port else ""))
+    snap = telemetry.snapshot()
+    if snap:
+        for k in sorted(snap):
+            lines.append(f"[bfstat]   {k} = {snap[k]:g}")
+    else:
+        lines.append("[bfstat]   (telemetry registry empty"
+                     + ("" if telemetry.enabled()
+                        else " — BLUEFOG_TPU_TELEMETRY=0") + ")")
+    return "\n".join(lines)
 
 
 def _gang_token() -> str:
@@ -273,6 +321,10 @@ class ClusterConsole(code.InteractiveConsole):
         return self._fleet._workers
 
     def runsource(self, source, filename="<input>", symbol="single"):
+        if source.strip() == "%bfstat":
+            # Status "magic": rewritten to a plain-Python cell so it runs
+            # SPMD like everything else — every rank prints its own block.
+            source = _BFSTAT_SRC
         try:
             compiled = self.compile(source, filename, symbol)
         except (OverflowError, SyntaxError, ValueError):
@@ -392,6 +444,17 @@ def kernel_main(ctrl: str, expect: int, conn_file: str) -> int:
         async def do_execute(self, code, silent, store_history=True,
                              user_expressions=None, allow_stdin=False,
                              **kwargs):
+            if code.strip() == "%bfstat":
+                # The one supported "magic": rewritten to plain Python and
+                # shipped SPMD, so every rank reports its gossip health.
+                code = _BFSTAT_SRC
+            # Normalize line endings BEFORE the guard comparison: CRLF
+            # cells from some Jupyter clients are plain Python that the
+            # transformer normalizes textually — without this they would
+            # be spuriously rejected as IPython-only syntax.  The
+            # normalized form is also what ships (workers' exec and the
+            # local run must see the same bytes).
+            code = code.replace("\r\n", "\n").replace("\r", "\n")
             # IPython-only syntax (magics, !shell, obj?) would execute in
             # THIS kernel but be a SyntaxError in the workers' plain
             # exec() — the kernel could then enter a collective the

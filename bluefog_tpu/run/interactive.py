@@ -159,9 +159,13 @@ def _cluster(args) -> int:
                 remote = R._launch_shell(tag, rank, run_cmd)
                 p = subprocess.Popen(rsh + [host, remote],
                                      stdin=subprocess.PIPE, text=True)
+                # Register BEFORE feeding the token: a dead rsh client
+                # (bad host, instant ssh failure) raises BrokenPipeError
+                # on the write, and the cleanup below must reach this
+                # child too.
+                entries.append((p, host, True))
                 p.stdin.write(token + "\n")
                 p.stdin.close()
-                entries.append((p, host, True))
         front = (["--kernel-file", args.kernel_file] if args.kernel_file
                  else ["--repl"])
         rc = subprocess.call(
@@ -172,6 +176,14 @@ def _cluster(args) -> int:
         print("ibfrun: interrupted; stopping the gang", file=sys.stderr)
         R._kill_gang(entries, rsh, tag)
         return 130
+    except OSError as e:
+        # A failing rsh client (e.g. BrokenPipeError writing the gang
+        # token) must not leak the already-launched workers: kill the
+        # gang, then surface the real error.
+        print(f"ibfrun: gang launch failed ({e}); stopping the gang",
+              file=sys.stderr)
+        R._kill_gang(entries, rsh, tag)
+        raise
     # REPL exit ends the session: workers exit on control-channel EOF.
     deadline = time.monotonic() + 15
     for p, _, _ in entries:

@@ -248,6 +248,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint)")
     p.add_argument("--timeline", default=None,
                    help="timeline file prefix (sets BLUEFOG_TIMELINE)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable the runtime telemetry registry in every "
+                        "rank (sets BLUEFOG_TPU_TELEMETRY=1 for the gang; "
+                        "read it back via bf.telemetry_snapshot() or pair "
+                        "with --telemetry-port for live /metrics)")
+    p.add_argument("--telemetry-port", type=int, default=None,
+                   metavar="BASE",
+                   help="serve /metrics + /healthz per rank: rank r binds "
+                        "port BASE + r (0 = ephemeral everywhere; implies "
+                        "--telemetry)")
     p.add_argument("--tag-output", action="store_true",
                    help="prefix every output line with [rank] (mpirun "
                         "--tag-output parity); also prevents ranks' lines "
@@ -269,6 +279,13 @@ def _child_env(args, coord: str, rank: int, local_rank: int = 0,
         virtual_mesh_env(env, args.devices_per_proc)
     if args.timeline:
         env["BLUEFOG_TIMELINE"] = args.timeline
+    if args.telemetry or args.telemetry_port is not None:
+        env["BLUEFOG_TPU_TELEMETRY"] = "1"
+    if args.telemetry_port is not None:
+        # Distinct port per rank (0 = ephemeral for every rank; the bound
+        # port is logged by the endpoint at init).
+        env["BLUEFOG_TPU_TELEMETRY_PORT"] = str(
+            args.telemetry_port + rank if args.telemetry_port else 0)
     return env
 
 

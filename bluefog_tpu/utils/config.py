@@ -16,6 +16,9 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_PORT          | 0     | DCN window-service port (0=ephemeral) |
 | BLUEFOG_TPU_WIN_MAX_PENDING   | 4096  | inbound window-message queue bound |
 | BLUEFOG_TPU_WIN_COMPRESSION   | none  | bf16: halve cross-host window payloads |
+| BLUEFOG_TPU_TELEMETRY         | 1     | 0: disable the metric registry entirely |
+| BLUEFOG_TPU_TELEMETRY_PORT    | unset | serve /metrics + /healthz (0=ephemeral) |
+| BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY | 10 | consensus-distance sample period (0=off) |
 | BFTPU_COORDINATOR             | unset | set by bfrun: coordinator host:port |
 | BFTPU_NUM_PROCESSES           | unset | set by bfrun |
 | BFTPU_PROCESS_ID              | unset | set by bfrun |
@@ -64,6 +67,13 @@ class Config:
     win_port: int
     win_max_pending: int
     win_compression: str
+    telemetry: bool
+    telemetry_port: Optional[int]
+    telemetry_consensus_every: int
+    # Whether the consensus period was explicitly configured: samplers
+    # that COST communication (the collective optimizer family) stay off
+    # unless the operator asked; free samplers use the default period.
+    telemetry_consensus_set: bool
 
     @staticmethod
     def from_env() -> "Config":
@@ -80,6 +90,14 @@ class Config:
                 os.environ.get("BLUEFOG_TPU_WIN_MAX_PENDING", "4096")),
             win_compression=_validated_compression(os.environ.get(
                 "BLUEFOG_TPU_WIN_COMPRESSION", "none").lower()),
+            telemetry=_flag("BLUEFOG_TPU_TELEMETRY", default=True),
+            telemetry_port=(
+                None if os.environ.get("BLUEFOG_TPU_TELEMETRY_PORT") is None
+                else int(os.environ["BLUEFOG_TPU_TELEMETRY_PORT"])),
+            telemetry_consensus_every=int(os.environ.get(
+                "BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY", "10")),
+            telemetry_consensus_set=(
+                "BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY" in os.environ),
         )
 
 

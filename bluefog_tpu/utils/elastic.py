@@ -153,17 +153,65 @@ def _owned_rows_of(dirs, n_rows: int):
     import numpy as np
     maps = []
     for d in dirs:
-        try:
-            with open(os.path.join(d, _OWNED_FILE)) as fh:
-                maps.append([int(r) for r in json.load(fh)])
-        except (OSError, ValueError):
+        # A map invalidated by a shrink resume lives on as .stale — its
+        # content is exactly the old-geometry ownership a stitch of that
+        # geometry's rows needs, so reading it keeps cross-geometry
+        # resumes (and any process racing the invalidation) correct.
+        for fname in (_OWNED_FILE, _OWNED_FILE + ".stale"):
+            try:
+                with open(os.path.join(d, fname)) as fh:
+                    maps.append([int(r) for r in json.load(fh)])
+                break
+            except (OSError, ValueError):
+                continue
+        else:
             maps.append(None)
     if all(m is not None for m in maps):
         flat = sorted(r for m in maps for r in m)
         if flat == list(range(n_rows)):
             return maps
+    if any(m is not None for m in maps):
+        # Some maps existed but the set does not partition range(n): the
+        # silent even-block fallback is wrong for non-uniform placements,
+        # so say so (missing maps land here too, not only the all-present
+        # case).
+        get_logger().warning(
+            "elastic: persisted owned_ranks.json maps %s do not partition "
+            "range(%d) (stale or missing maps from a previous world "
+            "size?); falling back to even-block row attribution — WRONG "
+            "for non-uniform host placements",
+            [m if m is not None else "<missing>" for m in maps], n_rows)
     return [rows.tolist()
             for rows in np.array_split(np.arange(n_rows), len(dirs))]
+
+
+def _invalidate_stale_owned_ranks(base: str, nproc: int) -> None:
+    """Shrink-resume hygiene: proc dirs beyond the NEW process count keep
+    the old geometry's ``owned_ranks.json``; once the surviving dirs are
+    rewritten for the new geometry, the combined maps would no longer
+    partition ``range(n)`` and ``_owned_rows_of`` would silently fall back
+    to even blocks on the next world-size resume.  Rename the stale files
+    aside (kept as ``.stale`` for forensics) and warn."""
+    stale = []
+    for d in _proc_dirs(base):
+        try:
+            idx = int(os.path.basename(d)[4:])
+        except ValueError:
+            continue
+        f = os.path.join(d, _OWNED_FILE)
+        if idx >= nproc and os.path.exists(f):
+            try:
+                os.replace(f, f + ".stale")
+            except OSError:
+                continue
+            stale.append(os.path.basename(d))
+    if stale:
+        get_logger().warning(
+            "elastic: world size shrank to %d processes; invalidated the "
+            "stale owned_ranks.json in %s (their ownership maps described "
+            "the previous geometry and would have silently degraded future "
+            "world-size resumes to even-block row attribution)",
+            nproc, ", ".join(stale))
 
 
 def _stitch(base: str, step: int):
@@ -426,7 +474,11 @@ def run_elastic(step_fn: Callable[[Any, int], Any], state: Any, *,
     if jax.process_count() > 1 and per_process and not sharded:
         # The resume decision is made; NOW record this geometry's ownership
         # for future world-size resumes (non-uniform placements attribute
-        # rows to the wrong process without it).
+        # rows to the wrong process without it).  Process 0 also retires
+        # ownership maps in directories beyond the new process count (a
+        # shrink leaves them describing the old geometry).
+        if jax.process_index() == 0:
+            _invalidate_stale_owned_ranks(base_dir, jax.process_count())
         _write_owned_ranks(ckpt_dir)
     if start >= num_steps:
         return state

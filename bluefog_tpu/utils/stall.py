@@ -78,6 +78,8 @@ class StallMonitor:
                 if overdue > threshold * (warned + 1):
                     if peers is None:
                         peers = self._probe_peers()
+                    from bluefog_tpu.utils import telemetry
+                    telemetry.inc("bf_stall_warnings_total", op=name)
                     get_logger().warning(
                         "One or more operations appear stalled: %r has been "
                         "waiting %.0f s (threshold %.0f s). A missing peer "
@@ -118,6 +120,19 @@ class StallMonitor:
             return
         with self._lock:
             self._outstanding.pop(key, None)
+
+    def overdue_ops(self) -> List[tuple]:
+        """``[(name, waited_sec)]`` for outstanding waits past the
+        threshold — the stall-monitor view ``/healthz`` reflects (the
+        counter records history; this is the live state)."""
+        threshold = config.get().stall_warning_sec
+        if threshold <= 0 or self._paused:
+            return []
+        now = time.monotonic()
+        with self._lock:
+            return [(name, now - start)
+                    for name, start, _ in self._outstanding.values()
+                    if now - start > threshold]
 
     def pause(self) -> None:
         """Silence stall warnings while the session is suspended (an

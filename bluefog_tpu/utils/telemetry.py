@@ -1,0 +1,516 @@
+"""Runtime telemetry: process-local counters/gauges + /metrics endpoint.
+
+The reference has no in-framework comm observability (its users wrap ops in
+hand-rolled timers); HiCCL/TACCL-style tuning of hierarchical collectives
+presupposes per-op measurement — this module is the single registry every
+comm entry point reports into:
+
+  * ``ops/collective.py`` via ``basics`` dispatch: calls, element-bytes,
+    schedule rounds/edges and estimated wire bytes per op family.
+  * ``ops/window.py`` / ``ops/transport.py``: win_put/get/accumulate counts,
+    payload bytes in/out per peer process, in-flight handles, drain-burst
+    queue depth, mutex waits, probe-detected unreachable peers.
+  * ``basics.py``: dispatch-cache hits/misses, throttle waits.
+  * ``utils/stall.py``: stall warnings as counters labeled by op name.
+  * the optimizer families: the consensus-distance gauge (L2 distance of
+    each rank's parameters from its neighborhood mean) — the single most
+    decision-relevant gossip-health signal.
+
+Design constraints:
+  * Near-zero overhead when disabled (``BLUEFOG_TPU_TELEMETRY=0``): every
+    mutator checks the config flag first and touches NOTHING else — no
+    registry mutation, no key rendering, no allocation beyond the call
+    frame itself (guarded by ``tests/test_telemetry.py``).
+  * Counters are MONOTONIC (``*_total`` names), gauges are last-value; keys
+    are ``(name, ((label, value), ...))`` tuples internally and rendered to
+    Prometheus text form (``name{label="value"} v``) only at snapshot time.
+  * The registry is process-local.  :func:`aggregate_snapshot` merges every
+    process's view by riding the existing collective path (``bf.allgather``
+    of fixed-width JSON rows), the same transport ``metric_average`` uses —
+    no side-channel socket mesh.
+
+Endpoint: ``BLUEFOG_TPU_TELEMETRY_PORT`` (or :func:`start_http_server`)
+serves ``/metrics`` (Prometheus text) and ``/healthz`` (JSON: stall-monitor
+overdue ops + peer-probe reachability) on a daemon thread.  Multi-process
+gangs give each rank its own port (``bfrun --telemetry-port BASE`` maps
+rank ``r`` to ``BASE + r``; 0 = ephemeral everywhere).
+
+Timeline: :func:`emit_timeline_counters` writes chrome-tracing counter
+events (``"ph": "C"``) through the live timeline writer, so counter series
+render alongside the existing op spans in ``chrome://tracing``.  Snapshot
+and scrape both call it automatically when a timeline is active.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from bluefog_tpu.utils import config
+
+__all__ = [
+    "enabled",
+    "inc",
+    "set_gauge",
+    "snapshot",
+    "telemetry_snapshot",
+    "aggregate_snapshot",
+    "record_comm_traffic",
+    "render_prometheus",
+    "reset",
+    "start_http_server",
+    "stop_http_server",
+    "server_port",
+    "maybe_start_endpoint",
+    "emit_timeline_counters",
+    "health",
+]
+
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+class _Registry:
+    """Process-local metric store.  One lock, two dicts — mutation is a
+    guarded dict add under the GIL-scale lock; the hot comm paths already
+    pay a python dispatch, so this is noise next to them."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counters: Dict[_Key, float] = {}
+        self.gauges: Dict[_Key, float] = {}
+
+
+_registry = _Registry()
+
+
+def enabled() -> bool:
+    """True when the registry records (``BLUEFOG_TPU_TELEMETRY``, default
+    on — counters are dict increments on already-python paths; the
+    endpoint stays opt-in separately)."""
+    return config.get().telemetry
+
+
+def _key(name: str, labels: dict) -> _Key:
+    if not labels:
+        return (name, ())
+    return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+def inc(name: str, value: float = 1.0, **labels) -> None:
+    """Add ``value`` to a monotonic counter (no-op when disabled)."""
+    if not config.get().telemetry:
+        return
+    key = _key(name, labels)
+    with _registry.lock:
+        _registry.counters[key] = _registry.counters.get(key, 0.0) + value
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    """Record the last value of a gauge (no-op when disabled)."""
+    if not config.get().telemetry:
+        return
+    key = _key(name, labels)
+    with _registry.lock:
+        _registry.gauges[key] = float(value)
+
+
+def reset() -> None:
+    """Drop every series (tests; a production registry is append-only)."""
+    with _registry.lock:
+        _registry.counters.clear()
+        _registry.gauges.clear()
+
+
+def _render_key(key: _Key) -> str:
+    name, labels = key
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+def snapshot() -> Dict[str, float]:
+    """Flat ``{rendered_series: value}`` dict of the process-local registry
+    (counters and gauges together; counter names end in ``_total``)."""
+    with _registry.lock:
+        out = {_render_key(k): v for k, v in _registry.counters.items()}
+        out.update({_render_key(k): v for k, v in _registry.gauges.items()})
+    emit_timeline_counters()
+    return out
+
+
+def _raw_series() -> Tuple[Dict[_Key, float], Dict[_Key, float]]:
+    with _registry.lock:
+        return dict(_registry.counters), dict(_registry.gauges)
+
+
+# ---------------------------------------------------------------------------
+# Cross-rank aggregation (rides the collective path, like metric_average)
+# ---------------------------------------------------------------------------
+
+def aggregate_snapshot() -> Dict[str, float]:
+    """Cluster-wide snapshot: counters SUMMED and gauges MAXed across every
+    process's registry.
+
+    COLLECTIVE in multi-process runs — every process must call it together
+    (it rides ``bf.allgather`` exactly like ``metric_average`` rides
+    ``bf.allreduce``: one fixed-width JSON row per rank, processes
+    deduplicated by embedded process id).  Single-process runs (where all
+    ranks live in one registry) return the local snapshot directly.
+    """
+    import jax
+
+    from bluefog_tpu import basics
+    if not basics.initialized() or jax.process_count() == 1:
+        return snapshot()
+    import numpy as np
+    counters, gauges = _raw_series()
+    blob = json.dumps({
+        "proc": jax.process_index(),
+        "c": [[k[0], list(k[1]), v] for k, v in counters.items()],
+        "g": [[k[0], list(k[1]), v] for k, v in gauges.items()],
+    }).encode()
+    n = basics.size()
+    # Agree on the row width first (one tiny allgather): registries differ
+    # per process, so the fixed-width payload gather must fit the largest
+    # blob.
+    lens = np.zeros((n, 1), np.float32)
+    for r in basics.owned_ranks():
+        lens[r] = len(blob)
+    width = int(np.asarray(basics.to_numpy(basics.allgather(lens))).max())
+    rows = np.zeros((n, width), np.uint8)
+    for r in basics.owned_ranks():
+        rows[r, :len(blob)] = np.frombuffer(blob, np.uint8)
+    # allgather concatenates along the leading axis: every rank's row of
+    # the output is all ranks' blobs back to back.
+    gathered = np.asarray(basics.to_numpy(
+        basics.allgather(rows)))[0].reshape(n, width)
+    agg_c: Dict[_Key, float] = {}
+    agg_g: Dict[_Key, float] = {}
+    seen_procs = set()
+    for r in range(n):
+        raw = bytes(gathered[r]).rstrip(b"\0")
+        if not raw:
+            continue
+        rec = json.loads(raw.decode())
+        if rec["proc"] in seen_procs:  # one registry per process, not rank
+            continue
+        seen_procs.add(rec["proc"])
+        for name, labels, v in rec["c"]:
+            k = (name, tuple((a, b) for a, b in labels))
+            agg_c[k] = agg_c.get(k, 0.0) + v
+        for name, labels, v in rec["g"]:
+            k = (name, tuple((a, b) for a, b in labels))
+            agg_g[k] = max(agg_g.get(k, float("-inf")), v)
+    out = {_render_key(k): v for k, v in agg_c.items()}
+    out.update({_render_key(k): v for k, v in agg_g.items()})
+    return out
+
+
+def telemetry_snapshot(aggregate: bool = False) -> Dict[str, float]:
+    """The ``bf.telemetry_snapshot()`` surface: the process-local registry
+    as a flat dict, or (``aggregate=True``) the cluster-wide merge via the
+    collective path (collective in multi-process runs — see
+    :func:`aggregate_snapshot`)."""
+    return aggregate_snapshot() if aggregate else snapshot()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exporter
+# ---------------------------------------------------------------------------
+
+def _fmt_value(v: float) -> str:
+    """Prometheus value rendering, total: NaN/±Inf spellings per the text
+    exposition format (a diverging run CAN land nan in a gauge — the
+    scrape must keep working)."""
+    import math
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return str(int(v)) if v == int(v) else repr(v)
+
+
+def render_prometheus() -> str:
+    """The process-local registry in Prometheus text exposition format
+    (``# TYPE`` per family; ``*_total`` series are counters)."""
+    counters, gauges = _raw_series()
+    lines: List[str] = []
+    for store, mtype in ((counters, "counter"), (gauges, "gauge")):
+        families: Dict[str, list] = {}
+        for key, v in sorted(store.items()):
+            families.setdefault(key[0], []).append((key, v))
+        for name, series in families.items():
+            lines.append(f"# TYPE {name} {mtype}")
+            for key, v in series:
+                lines.append(f"{_render_key(key)} {_fmt_value(v)}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Health (stall monitor + peer probe)
+# ---------------------------------------------------------------------------
+
+def health() -> dict:
+    """Liveness summary for ``/healthz``: overdue blocking waits from the
+    stall monitor and the window transport's unreachable-peer probe."""
+    from bluefog_tpu.utils import stall
+    overdue = stall._monitor.overdue_ops()
+    body = {
+        "status": "ok",
+        "overdue_ops": [{"op": name, "waited_sec": round(sec, 1)}
+                        for name, sec in overdue],
+        "stall_threshold_sec": config.get().stall_warning_sec,
+    }
+    probe = stall._peer_probe
+    if probe is not None:
+        try:
+            missing = probe()
+        except Exception:  # noqa: BLE001 — a probe crash is itself a signal
+            missing = None
+        if missing is None:
+            body["unreachable_peer_ranks"] = None
+            body["status"] = "degraded"
+        else:
+            body["unreachable_peer_ranks"] = missing
+            if missing:
+                body["status"] = "degraded"
+    if overdue:
+        body["status"] = "stalled"
+    return body
+
+
+# ---------------------------------------------------------------------------
+# Timeline integration (chrome-tracing counter events)
+# ---------------------------------------------------------------------------
+
+def emit_timeline_counters() -> None:
+    """Write every counter/gauge as a chrome-tracing counter event
+    (``"ph": "C"``) through the live timeline writer, so the series render
+    as stacked counter tracks alongside the op spans.  No-op without an
+    active timeline (and on the native writer, whose wire format carries
+    no ``args`` payload)."""
+    from bluefog_tpu.utils import timeline
+    if not timeline.counter_events_supported():
+        return
+    counters, gauges = _raw_series()
+    for key, v in list(counters.items()) + list(gauges.items()):
+        timeline.counter_event(_render_key(key), v)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint (/metrics + /healthz)
+# ---------------------------------------------------------------------------
+
+_server = None
+_server_lock = threading.Lock()
+
+
+def _make_handler():
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            path = self.path.split("?", 1)[0]
+            try:
+                if path == "/metrics":
+                    emit_timeline_counters()
+                    self._reply(200, render_prometheus().encode(),
+                                "text/plain; version=0.0.4")
+                elif path == "/healthz":
+                    body = health()
+                    code = 200 if body["status"] == "ok" else 503
+                    self._reply(code, json.dumps(body).encode(),
+                                "application/json")
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+            except BrokenPipeError:
+                pass  # scraper went away mid-reply
+            except Exception as e:  # noqa: BLE001 — a bad series must not
+                try:                # kill the handler thread silently
+                    self._reply(500, f"error: {e}\n".encode(), "text/plain")
+                except OSError:
+                    pass
+
+        def log_message(self, *args):  # scrapes must not spam stderr
+            pass
+
+    return Handler
+
+
+def start_http_server(port: int = 0, host: Optional[str] = None) -> int:
+    """Start the /metrics + /healthz endpoint on a daemon thread; returns
+    the bound port (``port=0`` picks an ephemeral one).  Idempotent — a
+    second call returns the live server's port.
+
+    Binds LOOPBACK by default (same convention as the cluster REPL's ctrl
+    socket: never expose a new service on every interface silently) —
+    off-host Prometheus scraping opts in via
+    ``BLUEFOG_TPU_TELEMETRY_HOST=0.0.0.0`` (or a specific interface)."""
+    global _server
+    import os
+    from http.server import ThreadingHTTPServer
+    if host is None:
+        host = os.environ.get("BLUEFOG_TPU_TELEMETRY_HOST", "127.0.0.1")
+    with _server_lock:
+        if _server is not None:
+            return _server.server_address[1]
+        srv = ThreadingHTTPServer((host, int(port)), _make_handler())
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True,
+                             name="bf-telemetry-http")
+        t.start()
+        _server = srv
+        return srv.server_address[1]
+
+
+def stop_http_server() -> None:
+    global _server
+    with _server_lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+
+
+def server_port() -> Optional[int]:
+    with _server_lock:
+        return None if _server is None else _server.server_address[1]
+
+
+def maybe_start_endpoint() -> Optional[int]:
+    """Start the endpoint iff ``BLUEFOG_TPU_TELEMETRY_PORT`` is set (called
+    from ``bf.init``); returns the bound port or None.  A failed bind is
+    logged, never fatal — observability must not take the job down."""
+    port = config.get().telemetry_port
+    if port is None:
+        return None
+    try:
+        bound = start_http_server(port)
+    except OSError as e:
+        from bluefog_tpu.utils.logging import get_logger
+        get_logger().warning(
+            "telemetry endpoint could not bind port %s (%s); /metrics "
+            "disabled for this process", port, e)
+        return None
+    from bluefog_tpu.utils.logging import get_logger
+    get_logger().info("telemetry endpoint serving /metrics and /healthz "
+                      "on port %d", bound)
+    return bound
+
+
+# ---------------------------------------------------------------------------
+# Shared comm accounting
+# ---------------------------------------------------------------------------
+
+def record_comm_traffic(op: str, nbytes: float, *, size: int,
+                        sched_stats=None, calls: float = 1.0) -> None:
+    """The one accounting formula for collective traffic: calls, element
+    bytes, and — given ``sched_stats = (rounds, edges)`` from
+    ``collective.schedule_wire_stats`` — rounds/edges/estimated wire bytes
+    (one ``nbytes / size`` per-rank row per directed edge).  Used by the
+    dispatch layer (``basics._record_dispatch``) per call and by
+    ``bench.py`` to account a whole jitted run at once, so the two can
+    never drift apart."""
+    if not config.get().telemetry:
+        return
+    inc("bf_comm_calls_total", calls, op=op)
+    inc("bf_comm_bytes_total", float(nbytes) * calls, op=op)
+    if sched_stats is not None:
+        rounds, edges = sched_stats
+        inc("bf_comm_rounds_total", rounds * calls, op=op)
+        inc("bf_comm_edges_total", edges * calls, op=op)
+        set_gauge("bf_comm_peers", edges, op=op)
+        inc("bf_comm_wire_bytes_total",
+            float(nbytes) / max(size, 1) * edges * calls, op=op)
+
+
+# ---------------------------------------------------------------------------
+# Consensus-distance gauge (gossip health)
+# ---------------------------------------------------------------------------
+
+def record_consensus_distance(mean_dist: float, max_dist: float) -> None:
+    """Record one consensus-distance sample: mean/max over this process's
+    ranks of ``||x_r - neighborhood_mean_r||_2``.  Called by the optimizer
+    families every ``BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY`` steps."""
+    set_gauge("bf_consensus_distance", mean_dist)
+    set_gauge("bf_consensus_distance_max", max_dist)
+    inc("bf_consensus_samples_total")
+
+
+def consensus_every(*, costs_communication: bool = False) -> int:
+    """Sampling period K for the consensus-distance gauge (0 = off, and
+    always off when telemetry is disabled).
+
+    ``costs_communication=True`` marks samplers that pay for the gauge
+    with an EXTRA collective (the collective optimizer family runs one
+    more full-parameter combine plus a host sync per sample): those stay
+    off unless ``BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY`` was explicitly
+    set, so default telemetry never changes a training loop's
+    communication volume.  Free samplers (the window family reads the
+    combine it already performed) use the default period."""
+    cfg = config.get()
+    if not cfg.telemetry:
+        return 0
+    if costs_communication and not cfg.telemetry_consensus_set:
+        return 0
+    return cfg.telemetry_consensus_every
+
+
+# ---------------------------------------------------------------------------
+# Smoke entry point (`make telemetry-smoke`)
+# ---------------------------------------------------------------------------
+
+def _smoke() -> int:
+    """Start the endpoint, drive one comm op, scrape /metrics and /healthz,
+    assert the core series exist.  Exit 0 on success.
+
+    Every telemetry call goes through the canonically-imported module
+    (under ``python -m`` THIS file is the separate ``__main__`` module
+    with its own empty registry — the instrumented ops report to the
+    imported one)."""
+    import os
+    import urllib.request
+    os.environ.setdefault("BLUEFOG_TPU_TELEMETRY", "1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import bluefog_tpu as bf
+    from bluefog_tpu.utils import config as _config
+    from bluefog_tpu.utils import telemetry as T
+    _config.reload()
+    bf.init()
+    n = bf.size()
+    x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    bf.neighbor_allreduce(x)
+    bf.allreduce(x)
+    port = T.start_http_server(0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+        hz = json.loads(r.read().decode())
+    for series in ("bf_comm_calls_total", "bf_comm_bytes_total",
+                   "bf_comm_rounds_total"):
+        assert series in text, f"missing core series {series} in /metrics"
+    assert 'op="neighbor_allreduce"' in text, "missing per-op labels"
+    assert hz["status"] == "ok", f"healthz not ok: {hz}"
+    T.stop_http_server()
+    print("telemetry smoke OK: port", port, "served",
+          len(text.splitlines()), "metric lines; healthz", hz["status"])
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_smoke())

@@ -33,6 +33,8 @@ __all__ = [
     "start_timeline",
     "stop_timeline",
     "flush",
+    "counter_event",
+    "counter_events_supported",
 ]
 
 _TRACE_EVENT_SENTINEL = None
@@ -217,6 +219,27 @@ def timeline_context(tensor_name: str, activity_name: str = "USER"):
         yield
     finally:
         timeline_end_activity(tensor_name, activity_name)
+
+
+def counter_events_supported() -> bool:
+    """True when a timeline writer that can carry counter events is live.
+    The native SPSC writer's wire format has no ``args`` payload, so
+    counter events ride the Python writer only — no autostart probe here
+    (telemetry polls this on every snapshot; it must stay one check)."""
+    return _writer is not None and hasattr(_writer, "q")
+
+
+def counter_event(name: str, value: float, cat: str = "telemetry") -> None:
+    """Emit one chrome-tracing COUNTER event (``"ph": "C"``): the series
+    renders as a stacked counter track alongside the op spans.  Telemetry
+    (``utils/telemetry.py``) emits every registry series through this on
+    snapshot/scrape."""
+    w = _writer
+    if w is None or not hasattr(w, "q"):
+        return
+    w.emit({"name": name, "cat": cat, "ph": "C",
+            "ts": time.monotonic_ns() // 1000, "pid": os.getpid(),
+            "tid": 0, "args": {"value": float(value)}})
 
 
 @contextmanager

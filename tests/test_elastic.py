@@ -555,3 +555,63 @@ def test_world_size_elastic_resume_under_bfrun(tmp_path):
     assert second.returncode == 0, \
         f"stdout={second.stdout}\nstderr={second.stderr[-4000:]}"
     assert second.stdout.count("WS-ELASTIC-OK") == 2, second.stdout
+
+
+def test_invalidate_stale_owned_ranks(tmp_path, caplog):
+    """Shrink-resume hygiene: ownership maps in proc dirs beyond the new
+    process count are renamed aside (with a warning), so a later stitch's
+    partition check cannot silently fall back to even blocks."""
+    import json
+    import logging
+
+    from bluefog_tpu.utils import elastic
+    from bluefog_tpu.utils.logging import get_logger
+    base = str(tmp_path)
+    for i, rows in enumerate(([0, 1], [2, 3], [4, 5], [6, 7])):
+        d = os.path.join(base, f"proc{i}")
+        os.makedirs(d)
+        with open(os.path.join(d, elastic._OWNED_FILE), "w") as fh:
+            json.dump(rows, fh)
+    log = get_logger()
+    log.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.WARNING, logger="bluefog_tpu"):
+            elastic._invalidate_stale_owned_ranks(base, 2)
+    finally:
+        log.removeHandler(caplog.handler)
+    for i in (0, 1):  # surviving dirs keep their maps
+        assert os.path.exists(
+            os.path.join(base, f"proc{i}", elastic._OWNED_FILE))
+    for i in (2, 3):  # stale dirs: renamed aside, not deleted
+        assert not os.path.exists(
+            os.path.join(base, f"proc{i}", elastic._OWNED_FILE))
+        assert os.path.exists(
+            os.path.join(base, f"proc{i}", elastic._OWNED_FILE + ".stale"))
+    assert any("invalidated the stale owned_ranks.json" in r.message
+               for r in caplog.records)
+
+
+def test_owned_rows_fallback_warns_on_broken_partition(tmp_path, caplog):
+    """Maps that no longer partition range(n) must warn before degrading
+    to even blocks (the silent wrong-owner attribution ADVICE flagged)."""
+    import json
+    import logging
+
+    from bluefog_tpu.utils import elastic
+    from bluefog_tpu.utils.logging import get_logger
+    dirs = []
+    for i, rows in enumerate(([0, 1, 2], [2, 3])):  # overlap: not a partition
+        d = os.path.join(str(tmp_path), f"proc{i}")
+        os.makedirs(d)
+        with open(os.path.join(d, elastic._OWNED_FILE), "w") as fh:
+            json.dump(rows, fh)
+        dirs.append(d)
+    log = get_logger()
+    log.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.WARNING, logger="bluefog_tpu"):
+            maps = elastic._owned_rows_of(dirs, 4)
+    finally:
+        log.removeHandler(caplog.handler)
+    assert maps == [[0, 1], [2, 3]]  # even-block fallback
+    assert any("do not partition" in r.message for r in caplog.records)

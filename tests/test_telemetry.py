@@ -1,0 +1,538 @@
+"""Telemetry subsystem tests: counter correctness per instrumented op
+family (exact byte/call counts against the compiled schedule), the
+disabled path (no registry mutation), the /metrics + /healthz endpoint,
+cross-rank aggregation, and the consensus-distance gauge against a
+hand-computed neighborhood mean."""
+
+import json
+import math
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as topo
+from bluefog_tpu.ops import collective as C
+from bluefog_tpu.ops import schedule as S
+from bluefog_tpu.ops import window as W
+from bluefog_tpu.utils import config, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.stop_http_server()
+
+
+def _init(n=8):
+    bf.init(devices=jax.devices()[:n])
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Counter correctness per op family
+# ---------------------------------------------------------------------------
+
+def test_collective_counters_exact():
+    """Known schedule → exact call/byte/round/edge counts.  Exp2 over 8
+    ranks: 3 shift-distance rounds, 8 edges each = 24 directed edges."""
+    n = _init()
+    x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)  # 128 bytes
+    bf.neighbor_allreduce(x)
+    bf.neighbor_allreduce(x)
+    bf.allreduce(x)
+    bf.allgather(x)
+    snap = bf.telemetry_snapshot()
+    assert snap['bf_comm_calls_total{op="neighbor_allreduce"}'] == 2
+    assert snap['bf_comm_bytes_total{op="neighbor_allreduce"}'] == 2 * 128
+    assert snap['bf_comm_rounds_total{op="neighbor_allreduce"}'] == 2 * 3
+    assert snap['bf_comm_edges_total{op="neighbor_allreduce"}'] == 2 * 24
+    # wire estimate: one 16-byte per-rank row per directed edge
+    assert snap['bf_comm_wire_bytes_total{op="neighbor_allreduce"}'] \
+        == 2 * 24 * 16
+    assert snap['bf_comm_peers{op="neighbor_allreduce"}'] == 24
+    assert snap['bf_comm_calls_total{op="allreduce"}'] == 1
+    assert snap['bf_comm_calls_total{op="allgather"}'] == 1
+    assert snap['bf_comm_bytes_total{op="allgather"}'] == 128
+
+
+def test_dynamic_schedule_counts_per_call_average():
+    """A dynamic schedule runs ONE phase per call; rounds/edges counters
+    record the per-call average over the period (exact for the uniform
+    one-peer walk: 1 round, n edges per phase)."""
+    n = _init()
+    x = np.zeros((n, 2), np.float32)
+    bf.dynamic_neighbor_allreduce(x, step=0)
+    snap = bf.telemetry_snapshot()
+    assert snap['bf_comm_calls_total{op="dynamic_neighbor_allreduce"}'] == 1
+    assert snap['bf_comm_rounds_total{op="dynamic_neighbor_allreduce"}'] == 1
+    assert snap['bf_comm_edges_total{op="dynamic_neighbor_allreduce"}'] == n
+
+
+def test_schedule_wire_stats_shapes():
+    g = topo.ExponentialTwoGraph(8)
+    sched = S.compile_static(g)
+    rounds, edges = C.schedule_wire_stats(sched)
+    assert rounds == 3 and edges == 24
+    dyn = S.compile_dynamic(topo.one_peer_exp2_phases(8), 8)
+    rounds, edges = C.schedule_wire_stats(dyn)
+    assert rounds == 1 and edges == 8
+    pg = S.compile_pair_gossip([1, 0, 3, 2, 5, 4, 7, 6], 8)
+    rounds, edges = C.schedule_wire_stats(pg)
+    assert rounds == 1 and edges == 8
+
+
+def test_pair_gossip_and_hierarchical_counters():
+    n = _init()
+    x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+    bf.pair_gossip(x, [1, 0, 3, 2, 5, 4, 7, 6])
+    snap = bf.telemetry_snapshot()
+    assert snap['bf_comm_calls_total{op="pair_gossip"}'] == 1
+    assert snap['bf_comm_edges_total{op="pair_gossip"}'] == n
+
+
+def test_window_op_counters():
+    n = _init()
+    x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)  # 96 bytes
+    bf.win_create(x, "tele_w")
+    try:
+        bf.win_put(x, "tele_w")
+        bf.win_accumulate(x, "tele_w")
+        bf.win_get("tele_w")
+        bf.win_update("tele_w")
+        snap = bf.telemetry_snapshot()
+        assert snap['bf_win_ops_total{op="put"}'] == 1
+        assert snap['bf_win_ops_total{op="accumulate"}'] == 1
+        assert snap['bf_win_ops_total{op="get"}'] == 1
+        # the explicit update + the ones inside put/acc/get waits: exactly 1
+        # explicit win_update here
+        assert snap['bf_win_ops_total{op="update"}'] == 1
+        assert snap['bf_win_bytes_total{op="put"}'] == 96
+        assert snap['bf_win_bytes_total{op="accumulate"}'] == 96
+        # get pulls one 12-byte row per in-edge (24 edges), update combines
+        # the 8 owned 12-byte rows
+        assert snap['bf_win_bytes_total{op="get"}'] == 24 * 12
+        assert snap['bf_win_bytes_total{op="update"}'] == 8 * 12
+        # every rank's out-edges: Exp2 over 8 ranks = 24 directed edges
+        assert snap['bf_win_edges_total{op="put"}'] == 24
+        assert 'bf_win_inflight_handles' in snap
+    finally:
+        bf.win_free("tele_w")
+
+
+def test_win_update_then_collect_counts_both():
+    n = _init()
+    x = np.zeros((n, 2), np.float32)
+    bf.win_create(x, "tele_c", zero_init=True)
+    try:
+        bf.win_update_then_collect("tele_c")
+        snap = bf.telemetry_snapshot()
+        assert snap['bf_win_ops_total{op="update_then_collect"}'] == 1
+        assert snap['bf_win_ops_total{op="update"}'] == 1  # the inner one
+    finally:
+        bf.win_free("tele_c")
+
+
+def test_win_mutex_counts_local_acquisitions():
+    n = _init()
+    x = np.zeros((n, 2), np.float32)
+    bf.win_create(x, "tele_m")
+    try:
+        with bf.win_mutex("tele_m", ranks=[0, 1]):
+            pass
+        snap = bf.telemetry_snapshot()
+        assert snap['bf_win_mutex_acquisitions_total{kind="local"}'] == 2
+        assert snap['bf_win_mutex_wait_seconds_total{kind="local"}'] >= 0
+    finally:
+        bf.win_free("tele_m")
+
+
+def test_dispatch_cache_hit_miss_counters():
+    n = _init()
+    x = np.zeros((n, 2), np.float32)
+    bf.allreduce(x)   # miss (fresh context)
+    bf.allreduce(x)   # hit
+    bf.allreduce(x)   # hit
+    snap = bf.telemetry_snapshot()
+    assert snap["bf_dispatch_cache_misses_total"] == 1
+    assert snap["bf_dispatch_cache_hits_total"] == 2
+
+
+def test_stall_warning_becomes_counter(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_STALL_WARNING_SEC", "0.3")
+    config.reload()
+    from bluefog_tpu.utils import stall
+    try:
+        with stall.watch("tele-stall-op"):
+            time.sleep(1.2)
+        snap = telemetry.snapshot()
+        assert snap.get('bf_stall_warnings_total{op="tele-stall-op"}', 0) >= 1
+    finally:
+        monkeypatch.delenv("BLUEFOG_TPU_STALL_WARNING_SEC")
+        config.reload()
+
+
+# ---------------------------------------------------------------------------
+# Disabled path: no registry mutation, no series
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_no_registry_mutation(monkeypatch):
+    n = _init()
+    x = np.zeros((n, 2), np.float32)
+    bf.allreduce(x)  # warm the jit cache so the disabled pass is pure reuse
+    telemetry.reset()
+    monkeypatch.setenv("BLUEFOG_TPU_TELEMETRY", "0")
+    config.reload()
+    try:
+        before_c = dict(telemetry._registry.counters)
+        before_g = dict(telemetry._registry.gauges)
+        bf.allreduce(x)
+        bf.neighbor_allreduce(x)
+        bf.win_create(x, "tele_off")
+        bf.win_put(x, "tele_off")
+        bf.win_update("tele_off")
+        bf.win_free("tele_off")
+        assert telemetry._registry.counters == before_c == {}
+        assert telemetry._registry.gauges == before_g == {}
+        assert telemetry.snapshot() == {}
+        assert not telemetry.enabled()
+    finally:
+        monkeypatch.delenv("BLUEFOG_TPU_TELEMETRY")
+        config.reload()
+
+
+# ---------------------------------------------------------------------------
+# /metrics + /healthz endpoint
+# ---------------------------------------------------------------------------
+
+def test_metrics_and_healthz_roundtrip():
+    n = _init()
+    x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    bf.neighbor_allreduce(x)
+    port = telemetry.start_http_server(0)
+    assert telemetry.server_port() == port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        assert r.status == 200
+        text = r.read().decode()
+    assert "# TYPE bf_comm_calls_total counter" in text
+    assert 'bf_comm_calls_total{op="neighbor_allreduce"} 1' in text
+    assert 'bf_comm_bytes_total{op="neighbor_allreduce"} 128' in text
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+        assert r.status == 200
+        hz = json.loads(r.read().decode())
+    assert hz["status"] == "ok"
+    assert hz["overdue_ops"] == []
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=10)
+    telemetry.stop_http_server()
+    assert telemetry.server_port() is None
+
+
+def test_healthz_reflects_stalled_wait(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_STALL_WARNING_SEC", "0.2")
+    config.reload()
+    from bluefog_tpu.utils import stall
+    port = telemetry.start_http_server(0)
+    try:
+        with stall.watch("healthz-stalled-op"):
+            time.sleep(0.5)
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                    hz = json.loads(r.read().decode())
+                    status = r.status
+            except urllib.error.HTTPError as e:  # 503 while stalled
+                hz = json.loads(e.read().decode())
+                status = e.code
+        assert status == 503
+        assert hz["status"] == "stalled"
+        assert any(o["op"] == "healthz-stalled-op"
+                   for o in hz["overdue_ops"])
+    finally:
+        monkeypatch.delenv("BLUEFOG_TPU_STALL_WARNING_SEC")
+        config.reload()
+
+
+def test_endpoint_autostart_from_env(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_TELEMETRY_PORT", "0")
+    config.reload()
+    try:
+        _init()
+        port = telemetry.server_port()
+        assert port is not None
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert r.status == 200
+    finally:
+        monkeypatch.delenv("BLUEFOG_TPU_TELEMETRY_PORT")
+        config.reload()
+
+
+# ---------------------------------------------------------------------------
+# Aggregation
+# ---------------------------------------------------------------------------
+
+def test_aggregate_snapshot_single_process_equals_local():
+    n = _init()
+    x = np.zeros((n, 2), np.float32)
+    bf.neighbor_allreduce(x)
+    local = dict(bf.telemetry_snapshot())
+    agg = bf.telemetry_snapshot(aggregate=True)
+    assert agg == local
+
+
+_AGG_SCRIPT = """\
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import bluefog_tpu as bf
+from bluefog_tpu.utils import telemetry
+bf.init_distributed()
+# One private counter per PROCESS: the aggregate must sum to 1 + 2 = 3
+# on every process, and counters recorded by both (the init collectives)
+# must sum across registries.
+telemetry.inc('bf_test_private_total', 1 + jax.process_index())
+agg = bf.telemetry_snapshot(aggregate=True)
+assert agg['bf_test_private_total'] == 3.0, agg
+print('AGG_OK', jax.process_index())
+bf.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_aggregate_snapshot_multiprocess(tmp_path):
+    """Two processes, each incrementing a private counter: the aggregate
+    must SUM them on every process (rides the collective path)."""
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "agg.py"
+    script.write_text(_AGG_SCRIPT.format(repo=repo))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",
+         "--devices-per-proc", "2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=600, cwd=repo, env=env)
+    if "Multiprocess computations aren't implemented" in out.stderr:
+        # Same capability gate as every cross-process collective test:
+        # this jaxlib's CPU backend cannot run multiprocess programs at
+        # all (the aggregate rides the ordinary collective path).
+        pytest.skip("CPU backend lacks multiprocess collectives here")
+    assert out.returncode == 0, \
+        f"stdout={out.stdout}\nstderr={out.stderr[-3000:]}"
+    assert out.stdout.count("AGG_OK") == 2
+
+
+# ---------------------------------------------------------------------------
+# Consensus-distance gauge
+# ---------------------------------------------------------------------------
+
+def test_consensus_distance_hand_computed(monkeypatch):
+    """K=1: every step samples.  With SGD lr=0 the params never move, so
+    the gauge must equal the hand-computed ``||x_r - (W^T x)_r||_2`` of the
+    initial rank-major parameters under the uniform Exp2 weights."""
+    monkeypatch.setenv("BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY", "1")
+    config.reload()
+    try:
+        n = _init()
+        g = topo.ExponentialTwoGraph(n)
+        bf.set_topology(g)
+        rng = np.random.RandomState(0)
+        params = {"w": rng.randn(n, 5).astype(np.float32)}
+        grads = {"w": np.zeros((n, 5), np.float32)}
+        opt = bf.optim.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+        state = opt.init(params)
+        new_params, state = opt.step(params, grads, state)
+        # lr=0 and W row-stochastic: step's combine IS the neighborhood
+        # mean, and new_params == W^T params.
+        w = S.uniform_weights(topo.weight_matrix(g))
+        x = params["w"]
+        combined = np.einsum("sd,s...->d...", w, x)
+        # the sampler measures the distance of the POST-step params from
+        # their own neighborhood mean
+        mean2 = np.einsum("sd,s...->d...", w, combined)
+        expected = np.linalg.norm(combined - mean2, axis=1)
+        snap = bf.telemetry_snapshot()
+        assert snap["bf_consensus_samples_total"] == 1
+        np.testing.assert_allclose(snap["bf_consensus_distance"],
+                                   expected.mean(), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(snap["bf_consensus_distance_max"],
+                                   expected.max(), rtol=1e-4, atol=1e-6)
+    finally:
+        monkeypatch.delenv("BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY")
+        config.reload()
+
+
+def test_consensus_distance_window_optimizer(monkeypatch):
+    """The async family reads the gauge off the win_update combine: with
+    lr=0 and uniform weights the distance is ``||x_r - mean_nbhd(x)_r||``
+    of the initial params."""
+    monkeypatch.setenv("BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY", "1")
+    config.reload()
+    try:
+        n = _init()
+        g = topo.ExponentialTwoGraph(n)
+        bf.set_topology(g)
+        rng = np.random.RandomState(1)
+        params = {"w": rng.randn(n, 4).astype(np.float32)}
+        grads = {"w": np.zeros((n, 4), np.float32)}
+        opt = bf.optim.DistributedWinPutOptimizer(optax.sgd(0.0))
+        state = opt.init(params)
+        try:
+            _, state = opt.step(params, grads, state)
+            x = params["w"]
+            w_uni = S.uniform_weights(topo.weight_matrix(g))
+            combined = np.einsum("sd,s...->d...", w_uni, x)
+            expected = np.linalg.norm(x - combined, axis=1)
+            snap = bf.telemetry_snapshot()
+            assert snap["bf_consensus_samples_total"] == 1
+            np.testing.assert_allclose(
+                snap["bf_consensus_distance"], expected.mean(),
+                rtol=1e-4, atol=1e-6)
+        finally:
+            opt.free()
+    finally:
+        monkeypatch.delenv("BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY")
+        config.reload()
+
+
+def test_collective_sampler_off_by_default():
+    """The collective family's sampler costs an extra combine + host sync
+    per sample, so without an EXPLICIT BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY
+    it must not run — default telemetry never changes a training loop's
+    communication volume.  (The window family samples for free and uses
+    the default period.)"""
+    assert "BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY" not in __import__(
+        "os").environ
+    config.reload()
+    n = _init()
+    params = {"w": np.ones((n, 2), np.float32)}
+    grads = {"w": np.zeros((n, 2), np.float32)}
+    opt = bf.optim.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+    state = opt.init(params)
+    for _ in range(12):
+        params, state = opt.step(params, grads, state)
+    snap = bf.telemetry_snapshot()
+    assert "bf_consensus_samples_total" not in snap
+    # free sampler still defaults on
+    assert telemetry.consensus_every() == 10
+    assert telemetry.consensus_every(costs_communication=True) == 0
+
+
+def test_consensus_sampling_period(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY", "3")
+    config.reload()
+    try:
+        n = _init()
+        params = {"w": np.ones((n, 2), np.float32)}
+        grads = {"w": np.zeros((n, 2), np.float32)}
+        opt = bf.optim.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+        state = opt.init(params)
+        for _ in range(7):
+            params, state = opt.step(params, grads, state)
+        snap = bf.telemetry_snapshot()
+        assert snap["bf_consensus_samples_total"] == 2  # steps 3 and 6
+    finally:
+        monkeypatch.delenv("BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY")
+        config.reload()
+
+
+# ---------------------------------------------------------------------------
+# Timeline counter events
+# ---------------------------------------------------------------------------
+
+def test_timeline_counter_events(tmp_path, monkeypatch):
+    from bluefog_tpu.utils import timeline
+    monkeypatch.setenv("BLUEFOG_TPU_PYTHON_TIMELINE", "1")
+    config.reload()
+    path = str(tmp_path / "tl.json")
+    assert timeline.start_timeline(path)
+    try:
+        n = _init()
+        x = np.zeros((n, 2), np.float32)
+        bf.neighbor_allreduce(x)
+        bf.telemetry_snapshot()  # emits counter events into the timeline
+    finally:
+        timeline.stop_timeline()
+        monkeypatch.delenv("BLUEFOG_TPU_PYTHON_TIMELINE")
+        config.reload()
+    events = json.load(open(path))
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert counters, "no counter events in the timeline"
+    names = {e["name"] for e in counters}
+    assert any("bf_comm_calls_total" in s for s in names)
+    assert all("args" in e and "value" in e["args"] for e in counters)
+
+
+# ---------------------------------------------------------------------------
+# %bfstat status command
+# ---------------------------------------------------------------------------
+
+def test_bfstat_text_reports_health_and_counters():
+    from bluefog_tpu.run.cluster_repl import bfstat_text
+    n = _init()
+    x = np.zeros((n, 2), np.float32)
+    bf.allreduce(x)
+    text = bfstat_text()
+    assert "[bfstat]" in text
+    assert "health: ok" in text
+    assert "topology: 8 nodes" in text
+    assert 'bf_comm_calls_total{op="allreduce"} = 1' in text
+
+
+def test_cluster_console_bfstat_rewrite(capsys):
+    """``%bfstat`` in the cluster REPL is rewritten to a plain-Python cell
+    (shipped SPMD) instead of being a SyntaxError."""
+    from bluefog_tpu.run.cluster_repl import ClusterConsole, Fleet
+    _init()
+    console = ClusterConsole(Fleet([]), locals={"bf": bf})
+    more = console.runsource("%bfstat")
+    assert more is False
+    out = capsys.readouterr().out
+    assert "[bfstat]" in out and "health:" in out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus renderer details
+# ---------------------------------------------------------------------------
+
+def test_render_prometheus_types_and_labels():
+    telemetry.inc("bf_x_total", 2, op="a")
+    telemetry.inc("bf_x_total", 3, op="b")
+    telemetry.set_gauge("bf_g", 1.5, rank="0")
+    text = telemetry.render_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE bf_x_total counter" in lines
+    assert "# TYPE bf_g gauge" in lines
+    assert 'bf_x_total{op="a"} 2' in lines
+    assert 'bf_x_total{op="b"} 3' in lines
+    assert 'bf_g{rank="0"} 1.5' in lines
+
+
+def test_render_prometheus_survives_nan_inf():
+    """A diverging run can land nan in a gauge (consensus distance of nan
+    params); the scrape must keep working with the exposition-format
+    spellings instead of crashing the handler forever."""
+    telemetry.set_gauge("bf_g_nan", float("nan"))
+    telemetry.set_gauge("bf_g_inf", float("inf"))
+    telemetry.set_gauge("bf_g_ninf", float("-inf"))
+    lines = telemetry.render_prometheus().splitlines()
+    assert "bf_g_nan NaN" in lines
+    assert "bf_g_inf +Inf" in lines
+    assert "bf_g_ninf -Inf" in lines
+    port = telemetry.start_http_server(0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        assert r.status == 200
+        assert "bf_g_nan NaN" in r.read().decode()
