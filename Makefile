@@ -5,12 +5,15 @@
 
 PYTEST = python -m pytest -q
 
-.PHONY: test test-fast test-slow test-all test-onchip bench native \
-        telemetry-smoke
+.PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
+        bench-comm-smoke native telemetry-smoke
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
-# grew a few oracle tests in round 4); run on every change.
-test: test-fast
+# grew a few oracle tests in round 4); run on every change, plus the
+# schedule-regression smoke (bench_comm asserts the min-round repack is
+# output-equivalent and never worse than naive — a broken repack fails
+# here loudly, not as a silent slowdown).
+test: test-fast bench-comm-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -28,6 +31,16 @@ test-onchip:
 
 bench:
 	python bench.py
+
+# Gossip hot-path microbench: rounds/edges/walltime, naive shift-distance
+# schedule vs the min-round repack (ops/schedule_opt.py), CPU-runnable.
+bench-comm:
+	python bench_comm.py
+
+# Tiny-mesh CI smoke of the same: fails loudly on any schedule regression
+# (more rounds than naive, off the König bound, or output drift > 1e-6).
+bench-comm-smoke:
+	env JAX_PLATFORMS=cpu python bench_comm.py --smoke
 
 # End-to-end telemetry check: start the /metrics endpoint, drive one
 # collective, scrape /metrics + /healthz and assert the core series exist.

@@ -34,8 +34,27 @@ _TRANSIENT_PROBE_PAT = re.compile(
     r"transport (closed|error)|unreachable")
 
 
+def _cpu_fallback_or_exit(reason: str) -> bool:
+    """When the accelerator is unreachable: with
+    ``BLUEFOG_TPU_BENCH_ALLOW_CPU=1`` fall back to a clearly-labeled CPU
+    smoke metric (``"backend": "cpu"`` + ``"cpu_fallback"`` in the JSON —
+    a data point that proves the code path, never a throughput claim)
+    instead of yielding NO metric for the round (BENCH_r05: rc=3 left 3
+    straight rounds without evidence); without the opt-in, exit 3 as
+    before so a dead tunnel cannot print a bogus accelerator number."""
+    import sys
+    if os.environ.get("BLUEFOG_TPU_BENCH_ALLOW_CPU") not in (
+            "1", "true", "True", "yes"):  # same spellings as config._flag
+        raise SystemExit(3)
+    print(f"bench: {reason} — BLUEFOG_TPU_BENCH_ALLOW_CPU=1 set, falling "
+          "back to a CPU smoke run (metric will be labeled backend=cpu)",
+          file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return True
+
+
 def _probe_backend(timeout_s: float = 180.0,
-                   retry_window_s: float = 900.0) -> None:
+                   retry_window_s: float = 900.0) -> bool:
     """Fail FAST when the accelerator tunnel is down: a dead backend hangs
     jax's init inside a C call no signal can interrupt, so probe it in a
     disposable subprocess first and exit with a clear error instead of
@@ -45,7 +64,8 @@ def _probe_backend(timeout_s: float = 180.0,
     HANG retries with backoff for up to ``retry_window_s`` (~15 min,
     override via ``BLUEFOG_TPU_BENCH_PROBE_WINDOW``); a probe that ERRORS
     (missing jax, bad platform string, crashing plugin) is deterministic
-    and fails immediately."""
+    and fails immediately.  Returns True when the run proceeds on the CPU
+    fallback (see :func:`_cpu_fallback_or_exit`)."""
     import subprocess
     import sys
     retry_window_s = float(os.environ.get(
@@ -67,7 +87,7 @@ def _probe_backend(timeout_s: float = 180.0,
                 [sys.executable, "-c", probe_src],
                 capture_output=True, text=True, timeout=timeout_s)
             if ping.returncode == 0:
-                return
+                return False
             if _TRANSIENT_PROBE_PAT.search(ping.stderr or ""):
                 # A fast connection error from the plugin is as transient
                 # as an init hang — same retry window.
@@ -77,17 +97,17 @@ def _probe_backend(timeout_s: float = 180.0,
             else:
                 print("bench: backend probe failed (deterministic — not "
                       "retrying):\n" + ping.stderr[-2000:], file=sys.stderr)
-                raise SystemExit(3)
+                return _cpu_fallback_or_exit("deterministic probe failure")
         except subprocess.TimeoutExpired:
             err = "accelerator backend unreachable (init hang)"
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             print(f"bench: {err} — giving up after {attempt} attempts; "
-                  "not printing a bogus metric", file=sys.stderr)
+                  "not printing a bogus accelerator metric", file=sys.stderr)
             if last_stderr:  # the operator needs the actual error text
                 print("bench: last probe stderr:\n" + last_stderr[-2000:],
                       file=sys.stderr)
-            raise SystemExit(3)
+            return _cpu_fallback_or_exit(err)
         wait = min(delay, remaining)
         print(f"bench: {err} — retrying in {wait:.0f}s "
               f"({remaining:.0f}s left in probe window)", file=sys.stderr)
@@ -96,7 +116,7 @@ def _probe_backend(timeout_s: float = 180.0,
 
 
 def main():
-    _probe_backend()
+    cpu_fallback = _probe_backend()
     import jax
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
@@ -252,6 +272,9 @@ def main():
             "optimizer": "ATC neighbor_allreduce (dynamic one-peer Exp2)"
             if n > 1 else "local SGD (single chip)",
             "compression": compression,
+            # Accelerator tunnel was down; this is a CPU smoke data point
+            # (code-path evidence only), never a throughput claim.
+            "cpu_fallback": cpu_fallback,
             "telemetry": snap,
         },
     }))

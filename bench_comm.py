@@ -1,0 +1,184 @@
+"""Communication microbenchmark: the gossip hot path's compiled schedule.
+
+Measures what ``bench.py`` (an end-to-end training benchmark) cannot
+isolate: the round count, edge count and per-op walltime of
+``neighbor_allreduce`` under the naive shift-distance schedule vs the
+min-round repack (``ops/schedule_opt.py``), across the topology families
+that matter — shift-structured (ring, Exp2: already optimal, the repack
+must be a no-op), star (irregular hub) and random-regular (the stress
+case: ~n naive rounds vs degree optimal).
+
+CPU-runnable by design: ppermute schedules compile and execute on the
+virtual host-platform mesh, so schedule regressions are caught by
+``make bench-comm-smoke`` with no accelerator attached.  On CPU the script
+forces ``--n`` virtual devices itself (before jax imports); on a real
+backend it uses the attached devices and clamps ``--n`` to them.
+
+Prints ONE JSON line like bench.py:
+  {"metric": "gossip_schedule_opt_round_reduction_random_regular",
+   "value": <naive_rounds / optimized_rounds>, "unit": "x", ...}
+with per-topology detail: rounds/edges before/after, per-op walltime for
+both schedules, and the max |naive - optimized| output difference
+(must be <= 1e-6 at fp32 — the repack is output-equivalent).
+"""
+
+import argparse
+import json
+import os
+import time
+
+
+def _parse_args():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--n", type=int, default=None,
+                   help="mesh/topology size (default: 32 on CPU, else the "
+                        "attached device count)")
+    p.add_argument("--degree", type=int, default=4,
+                   help="random-regular degree (default 4)")
+    p.add_argument("--payload", type=int, default=2048,
+                   help="per-rank f32 payload elements (default 2048)")
+    p.add_argument("--iters", type=int, default=10,
+                   help="timed iterations per schedule (default 10)")
+    p.add_argument("--reps", type=int, default=2,
+                   help="op applications fused per timed call (amortizes "
+                        "dispatch; default 2 — naive schedules on irregular "
+                        "topologies chain O(n) ppermutes per application, "
+                        "and XLA compile time grows with the chain)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny fast configuration for CI (n=8, few iters)")
+    return p.parse_args()
+
+
+def main():
+    args = _parse_args()
+    if args.smoke:
+        args.n = args.n or 8
+        args.payload = min(args.payload, 1024)
+        args.iters = min(args.iters, 5)
+        args.reps = min(args.reps, 4)
+
+    # Backend selection BEFORE jax import: default to CPU (this is a
+    # schedule benchmark, not a bandwidth one) and size the virtual mesh
+    # to the requested topology so the numeric-equivalence check runs at
+    # full scale.
+    platform = os.environ.get("JAX_PLATFORMS") or "cpu"
+    os.environ["JAX_PLATFORMS"] = platform
+    if platform == "cpu":
+        n = args.n or 32
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    jax.config.update("jax_platforms", platform)
+    devs = jax.devices()
+    n = min(args.n or len(devs), len(devs))
+    if n < 4:
+        import sys
+        print(f"bench_comm: needs >= 4 ranks to build its topologies, have "
+              f"{n} device(s) on backend {jax.default_backend()!r}; run "
+              "with JAX_PLATFORMS=cpu (the script self-sizes a virtual "
+              "mesh) or pass --n on a larger mesh", file=sys.stderr)
+        return 2
+    mesh = Mesh(np.asarray(devs[:n]), ("r",))
+
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import collective as C
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu.ops import schedule_opt as SO
+    from bluefog_tpu.utils import telemetry
+
+    # Random-regular needs n * degree even: drop the clamped degree by one
+    # for parity, and fail with a usable message if that empties it.
+    rr_degree = min(args.degree, n - 1)
+    if (n * rr_degree) % 2:
+        rr_degree -= 1
+    if rr_degree < 1:
+        raise SystemExit(
+            f"bench_comm: no valid random-regular degree at n={n} with "
+            f"--degree {args.degree} (n * degree must be even and "
+            "0 < degree < n); use an even --n or a larger --degree")
+
+    topologies = {
+        "ring": lambda: topo.RingGraph(n),
+        "exp2": lambda: topo.ExponentialTwoGraph(n),
+        "star": lambda: topo.StarGraph(n),
+        "random_regular": lambda: topo.RandomRegularGraph(
+            n, rr_degree, seed=args.seed),
+    }
+
+    rng = np.random.default_rng(args.seed)
+    x = jnp.asarray(rng.standard_normal((n, args.payload)), jnp.float32)
+
+    def run_op(sched):
+        def body(b):
+            out = b[0]
+            for _ in range(args.reps):
+                out = C.neighbor_allreduce(out, sched, "r")
+            return out[None]
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P("r"), out_specs=P("r"),
+            check_vma=False))
+
+    def time_op(fn):
+        out = fn(x)
+        jax.block_until_ready(out)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(x)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        return dt / (args.iters * args.reps) * 1e3  # ms per op
+
+    detail = {}
+    for name, make in topologies.items():
+        w = topo.weight_matrix(make())
+        naive = S._build_schedule(w, optimize=False)
+        opt = S._build_schedule(w, optimize=True)
+        r0, e0 = C.schedule_wire_stats(naive)
+        r1, e1 = C.schedule_wire_stats(opt)
+        assert e0 == e1, f"{name}: repack changed the edge set ({e0} -> {e1})"
+        assert r1 <= r0, f"{name}: repack emitted MORE rounds ({r0} -> {r1})"
+        assert r1 == SO.min_rounds(opt), \
+            f"{name}: {r1} rounds, König bound {SO.min_rounds(opt)}"
+        f_naive, f_opt = run_op(naive), run_op(opt)
+        out_naive = np.asarray(f_naive(x))
+        out_opt = np.asarray(f_opt(x))
+        max_diff = float(np.abs(out_naive - out_opt).max())
+        assert max_diff <= 1e-6, \
+            f"{name}: outputs differ by {max_diff} (> 1e-6)"
+        detail[name] = {
+            "rounds_naive": r0, "rounds_optimized": r1,
+            "edges": e0,
+            "round_reduction": round(r0 / max(r1, 1), 3),
+            "ms_per_op_naive": round(time_op(f_naive), 4),
+            "ms_per_op_optimized": round(time_op(f_opt), 4),
+            "max_output_diff": max_diff,
+        }
+
+    rr = detail["random_regular"]
+    snap = telemetry.snapshot() if telemetry.enabled() else {}
+    print(json.dumps({
+        "metric": "gossip_schedule_opt_round_reduction_random_regular",
+        "value": rr["round_reduction"],
+        "unit": "x",
+        "detail": {
+            "n": n,
+            "payload_f32": args.payload,
+            "backend": jax.default_backend(),
+            "per_topology": detail,
+            "schedule_opt_rounds_saved_total": snap.get(
+                "bf_schedule_opt_rounds_saved_total", 0),
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

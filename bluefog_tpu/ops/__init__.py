@@ -7,4 +7,9 @@ from bluefog_tpu.ops.schedule import (  # noqa: F401
     compile_dynamic,
     compile_pair_gossip,
 )
+from bluefog_tpu.ops.schedule_opt import (  # noqa: F401
+    clear_compile_cache,
+    min_rounds,
+    optimize_schedule,
+)
 from bluefog_tpu.ops import collective  # noqa: F401

@@ -58,7 +58,12 @@ def schedule_wire_stats(sched) -> tuple:
     per call, edges the total (src, dst) pairs across them.  A
     ``DynamicSchedule`` executes ONE phase per call (``lax.switch``), so
     rounds/edges are averaged over the period — the exact per-call value
-    for uniform phases (one-peer walks), the expectation otherwise."""
+    for uniform phases (one-peer walks), the expectation otherwise.
+
+    Counts reflect the schedule AS COMPILED: with the min-round repack on
+    (``BLUEFOG_TPU_SCHEDULE_OPT``, default) the rounds gauge is the
+    optimized ``max(max_outdeg, max_indeg)`` count, not the shift-distance
+    decomposition's; edges are invariant under repacking."""
     phases = getattr(sched, "phases", None)
     if phases is not None:  # DynamicSchedule
         per = [schedule_wire_stats(ph) for ph in phases]
@@ -111,16 +116,32 @@ def allgather(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
 # Neighbor family
 # ---------------------------------------------------------------------------
 
+def _tree_sum(terms: list) -> jnp.ndarray:
+    """Balanced pairwise sum: depth ``ceil(log2(k))`` instead of a serial
+    add chain, so no permuted term's consumption is serialized behind every
+    earlier round — XLA is free to add round r's arrival while round r+1 is
+    still on the wire (and fp error grows O(log k), not O(k))."""
+    while len(terms) > 1:
+        nxt = [terms[i] + terms[i + 1] for i in range(0, len(terms) - 1, 2)]
+        if len(terms) % 2:
+            nxt.append(terms[-1])
+        terms = nxt
+    return terms[0]
+
+
 def _apply_rounds(x: jnp.ndarray, sched: StaticSchedule, axis_name: str,
                   idx) -> jnp.ndarray:
     """``self_scale[i] * x + sum_r ppermute(x * send_scale_r)`` — the weighted
-    neighbor combine, with weights applied source-side (see schedule.py)."""
+    neighbor combine, with weights applied source-side (see schedule.py).
+    Permuted terms accumulate via a balanced tree-sum: the old serial chain
+    made round r's add depend on rounds 0..r-1, an artificial dependency
+    the scheduler had to respect."""
     dt = x.dtype
-    out = x * _const(sched.self_scale, dt)[idx]
+    terms = [x * _const(sched.self_scale, dt)[idx]]
     for rnd in sched.rounds:
         scaled = x * _const(rnd.send_scale, dt)[idx]
-        out = out + lax.ppermute(scaled, axis_name, rnd.pairs)
-    return out
+        terms.append(lax.ppermute(scaled, axis_name, rnd.pairs))
+    return _tree_sum(terms)
 
 
 def neighbor_allreduce(x: jnp.ndarray, sched: StaticSchedule,
@@ -262,17 +283,15 @@ def neighbor_allreduce_matrix(x: jnp.ndarray, w: jnp.ndarray,
     """
     idx = _axis_index(axis_name)
     dt = x.dtype
-    out = x * w[idx, idx].astype(dt)
+    terms = [x * w[idx, idx].astype(dt)]
     for rnd in sched.rounds:
-        # Static per-round dst of each src (-1 = silent); silent ranks get a
-        # zero scale so the value they permute is masked out.
-        dst_of = np.full(sched.n, -1, dtype=np.int32)
-        for s, d in rnd.pairs:
-            dst_of[s] = d
-        dst = _const(dst_of, jnp.int32)[idx]
+        # Static per-round dst of each src (-1 = silent, precomputed on the
+        # round); silent ranks get a zero scale so the value they permute
+        # is masked out.
+        dst = _const(rnd.dst_of, jnp.int32)[idx]
         scale = jnp.where(dst >= 0, w[idx, jnp.maximum(dst, 0)], 0.0).astype(dt)
-        out = out + lax.ppermute(x * scale, axis_name, rnd.pairs)
-    return out
+        terms.append(lax.ppermute(x * scale, axis_name, rnd.pairs))
+    return _tree_sum(terms)
 
 
 def dynamic_neighbor_allreduce(x: jnp.ndarray, step: jnp.ndarray,

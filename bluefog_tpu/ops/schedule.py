@@ -22,11 +22,20 @@ Weights are applied *source-side*: round ``r`` communicates
 This one convention implements receiver-chosen ``src_weights``, sender-chosen
 ``dst_weights`` (partial send) and push-sum column-stochastic scaling alike,
 since schedule weights are compile-time constants known on every device.
+
+Round minimization: the shift-distance decomposition is a *starting point*.
+Unless ``BLUEFOG_TPU_SCHEDULE_OPT=0``, every compiled schedule is repacked
+by :mod:`bluefog_tpu.ops.schedule_opt` into the König-minimal
+``max(max_outdeg, max_indeg)`` rounds (bipartite edge coloring), and the
+matrix -> schedule compilation is memoized process-wide on the weight-matrix
+bytes, so dynamic phase tables and repeated ``set_topology`` calls never
+recompile the same matrix twice.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import cached_property
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import networkx as nx
@@ -65,6 +74,17 @@ class CommRound:
     send_scale: np.ndarray
     recv_mask: np.ndarray
     src_of: np.ndarray
+
+    @cached_property
+    def dst_of(self) -> np.ndarray:
+        """(n,) int array; dst rank each src feeds this round, -1 when
+        silent — the inverse of ``src_of``.  Cached on the round so ops
+        with traced weights (``neighbor_allreduce_matrix``) don't rebuild
+        an O(n) table per round on every retrace."""
+        dst = np.full(len(self.send_scale), -1, dtype=np.int32)
+        for s, d in self.pairs:
+            dst[s] = d
+        return dst
 
 
 @dataclass(frozen=True, eq=False)
@@ -170,6 +190,38 @@ def _rounds_from_matrix(w: np.ndarray) -> Tuple[CommRound, ...]:
     return _rounds_from_matrix_py(w)
 
 
+def _build_schedule(w: np.ndarray,
+                    optimize: Optional[bool] = None) -> StaticSchedule:
+    """Uncached matrix -> schedule: naive decomposition + min-round repack.
+
+    ``optimize`` overrides the ``BLUEFOG_TPU_SCHEDULE_OPT`` config flag
+    (bench_comm.py and the property tests compile both variants of the
+    same matrix to compare them)."""
+    from bluefog_tpu.utils import config
+    n = w.shape[0]
+    off_diag = w.copy()
+    np.fill_diagonal(off_diag, 0.0)
+    sched = StaticSchedule(
+        n=n,
+        rounds=_rounds_from_matrix(w),
+        self_scale=np.diag(w).copy(),
+        indegree=(off_diag != 0).sum(axis=0).astype(np.int32),
+        outdegree=(off_diag != 0).sum(axis=1).astype(np.int32),
+    )
+    do_opt = config.get().schedule_opt if optimize is None else optimize
+    if do_opt:
+        from bluefog_tpu.ops.schedule_opt import optimize_schedule
+        sched = optimize_schedule(sched)
+    return sched
+
+
+def _schedule_from_matrix(w: np.ndarray) -> StaticSchedule:
+    """Matrix -> (optimized) schedule through the process-level compile
+    cache — the single funnel ``compile_static``/``compile_dynamic`` use."""
+    from bluefog_tpu.ops.schedule_opt import cached_schedule_from_matrix
+    return cached_schedule_from_matrix(w, _build_schedule)
+
+
 def uniform_weights(w_adj: np.ndarray) -> np.ndarray:
     """Replace a 0/1-ish adjacency with uniform ``1/(indeg+1)`` averaging
     weights — the reference's default when topology weights are disabled
@@ -205,16 +257,7 @@ def compile_static(topo: nx.DiGraph, *,
     if self_weight is not None:
         w = w.copy()
         np.fill_diagonal(w, self_weight)
-    n = w.shape[0]
-    off_diag = w.copy()
-    np.fill_diagonal(off_diag, 0.0)
-    return StaticSchedule(
-        n=n,
-        rounds=_rounds_from_matrix(w),
-        self_scale=np.diag(w).copy(),
-        indegree=(off_diag != 0).sum(axis=0).astype(np.int32),
-        outdegree=(off_diag != 0).sum(axis=1).astype(np.int32),
-    )
+    return _schedule_from_matrix(w)
 
 
 def _phase_matrix(phase: topo_mod.DynamicPhase, n: int,
@@ -245,18 +288,8 @@ def compile_dynamic(phases: Sequence[topo_mod.DynamicPhase], n: int, *,
     branches that each contain their own static ``ppermute`` — dynamic
     topologies never retrace (SURVEY §7 "dynamic topology under jit").
     """
-    compiled = []
-    for ph in phases:
-        w = _phase_matrix(ph, n, weights)
-        off = w.copy()
-        np.fill_diagonal(off, 0.0)
-        compiled.append(StaticSchedule(
-            n=n,
-            rounds=_rounds_from_matrix(w),
-            self_scale=np.diag(w).copy(),
-            indegree=(off != 0).sum(axis=0).astype(np.int32),
-            outdegree=(off != 0).sum(axis=1).astype(np.int32),
-        ))
+    compiled = [_schedule_from_matrix(_phase_matrix(ph, n, weights))
+                for ph in phases]
     return DynamicSchedule(n=n, phases=tuple(compiled))
 
 

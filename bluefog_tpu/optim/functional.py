@@ -148,26 +148,98 @@ def make_combiner(
     raise ValueError(f"unknown communication type {comm}")
 
 
+def _bucket_groups(leaves, fusion_buckets: Optional[int]):
+    """Partition flatten-order leaf indices into contiguous fusion buckets.
+
+    ``fusion_buckets`` (explicit count) wins over the
+    ``BLUEFOG_TPU_FUSION_BUCKET_MB`` size cap; with neither, one bucket —
+    today's whole-tree ravel.  Buckets are contiguous in tree-flatten
+    order, byte-balanced (count mode) or size-capped (MB mode), and
+    deterministic: every SPMD rank must build identical buffers.
+    """
+    from bluefog_tpu.utils import config
+    nbytes = [int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves]
+    total = sum(nbytes)
+    if fusion_buckets is not None:
+        k = max(1, min(int(fusion_buckets), len(leaves)))
+        if k == 1:
+            return [list(range(len(leaves)))]
+        # Close bucket b once the running total crosses b/k of the bytes:
+        # balanced without look-ahead, never more than k buckets.
+        groups, cur, cum, b = [], [], 0, 1
+        for i, nb in enumerate(nbytes):
+            cur.append(i)
+            cum += nb
+            if cum * k >= b * total and b < k:
+                groups.append(cur)
+                cur, b = [], b + 1
+        if cur:
+            groups.append(cur)
+        return groups
+    cap = config.get().fusion_bucket_mb * (1 << 20)
+    if cap <= 0:
+        return [list(range(len(leaves)))]
+    groups, cur, cur_bytes = [], [], 0
+    for i, nb in enumerate(nbytes):
+        if cur and cur_bytes + nb > cap:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def _fused_apply(fn, tree, fusion_buckets: Optional[int]):
+    """Apply ``fn`` (flat-array -> flat-array) to a pytree through fusion
+    buckets: each bucket of leaves ravels into one flat buffer, so a model
+    with hundreds of parameters issues one collective set per bucket
+    instead of one per parameter.  With multiple buckets the per-bucket
+    programs are INDEPENDENT subgraphs — bucket i+1's producer math carries
+    no data dependency on bucket i's collective, so XLA's latency-hiding
+    scheduler overlaps wire time with compute (the single-buffer ravel
+    serializes ALL producers before the first ppermute can start)."""
+    from jax.flatten_util import ravel_pytree
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    groups = _bucket_groups(leaves, fusion_buckets)
+    if len(groups) == 1:
+        flat, unravel = ravel_pytree(tree)
+        return unravel(fn(flat))
+    out = list(leaves)
+    for grp in groups:
+        flat, unravel = ravel_pytree([leaves[i] for i in grp])
+        for i, leaf in zip(grp, unravel(fn(flat))):
+            out[i] = leaf
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def _tree_combine(params, combine, step, weights, steps_per_comm: int,
-                  fuse: bool = True):
+                  fuse: bool = True, fusion_buckets: Optional[int] = None):
     """Apply ``combine`` to a pytree, skipping steps where
     ``step % steps_per_comm != 0`` (local aggregation).
 
-    ``fuse=True`` ravels the whole tree into ONE flat buffer so a model with
-    hundreds of parameters issues one ppermute set per round instead of one
-    per parameter — the TPU-native replacement for the reference's
-    FusionBufferManager + fused-response machinery (``tensor_queue.h:70-92``,
-    ``operations.cc:918-1001``), with zero copy-in/copy-out phases because XLA
-    fuses the concatenation into the collective's producers/consumers.
+    ``fuse=True`` ravels the tree into fusion-bucket buffers (default: one)
+    so a model with hundreds of parameters issues one ppermute set per
+    round per bucket instead of one per parameter — the TPU-native
+    replacement for the reference's FusionBufferManager + fused-response
+    machinery (``tensor_queue.h:70-92``, ``operations.cc:918-1001``), with
+    zero copy-in/copy-out phases because XLA fuses the concatenation into
+    the collective's producers/consumers.  ``fusion_buckets > 1`` (or the
+    ``BLUEFOG_TPU_FUSION_BUCKET_MB`` cap) splits the buffer so per-bucket
+    communication pipelines against the other buckets' optimizer math —
+    see :func:`_fused_apply`.
     """
     if getattr(combine, "is_identity", False):
         return params  # empty communication: no fusion copies, no cond
 
     def comm_all(p):
         if fuse:
-            from jax.flatten_util import ravel_pytree
-            flat, unravel = ravel_pytree(p)
-            return unravel(combine(flat, step=step, weights=weights))
+            return _fused_apply(
+                lambda flat: combine(flat, step=step, weights=weights),
+                p, fusion_buckets)
         return jax.tree.map(lambda x: combine(x, step=step, weights=weights), p)
     if steps_per_comm == 1:
         return comm_all(params)
@@ -177,16 +249,19 @@ def _tree_combine(params, combine, step, weights, steps_per_comm: int,
 
 def awc_step(base: optax.GradientTransformation, combine: Combiner,
              params, grads, state: DistOptState, *,
-             weights=None, steps_per_comm: int = 1, fuse: bool = True):
+             weights=None, steps_per_comm: int = 1, fuse: bool = True,
+             fusion_buckets: Optional[int] = None):
     """Adapt-with-combine: communicate params, then apply the base update.
 
     Matches ``_DistributedReduceOptimizer`` (reference
     ``torch/optimizers.py:297-483``): the forward hook launches communication
     of ``x_t`` while backward computes ``g_t``; ``step()`` waits and applies
-    the local update to the *combined* parameters.
+    the local update to the *combined* parameters.  With ``fusion_buckets``
+    the base update of bucket i overlaps the combine of bucket i+1 (each
+    bucket's update depends only on its own combine).
     """
     combined = _tree_combine(params, combine, state.step, weights,
-                             steps_per_comm, fuse)
+                             steps_per_comm, fuse, fusion_buckets)
     updates, base_state = base.update(grads, state.base, combined)
     new_params = optax.apply_updates(combined, updates)
     return new_params, DistOptState(base_state, state.step + 1)
@@ -194,18 +269,21 @@ def awc_step(base: optax.GradientTransformation, combine: Combiner,
 
 def atc_step(base: optax.GradientTransformation, combine: Combiner,
              params, grads, state: DistOptState, *,
-             weights=None, steps_per_comm: int = 1, fuse: bool = True):
+             weights=None, steps_per_comm: int = 1, fuse: bool = True,
+             fusion_buckets: Optional[int] = None):
     """Adapt-then-combine: local base update first, then communicate.
 
     Matches ``_DistributedAdaptThenCombineOptimizer`` (reference
     ``torch/optimizers.py:485-842``) — which re-implements sgd/adam/rmsprop/
     adagrad/adadelta by hand to fuse the update into the backward hook; here
-    any optax transformation slots in unchanged.
+    any optax transformation slots in unchanged.  With ``fusion_buckets``
+    bucket i's combine can hit the wire as soon as ITS leaves' updates are
+    applied, overlapping the remaining buckets' optimizer math.
     """
     updates, base_state = base.update(grads, state.base, params)
     half = optax.apply_updates(params, updates)
     new_params = _tree_combine(half, combine, state.step, weights,
-                               steps_per_comm, fuse)
+                               steps_per_comm, fuse, fusion_buckets)
     return new_params, DistOptState(base_state, state.step + 1)
 
 
@@ -324,7 +402,8 @@ def compress_combiner(combine: Combiner, compression: str,
 def gradient_allreduce_step(base: optax.GradientTransformation,
                             params, grads, state: DistOptState, *,
                             axis_name: str, steps_per_comm: int = 1,
-                            compression: str = "none"):
+                            compression: str = "none", fuse: bool = True,
+                            fusion_buckets: Optional[int] = None):
     """Horovod-style synchronous gradient averaging
     (reference ``_DistributedOptimizer``, ``torch/optimizers.py:166-295``).
 
@@ -333,14 +412,27 @@ def gradient_allreduce_step(base: optax.GradientTransformation,
     only — every rank always applies the identical update, preserving the
     replica-identical invariant (the reference's delayed-allreduce counters,
     ``torch/optimizers.py:348-383``).
+
+    ``fuse``/``fusion_buckets`` ride the same bucket machinery as the
+    parameter-consensus orders; for a uniform-dtype gradient tree the fused
+    averaging is bit-identical to per-leaf (psum and the bf16 casts are
+    elementwise), it just issues one allreduce per bucket instead of one
+    per gradient leaf.  Mixed-dtype trees stay on the per-leaf path: the
+    ravel would promote every leaf to a common dtype, changing the psum
+    rounding — this order's replica-identical numerics must not shift
+    underneath existing runs.
     """
     # residual=False: every rank must apply the bit-identical averaged
     # gradient (the replica-identical invariant below).
     one = compress_combiner(
         lambda x, **kw: C.allreduce(x, axis_name, average=True),
         compression, residual=False)
+    uniform_dtype = len(
+        {l.dtype for l in jax.tree_util.tree_leaves(grads)}) <= 1
 
     def comm(g):
+        if fuse and uniform_dtype:
+            return _fused_apply(one, g, fusion_buckets)
         return jax.tree.map(one, g)
     if steps_per_comm == 1:
         avg = comm(grads)
@@ -373,9 +465,15 @@ def dist_init(base: optax.GradientTransformation, params) -> DistOptState:
 def step_fn(order: str, base: optax.GradientTransformation,
             combine: Combiner, *, axis_name: str,
             steps_per_comm: int = 1, fuse: bool = True,
+            fusion_buckets: Optional[int] = None,
             compression: str = "none",
             residual: Optional[bool] = None) -> Callable:
     """Bind an execution order to a ``(params, grads, state[, weights])`` fn.
+
+    ``fusion_buckets`` splits the fused communication buffer into that many
+    byte-balanced buckets (None: one bucket, or the
+    ``BLUEFOG_TPU_FUSION_BUCKET_MB`` size cap when set) so per-bucket
+    collectives pipeline against the other buckets' optimizer math.
 
     ``residual`` controls difference compression under ``compression='bf16'``.
     A global-consensus allreduce must keep replicas bit-identical, so the
@@ -392,12 +490,15 @@ def step_fn(order: str, base: optax.GradientTransformation,
                                 steps_per_comm=steps_per_comm)
     if order == "awc":
         return partial(awc_step, base, combine,
-                       steps_per_comm=steps_per_comm, fuse=fuse)
+                       steps_per_comm=steps_per_comm, fuse=fuse,
+                       fusion_buckets=fusion_buckets)
     if order == "atc":
         return partial(atc_step, base, combine,
-                       steps_per_comm=steps_per_comm, fuse=fuse)
+                       steps_per_comm=steps_per_comm, fuse=fuse,
+                       fusion_buckets=fusion_buckets)
     if order == "gradient_allreduce":
         return partial(gradient_allreduce_step, base, axis_name=axis_name,
                        steps_per_comm=steps_per_comm,
-                       compression=compression)
+                       compression=compression, fuse=fuse,
+                       fusion_buckets=fusion_buckets)
     raise ValueError(f"unknown execution order {order!r}")

@@ -73,6 +73,14 @@ class DistributedOptimizer:
     use_dynamic_topology : cycle the one-peer phase table of the active
         topology (or ``phases`` if given) by step index.
     phases : explicit list of ``topology.DynamicPhase`` for dynamic mode.
+    fusion_buckets : split the fused communication buffer into this many
+        byte-balanced buckets so each bucket's collectives overlap the
+        other buckets' optimizer math (AWC: update(i) || combine(i+1);
+        ATC: combine(i) || update(i+1)).  ``None``: one bucket — unless
+        ``BLUEFOG_TPU_FUSION_BUCKET_MB`` caps bucket size instead.  Only
+        meaningful with ``fusion=True``; tune when the model is large
+        enough that parameter communication and step math are comparable
+        (see docs/performance.md).
     donate : donate the grads and state buffers to the jitted step so XLA
         aliases them into the outputs (grads, same tree shape as params,
         becomes the new params buffer) — peak memory drops by roughly one
@@ -90,6 +98,7 @@ class DistributedOptimizer:
                  num_steps_per_communication: int = 1,
                  use_dynamic_topology: bool = False,
                  phases=None, fusion: bool = True,
+                 fusion_buckets: Optional[int] = None,
                  compression: str = "none", donate: bool = False):
         if isinstance(communication_type, str):
             communication_type = CommunicationType(communication_type)
@@ -104,8 +113,13 @@ class DistributedOptimizer:
         self.num_steps_per_communication = int(num_steps_per_communication)
         self.use_dynamic_topology = use_dynamic_topology
         self.phases = phases
-        # Fused single-buffer communication (reference FusionBufferManager).
+        if fusion_buckets is not None and int(fusion_buckets) < 1:
+            raise ValueError(f"fusion_buckets must be >= 1, got {fusion_buckets}")
+        # Fused communication buffers (reference FusionBufferManager);
+        # fusion_buckets > 1 pipelines per-bucket comm against step math.
         self.fusion = fusion
+        self.fusion_buckets = (None if fusion_buckets is None
+                               else int(fusion_buckets))
         # "bf16": halve the wire bytes per round (functional.
         # compress_combiner — the reference family's fp16 compression role).
         self.compression = compression
@@ -158,7 +172,8 @@ class DistributedOptimizer:
             self.order, self.base, combine,
             axis_name=RANK_AXIS,
             steps_per_comm=self.num_steps_per_communication,
-            fuse=self.fusion, compression=self.compression,
+            fuse=self.fusion, fusion_buckets=self.fusion_buckets,
+            compression=self.compression,
             # Explicit residual policy: a global-consensus allreduce must
             # stay replica-bit-identical under compression.
             residual=(self.communication_type

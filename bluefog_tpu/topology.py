@@ -44,6 +44,7 @@ __all__ = [
     "StarGraph",
     "RingGraph",
     "FullyConnectedGraph",
+    "RandomRegularGraph",
     "GetDynamicOnePeerSendRecvRanks",
     "GetExp2DynamicSendRecvMachineRanks",
     "GetInnerOuterRingDynamicSendRecvRanks",
@@ -272,6 +273,30 @@ def FullyConnectedGraph(size: int) -> nx.DiGraph:
     """All-to-all with uniform ``1/size`` weights (reference :284-303)."""
     assert size > 0
     return from_weight_matrix(np.full((size, size), 1.0 / size))
+
+
+def RandomRegularGraph(size: int, degree: int = 4,
+                       seed: int = 0) -> nx.DiGraph:
+    """Random ``degree``-regular undirected graph as a bidirectional
+    topology with uniform ``1/(degree+1)`` weights (doubly stochastic).
+
+    Random-regular graphs are expanders with high probability — near-Exp2
+    spectral gap at constant degree — but carry NO shift structure: their
+    edges scatter across ~``size`` cyclic distance classes, which makes
+    them the stress topology for the schedule optimizer
+    (``ops/schedule_opt.py`` repacks them from ~``size`` naive ppermute
+    rounds down to exactly ``degree``).  Deterministic in ``seed`` so every
+    rank builds the identical graph.
+    """
+    assert size > 0 and 0 < degree < size, "need 0 < degree < size"
+    assert (size * degree) % 2 == 0, "size * degree must be even"
+    g = nx.random_regular_graph(degree, size, seed=seed)
+    w = np.zeros((size, size))
+    share = 1.0 / (degree + 1.0)
+    for u, v in g.edges():
+        w[u, v] = w[v, u] = share
+    np.fill_diagonal(w, share)
+    return from_weight_matrix(w)
 
 
 # ---------------------------------------------------------------------------

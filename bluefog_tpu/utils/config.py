@@ -19,6 +19,8 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_TELEMETRY         | 1     | 0: disable the metric registry entirely |
 | BLUEFOG_TPU_TELEMETRY_PORT    | unset | serve /metrics + /healthz (0=ephemeral) |
 | BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY | 10 | consensus-distance sample period (0=off) |
+| BLUEFOG_TPU_SCHEDULE_OPT      | 1     | 0: skip the min-round schedule repack |
+| BLUEFOG_TPU_FUSION_BUCKET_MB  | 0     | fusion-buffer bucket cap in MiB (0=one bucket) |
 | BFTPU_COORDINATOR             | unset | set by bfrun: coordinator host:port |
 | BFTPU_NUM_PROCESSES           | unset | set by bfrun |
 | BFTPU_PROCESS_ID              | unset | set by bfrun |
@@ -70,6 +72,14 @@ class Config:
     telemetry: bool
     telemetry_port: Optional[int]
     telemetry_consensus_every: int
+    # Min-round repack of compiled ppermute schedules (ops/schedule_opt.py);
+    # on by default — off is the escape hatch for debugging a schedule by
+    # its raw shift-distance decomposition.
+    schedule_opt: bool
+    # Fusion-buffer bucket cap in MiB for the distributed optimizers
+    # (optim/functional.py); 0 = one fused buffer (legacy behavior).  An
+    # explicit fusion_buckets= argument on the optimizer overrides this.
+    fusion_bucket_mb: float
     # Whether the consensus period was explicitly configured: samplers
     # that COST communication (the collective optimizer family) stay off
     # unless the operator asked; free samplers use the default period.
@@ -98,6 +108,9 @@ class Config:
                 "BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY", "10")),
             telemetry_consensus_set=(
                 "BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY" in os.environ),
+            schedule_opt=_flag("BLUEFOG_TPU_SCHEDULE_OPT", default=True),
+            fusion_bucket_mb=float(
+                os.environ.get("BLUEFOG_TPU_FUSION_BUCKET_MB", "0")),
         )
 
 

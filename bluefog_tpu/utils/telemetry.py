@@ -11,6 +11,11 @@ comm entry point reports into:
     payload bytes in/out per peer process, in-flight handles, drain-burst
     queue depth, mutex waits, probe-detected unreachable peers.
   * ``basics.py``: dispatch-cache hits/misses, throttle waits.
+  * ``ops/schedule_opt.py``: min-round repack savings
+    (``bf_schedule_opt_rounds_saved_total``) and compile-cache
+    hits/misses (``bf_schedule_compile_cache_{hits,misses}_total``);
+    the per-op ``bf_comm_rounds_total`` counters consequently report the
+    *optimized* round counts.
   * ``utils/stall.py``: stall warnings as counters labeled by op name.
   * the optimizer families: the consensus-distance gauge (L2 distance of
     each rank's parameters from its neighborhood mean) — the single most

@@ -144,6 +144,94 @@ def test_fusion_matches_unfused(order, dynamic):
                                    rtol=1e-6, atol=1e-7)
 
 
+def _multi_leaf_problem(seed=3):
+    rng = np.random.RandomState(seed)
+    params = {"a": jnp.asarray(rng.randn(N, DIM, 1)),
+              "b": jnp.asarray(rng.randn(N, 3)),
+              "c": jnp.asarray(rng.randn(N, 2, 2)),
+              "d": jnp.asarray(rng.randn(N, 5))}
+    grads = {k: jnp.asarray(rng.randn(*np.asarray(v).shape))
+             for k, v in params.items()}
+    return params, grads
+
+
+@pytest.mark.parametrize("order,comm", [
+    ("awc", CommunicationType.neighbor_allreduce),
+    ("atc", CommunicationType.neighbor_allreduce),
+    ("gradient_allreduce", CommunicationType.allreduce),
+], ids=["awc", "atc", "gradient_allreduce"])
+def test_bucketed_fusion_matches_single_buffer(order, comm):
+    """fusion_buckets splits the fused buffer so per-bucket collectives
+    pipeline against the other buckets' optimizer math — but it must be
+    numerically equivalent to the single-buffer ravel in all three
+    execution orders (<= fp32 tolerance; the only difference is float
+    summation grouping)."""
+    bf.init(lambda: topo.ExponentialTwoGraph(N))
+    params0, grads = _multi_leaf_problem()
+    outs = {}
+    for buckets in (None, 3):
+        opt = bf.optim.DistributedOptimizer(
+            optax.sgd(0.05, momentum=0.9), comm, order=order,
+            fusion_buckets=buckets)
+        p, s = params0, opt.init(params0)
+        for _ in range(3):
+            p, s = opt.step(p, grads, s)
+        outs[buckets] = p
+    for k in params0:
+        np.testing.assert_allclose(np.asarray(outs[None][k]),
+                                   np.asarray(outs[3][k]),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_bucket_mb_env_cap_matches_single_buffer(monkeypatch):
+    """BLUEFOG_TPU_FUSION_BUCKET_MB caps bucket size instead of fixing a
+    count; a tiny cap (every leaf its own bucket) must still match the
+    single-buffer result."""
+    from bluefog_tpu.utils import config
+    bf.init(lambda: topo.ExponentialTwoGraph(N))
+    params0, grads = _multi_leaf_problem(seed=4)
+
+    def run():
+        opt = bf.optim.DistributedNeighborAllreduceOptimizer(optax.sgd(0.05))
+        p, s = params0, opt.init(params0)
+        for _ in range(2):
+            p, s = opt.step(p, grads, s)
+        return p
+    baseline = run()
+    monkeypatch.setenv("BLUEFOG_TPU_FUSION_BUCKET_MB", "0.00001")
+    config.reload()
+    try:
+        capped = run()
+    finally:
+        monkeypatch.delenv("BLUEFOG_TPU_FUSION_BUCKET_MB")
+        config.reload()
+    for k in params0:
+        np.testing.assert_allclose(np.asarray(capped[k]),
+                                   np.asarray(baseline[k]),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_bucket_groups_partitioning():
+    """Unit contract of the bucket partitioner: contiguous, exhaustive,
+    byte-balanced in count mode, size-capped in MB mode."""
+    from bluefog_tpu.optim.functional import _bucket_groups
+    leaves = [np.zeros(s, np.float32) for s in (100, 50, 200, 10, 40)]
+    assert _bucket_groups(leaves, None) == [[0, 1, 2, 3, 4]]
+    g2 = _bucket_groups(leaves, 2)
+    assert [i for grp in g2 for i in grp] == [0, 1, 2, 3, 4]
+    assert len(g2) == 2
+    # more buckets than leaves clamps to one leaf per bucket
+    g9 = _bucket_groups(leaves, 9)
+    assert len(g9) <= 5 and [i for g in g9 for i in g] == [0, 1, 2, 3, 4]
+    # fusion_buckets=1 is exactly the legacy single buffer
+    assert _bucket_groups(leaves, 1) == [[0, 1, 2, 3, 4]]
+
+
+def test_fusion_buckets_validation():
+    with pytest.raises(ValueError, match="fusion_buckets"):
+        bf.optim.DistributedOptimizer(optax.sgd(0.1), fusion_buckets=0)
+
+
 @pytest.mark.parametrize("factory,kind", [
     (lambda b: bf.optim.DistributedNeighborAllreduceOptimizer(
         b, compression="bf16"), "neighbor"),
