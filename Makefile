@@ -6,14 +6,14 @@
 PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
-        bench-comm-smoke native telemetry-smoke
+        bench-comm-smoke native telemetry-smoke prof-smoke
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
 # schedule-regression smoke (bench_comm asserts the min-round repack is
 # output-equivalent and never worse than naive — a broken repack fails
 # here loudly, not as a silent slowdown).
-test: test-fast bench-comm-smoke
+test: test-fast bench-comm-smoke prof-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -48,6 +48,15 @@ telemetry-smoke:
 	env JAX_PLATFORMS=cpu \
 	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	    python -m bluefog_tpu.utils.telemetry
+
+# End-to-end profiler check: tiny CPU-backed profiled loop — asserts the
+# bf_step_phase_seconds histogram appears in /metrics, the straggler
+# report in /healthz, and that trace-merge emits valid JSON with one
+# process lane per rank.
+prof-smoke:
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    python -m bluefog_tpu.utils.profiler
 
 native:
 	$(MAKE) -C bluefog_tpu/native

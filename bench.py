@@ -227,13 +227,28 @@ def main():
             params, batch_stats, state, images, labels)
     sync()
 
+    # Per-phase latency histograms (utils/telemetry.observe): dispatch
+    # wall time per step ("optimizer-update" — the whole fused program's
+    # python-side cost) and the per-iteration device sync ("host-sync"),
+    # so BENCH json carries p50/p99 TAIL evidence, not just the mean rate.
+    # A bench-OWNED series: these definitions differ from the step
+    # profiler's canonical bf_step_phase_seconds attribution and must not
+    # pollute it.
+    from bluefog_tpu.utils import telemetry
     rates = []
     for _ in range(iters):
         t0 = time.perf_counter()
         for _ in range(batches_per_iter):
+            t_step = time.perf_counter()
             params, batch_stats, state, loss = step(
                 params, batch_stats, state, images, labels)
+            telemetry.observe("bf_bench_phase_seconds",
+                              time.perf_counter() - t_step,
+                              phase="optimizer-update")
+        t_sync = time.perf_counter()
         sync()
+        telemetry.observe("bf_bench_phase_seconds",
+                          time.perf_counter() - t_sync, phase="host-sync")
         dt = time.perf_counter() - t0
         rates.append(n * batch * batches_per_iter / dt)
 
@@ -247,7 +262,6 @@ def main():
     # the per-rank parameter row size and the dynamic schedule's per-call
     # round/edge average) and ship the snapshot in the JSON.
     from bluefog_tpu.ops import collective as C
-    from bluefog_tpu.utils import telemetry
     steps_run = warmup + iters * batches_per_iter
     tree_bytes = float(sum(x.nbytes for x in jax.tree_util.tree_leaves(
         params)))
@@ -256,6 +270,16 @@ def main():
         op, tree_bytes, size=n, calls=steps_run,
         sched_stats=None if dyn is None else C.schedule_wire_stats(dyn))
     snap = telemetry.snapshot() if telemetry.enabled() else None
+
+    # Tail-latency trajectory for future rounds: per-phase p50/p99 (ms)
+    # from the new step-phase histograms (None when telemetry is off).
+    phase_latency = {}
+    for ph in ("optimizer-update", "host-sync"):
+        pct = telemetry.histogram_percentiles(
+            "bf_bench_phase_seconds", (50.0, 99.0), phase=ph)
+        if pct:
+            phase_latency[ph] = {"p50_ms": round(pct[50.0] * 1e3, 3),
+                                 "p99_ms": round(pct[99.0] * 1e3, 3)}
 
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec_per_chip",
@@ -275,6 +299,7 @@ def main():
             # Accelerator tunnel was down; this is a CPU smoke data point
             # (code-path evidence only), never a throughput claim.
             "cpu_fallback": cpu_fallback,
+            "phase_latency": phase_latency or None,
             "telemetry": snap,
         },
     }))

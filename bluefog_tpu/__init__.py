@@ -120,7 +120,12 @@ from bluefog_tpu.utils.timeline import (  # noqa: F401
     timeline_start_activity,
     timeline_end_activity,
     timeline_context,
+    start_timeline,
+    stop_timeline,
 )
 
 from bluefog_tpu.utils import telemetry  # noqa: F401
 from bluefog_tpu.utils.telemetry import telemetry_snapshot  # noqa: F401
+
+from bluefog_tpu.utils import profiler  # noqa: F401
+from bluefog_tpu.utils.profiler import step_profile  # noqa: F401

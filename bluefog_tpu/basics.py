@@ -606,6 +606,18 @@ def _record_dispatch(key, fn, x) -> None:
         sched_stats=None if sched is None else C.schedule_wire_stats(sched))
 
 
+def _observe_dispatch(key, t0) -> None:
+    """Per-op dispatch wall-time histogram (``bf_comm_dispatch_seconds``):
+    the Python-side cost of one eager collective call — place + jit-cache
+    lookup + async dispatch + any throttle wait.  Device execution time is
+    NOT included (dispatch is async); the blocking side lands in
+    ``bf_comm_sync_seconds`` at :func:`synchronize`."""
+    if t0 is None:
+        return  # disabled path: skip the label render too
+    from bluefog_tpu.utils import telemetry
+    telemetry.observe_since(t0, "bf_comm_dispatch_seconds", op=str(key[0]))
+
+
 def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
     ctx = _require_active()
     def build():
@@ -616,11 +628,15 @@ def _dispatch_flat(key, fn, x, *extra) -> jnp.ndarray:
             run, mesh=ctx.mesh,
             in_specs=(P(RANK_AXIS),) + (P(),) * n_extra,
             out_specs=P(RANK_AXIS)))
+    from bluefog_tpu.utils import telemetry
     from bluefog_tpu.utils.timeline import op_span
     _record_dispatch(key, fn, x)
+    t0 = telemetry.start_timer()
     with op_span(str(key[0]), "ENQUEUE"):
-        return _throttle(
+        out = _throttle(
             _jitted(("flat", key, len(extra)), build)(_place(x), *extra))
+    _observe_dispatch(key, t0)
+    return out
 
 
 def _dispatch_hier(key, fn, x, *extra) -> jnp.ndarray:
@@ -633,11 +649,15 @@ def _dispatch_hier(key, fn, x, *extra) -> jnp.ndarray:
             run, mesh=ctx.hier_mesh,
             in_specs=(P((MACHINE_AXIS, LOCAL_AXIS)),) + (P(),) * n_extra,
             out_specs=P((MACHINE_AXIS, LOCAL_AXIS))))
+    from bluefog_tpu.utils import telemetry
     from bluefog_tpu.utils.timeline import op_span
     _record_dispatch(key, fn, x)
+    t0 = telemetry.start_timer()
     with op_span(str(key[0]), "ENQUEUE"):
-        return _throttle(
+        out = _throttle(
             _jitted(("hier", key, len(extra)), build)(_place(x), *extra))
+    _observe_dispatch(key, t0)
+    return out
 
 
 def _weight_override_matrix(
@@ -1065,11 +1085,14 @@ def wait(handle: Handle) -> jnp.ndarray:
 
 
 def synchronize(handle: Handle) -> jnp.ndarray:
-    from bluefog_tpu.utils import stall
+    from bluefog_tpu.utils import stall, telemetry
     from bluefog_tpu.utils.timeline import op_span
+    t0 = telemetry.start_timer()
     with stall.watch("collective synchronize"), \
             op_span("synchronize", "COMMUNICATE"):
-        return jax.block_until_ready(handle)
+        out = jax.block_until_ready(handle)
+    telemetry.observe_since(t0, "bf_comm_sync_seconds")
+    return out
 
 
 def to_numpy(x) -> np.ndarray:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 from typing import Callable
 
 import numpy as np
@@ -95,15 +96,23 @@ class WindowTransport:
         payload = np.ascontiguousarray(tensor).view(np.uint8).reshape(-1)
         # Guard BEFORE building labels: the disabled path must not pay the
         # per-message f-string/op-name allocations on the gossip hot path.
+        t0 = None
         if telemetry.enabled():
             telemetry.inc("bf_win_tx_msgs_total", op=_op_label(op))
             telemetry.inc("bf_win_tx_bytes_total", float(payload.size),
                           peer=f"{host}:{port}")
+            t0 = time.perf_counter()
         rc = self._lib.bf_winsvc_send(
             host.encode(), port, op, name.encode(), src, dst,
             float(weight), float(p_weight),
             payload.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             payload.size)
+        if t0 is not None:
+            # Per-message RPC latency: serialize + connect/enqueue on the
+            # native client (TCP backpressure shows up here as tail mass).
+            # Guarded so the disabled path skips the label build too.
+            telemetry.observe_since(t0, "bf_win_rpc_seconds",
+                                    op=_op_label(op))
         if rc != 0:
             if telemetry.enabled():
                 telemetry.inc("bf_win_tx_errors_total",
@@ -116,6 +125,7 @@ class WindowTransport:
         from bluefog_tpu.utils import telemetry
         msg = native.WinMsg()
         burst = 0  # consecutive non-empty recvs: inbound-queue depth proxy
+        burst_t0 = 0.0
         while not self._stop.is_set():
             got = self._lib.bf_winsvc_recv(
                 self._svc, ctypes.byref(msg),
@@ -131,9 +141,17 @@ class WindowTransport:
                     # burst length — messages drained back-to-back before
                     # the queue ran dry — is the depth proxy.
                     telemetry.set_gauge("bf_win_rx_queue_depth", burst)
+                    # Burst service time: how long the drain thread spent
+                    # applying back-to-back messages before the queue ran
+                    # dry — tail mass here means inbound gossip arrives
+                    # faster than this host applies it.
+                    telemetry.observe("bf_win_drain_burst_seconds",
+                                      time.perf_counter() - burst_t0)
                     burst = 0
                 self._stop.wait(self._interval)
                 continue
+            if not burst:
+                burst_t0 = time.perf_counter()
             burst += 1
             if telemetry.enabled():  # skip label rendering when off
                 telemetry.inc("bf_win_rx_msgs_total",

@@ -1260,11 +1260,16 @@ def win_wait(handle: int) -> bool:
     if fut is None:
         return True
     from bluefog_tpu.utils import stall
+    t0 = telemetry.start_timer()
     try:
         with stall.watch(f"win_wait(handle={handle})"):
             fut.result()
     except KeyError:
         return False  # window freed while the op was in flight
+    finally:
+        # Host-side latency of one nonblocking window op: queue wait on
+        # the worker pool + the op's own edge sends/replies.
+        telemetry.observe_since(t0, "bf_win_wait_seconds")
     return True
 
 

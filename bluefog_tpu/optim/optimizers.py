@@ -89,6 +89,15 @@ class DistributedOptimizer:
         after ``step`` returns (the usual ``params, state =
         opt.step(params, grads, state)`` rebinding pattern is safe; the
         params argument itself is not donated).
+    profile_every : every N steps, block until the step's device work
+        completes, record the TRUE step wall time into the step-profiler
+        histograms and gather every rank's duration into a straggler
+        report (``bf_straggler_score``, surfaced in ``/healthz`` and
+        ``%bfstat``).  The synced sample costs one host sync + one tiny
+        allgather per period, so it is opt-in: ``None`` defers to
+        ``BLUEFOG_TPU_PROFILE`` / ``BLUEFOG_TPU_PROFILE_EVERY``; 0
+        disables outright.  COLLECTIVE in multi-process runs (every
+        process steps the same loop, so the periods line up).
     """
 
     def __init__(self, base: optax.GradientTransformation,
@@ -99,7 +108,8 @@ class DistributedOptimizer:
                  use_dynamic_topology: bool = False,
                  phases=None, fusion: bool = True,
                  fusion_buckets: Optional[int] = None,
-                 compression: str = "none", donate: bool = False):
+                 compression: str = "none", donate: bool = False,
+                 profile_every: Optional[int] = None):
         if isinstance(communication_type, str):
             communication_type = CommunicationType(communication_type)
         if compression not in ("none", "bf16") and not (
@@ -124,6 +134,11 @@ class DistributedOptimizer:
         # compress_combiner — the reference family's fp16 compression role).
         self.compression = compression
         self.donate = donate
+        if profile_every is not None and int(profile_every) < 0:
+            raise ValueError(
+                f"profile_every must be >= 0, got {profile_every}")
+        self.profile_every = (None if profile_every is None
+                              else int(profile_every))
         self._jitted = {}
         self._steps_seen = 0  # host-side counter for telemetry sampling
 
@@ -232,6 +247,10 @@ class DistributedOptimizer:
         Weight kwargs override the schedule's weights for this step only
         (traced — no recompilation when they change every iteration).
         """
+        import time as _time
+
+        from bluefog_tpu.utils import profiler, telemetry
+        t0 = telemetry.start_timer()
         w = basics._weight_override_matrix(self_weight, src_weights, dst_weights)
         placed = jax.tree.map(basics._place, (params, grads))
         params, grads = placed
@@ -242,7 +261,31 @@ class DistributedOptimizer:
             out = basics._throttle(
                 fn(params, grads, state, jnp.asarray(w, jnp.float32)))
         self._steps_seen += 1
-        from bluefog_tpu.utils import telemetry
+        # DISPATCH wall time (async — device work keeps running); the
+        # synced profile below measures true step latency.
+        telemetry.observe_since(t0, "bf_optimizer_step_seconds",
+                                family="collective")
+        pe = profiler.profile_period(self.profile_every)
+        if pe and self._steps_seen % pe == 0 and t0 is not None:
+            # Synced sample: the step is one fused XLA program, so phase
+            # attribution inside it is impossible — what this measures is
+            # the whole step's true wall time (dispatch-to-done, including
+            # device work queued ahead of it) plus the straggler gather.
+            t_sync = _time.perf_counter()
+            jax.block_until_ready(out)
+            now = _time.perf_counter()
+            outer = profiler.active()
+            if outer is not None:
+                # An enclosing bf.step_profile() owns this step's record:
+                # credit the sync wait to it and let ITS exit record the
+                # (now truly synced) step and gather stragglers — once,
+                # not twice.
+                outer.attribute("host-sync", now - t_sync)
+                outer.request_straggler()
+            else:
+                profiler.record_synced_step(
+                    now - t0, phases={"optimizer-update": t_sync - t0,
+                                      "host-sync": now - t_sync})
         # costs_communication: this sampler adds a combine + host sync,
         # so it only runs when the consensus period was explicitly set.
         k = telemetry.consensus_every(costs_communication=True)

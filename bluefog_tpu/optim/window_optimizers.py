@@ -236,6 +236,33 @@ class _WindowOptimizerBase:
         new_params = jax.tree.map(lambda p, u: p + u, params, updates)
         return new_params, base_state
 
+    @staticmethod
+    def _step_timer():
+        from bluefog_tpu.utils import telemetry
+        return telemetry.start_timer()
+
+    def _record_step_time(self, t0, t: int) -> None:
+        """Step-latency histogram for the async family (the host-side step
+        IS the true wall time — window ops complete before return), plus
+        the periodic cross-rank straggler gather
+        (``BLUEFOG_TPU_PROFILE`` / ``BLUEFOG_TPU_PROFILE_EVERY``).  The
+        gather is collective; every process runs the same step loop, so
+        the periods line up — same contract as the consensus sampler."""
+        from bluefog_tpu.utils import profiler, telemetry
+        dt = telemetry.observe_since(t0, "bf_optimizer_step_seconds",
+                                     family="window")
+        if dt is None:
+            return
+        pe = profiler.profile_period()
+        if pe and (t + 1) % pe == 0:
+            outer = profiler.active()
+            if outer is not None:
+                # An enclosing bf.step_profile() records this step itself;
+                # just make sure exactly one straggler gather happens.
+                outer.request_straggler()
+            else:
+                profiler.record_synced_step(dt)
+
     def _maybe_sample_consensus(self, t: int, payloads, combined) -> None:
         """Consensus-distance gauge for the async family: every K steps
         (``BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY``) record, per owned rank,
@@ -350,6 +377,7 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
 
     def step(self, params, grads, state: DistOptState, *,
              dst_weights=None, require_mutex: bool = True):
+        t0 = self._step_timer()
         new_params, base_state = self._local_adapt(params, grads, state)
         t = int(state.step)
         if (t + 1) % self.num_steps_per_communication == 0:
@@ -371,8 +399,10 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
                         for name in self._names]
             self._maybe_sample_consensus(t, payloads, combined)
             new_params = self._rebuild(combined, params)
-        return (self._merge_owned(params, new_params),
-                DistOptState(base_state, state.step + 1))
+        out = (self._merge_owned(params, new_params),
+               DistOptState(base_state, state.step + 1))
+        self._record_step_time(t0, t)
+        return out
 
     def _drain_pending(self) -> None:
         for h in self._pending:   # overlapped puts must land first
@@ -402,6 +432,7 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
 
     def step(self, params, grads, state: DistOptState, *,
              src_weights=None, require_mutex: bool = True):
+        t0 = self._step_timer()
         new_params, base_state = self._local_adapt(params, grads, state)
         t = int(state.step)
         if (t + 1) % self.num_steps_per_communication == 0:
@@ -422,8 +453,10 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
                         for name in self._names]
             self._maybe_sample_consensus(t, payloads, combined)
             new_params = self._rebuild(combined, params)
-        return (self._merge_owned(params, new_params),
-                DistOptState(base_state, state.step + 1))
+        out = (self._merge_owned(params, new_params),
+               DistOptState(base_state, state.step + 1))
+        self._record_step_time(t0, t)
+        return out
 
 
 class DistributedPushSumOptimizer(_WindowOptimizerBase):
@@ -473,6 +506,7 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
 
     def step(self, params, grads, state: DistOptState, *,
              dst_weights=None, require_mutex: bool = True):
+        t0 = self._step_timer()
         new_params, base_state = self._local_adapt(params, grads, state)
         if dst_weights is None:
             dst_weights = self._outgoing_weights()
@@ -511,8 +545,10 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
                      for name in self._names]
         self._maybe_sample_consensus(t, payloads, collected)
         new_params = self._rebuild(collected, params)
-        return (self._merge_owned(params, new_params),
-                DistOptState(base_state, state.step + 1))
+        out = (self._merge_owned(params, new_params),
+               DistOptState(base_state, state.step + 1))
+        self._record_step_time(t0, t)
+        return out
 
     def collect(self, params, *, require_mutex: bool = True):
         """Fold ALL in-flight gossip into the iterates (evaluation-time

@@ -69,12 +69,24 @@ def bfstat_text() -> str:
     windows = bf.get_current_created_window_names()
     lines.append(
         f"[bfstat] health: {health['status']}"
-        + (f"; overdue: {health['overdue_ops']}"
+        + ("; overdue: " + ", ".join(
+            f"{o['op']} ({o['waited_sec']:.0f}s)"
+            for o in health["overdue_ops"])
            if health["overdue_ops"] else "")
         + (f"; unreachable ranks: {health['unreachable_peer_ranks']}"
            if health.get("unreachable_peer_ranks") else "")
         + (f"; windows: {', '.join(windows)}" if windows else "")
         + (f"; /metrics on :{port}" if port else ""))
+    straggler = health.get("straggler")
+    if straggler:
+        slow = straggler["slowest_rank"]
+        lines.append(
+            f"[bfstat] straggler: score {straggler['straggler_score']:.2f}"
+            f" (x{straggler.get('slowest_over_mean', 1.0):.2f} mean), "
+            f"slowest rank {slow} "
+            f"({straggler['step_seconds'][slow]:.4f}s vs mean "
+            f"{straggler['mean_sec']:.4f}s over "
+            f"{len(straggler['step_seconds'])} ranks)")
     snap = telemetry.snapshot()
     if snap:
         for k in sorted(snap):

@@ -258,6 +258,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve /metrics + /healthz per rank: rank r binds "
                         "port BASE + r (0 = ephemeral everywhere; implies "
                         "--telemetry)")
+    p.add_argument("--profile", action="store_true",
+                   help="enable the distributed step profiler in every "
+                        "rank (sets BLUEFOG_TPU_PROFILE=1; implies "
+                        "--telemetry): periodic synced step samples, "
+                        "phase latency histograms and cross-rank "
+                        "straggler reports every BLUEFOG_TPU_PROFILE_EVERY "
+                        "steps — pair with --timeline and `python -m "
+                        "bluefog_tpu.tools trace-merge` for a merged "
+                        "per-rank trace")
     p.add_argument("--tag-output", action="store_true",
                    help="prefix every output line with [rank] (mpirun "
                         "--tag-output parity); also prevents ranks' lines "
@@ -279,8 +288,10 @@ def _child_env(args, coord: str, rank: int, local_rank: int = 0,
         virtual_mesh_env(env, args.devices_per_proc)
     if args.timeline:
         env["BLUEFOG_TIMELINE"] = args.timeline
-    if args.telemetry or args.telemetry_port is not None:
+    if args.telemetry or args.telemetry_port is not None or args.profile:
         env["BLUEFOG_TPU_TELEMETRY"] = "1"
+    if args.profile:
+        env["BLUEFOG_TPU_PROFILE"] = "1"
     if args.telemetry_port is not None:
         # Distinct port per rank (0 = ephemeral for every rank; the bound
         # port is logged by the endpoint at init).

@@ -1,0 +1,254 @@
+"""Offline trace tooling: merge per-rank timelines, summarize phase tails.
+
+``BLUEFOG_TIMELINE=<prefix>`` makes every process write its own
+chrome-tracing file ``<prefix><rank>.json`` (``utils/timeline.py``) — but
+straggler hunting needs the ranks SIDE BY SIDE on one timeline, which
+``chrome://tracing`` cannot do across files.  This package is the merge
+step the reference never had:
+
+  python -m bluefog_tpu.tools trace-merge <prefix> [-o merged.json]
+      Merge every ``<prefix><rank>.json`` into one trace with one PROCESS
+      LANE per rank (pid = rank, named ``rank N``) and aligned clocks:
+      each rank's timeline starts with a clock-anchor metadata event
+      (``bf_clock_anchor``) pairing its monotonic event clock with wall
+      time, so cross-rank skew in the merged view is real wall-clock skew
+      (up to NTP error), not per-process clock origin noise.  Tolerates
+      and repairs truncated inputs (a killed process never closes its
+      JSON array).
+
+  python -m bluefog_tpu.tools trace-summary <merged.json>
+      Per-phase p50/p95/p99 duration table from a (merged or single-rank)
+      trace's B/E span pairs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import re
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_trace_events", "rank_files", "trace_merge",
+           "phase_durations", "trace_summary", "main"]
+
+_ANCHOR = "bf_clock_anchor"  # timeline.CLOCK_ANCHOR_NAME (no jax import here)
+
+
+def load_trace_events(path: str) -> Tuple[List[dict], bool]:
+    """Parse a chrome-tracing JSON file; returns ``(events, repaired)``.
+
+    Strict parse first; on failure, repair line-by-line — the Python
+    timeline writer emits ``[\\n`` then one JSON object per line separated
+    by ``,\\n``, so a truncated file (process killed before
+    ``stop_timeline``) loses at most its partial tail line."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+        events = data.get("traceEvents", []) if isinstance(data, dict) \
+            else data
+        return [e for e in events if isinstance(e, dict)], False
+    except ValueError:
+        pass
+    events = []
+    body = text.lstrip()
+    if body.startswith("["):
+        body = body[1:]
+    for line in body.splitlines():
+        line = line.strip().rstrip(",")
+        if not line or line == "]":
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue  # the torn tail line of a truncated file
+        if isinstance(ev, dict):
+            events.append(ev)
+    return events, True
+
+
+def rank_files(prefix: str) -> Dict[int, str]:
+    """``{rank: path}`` of the per-rank timelines written under ``prefix``
+    (the ``BLUEFOG_TIMELINE`` naming contract: ``<prefix><rank>.json``)."""
+    out: Dict[int, str] = {}
+    for path in glob.glob(glob.escape(prefix) + "*.json"):
+        m = re.fullmatch(re.escape(prefix) + r"(\d+)\.json", path)
+        if m:
+            out[int(m.group(1))] = path
+    return dict(sorted(out.items()))
+
+
+def _anchor_offset(events: List[dict],
+                   path: Optional[str] = None) -> Optional[int]:
+    """µs to add to this rank's event timestamps to land on the unix-time
+    axis, from its clock-anchor event — or, for the native writer (whose
+    wire format cannot carry the anchor in-band), from the
+    ``<file>.anchor.json`` sidecar.  None when neither exists
+    (pre-anchor files)."""
+    for e in events:
+        if e.get("name") == _ANCHOR and "args" in e:
+            a = e["args"]
+            if "unix_us" in a and "monotonic_us" in a:
+                return int(a["unix_us"]) - int(a["monotonic_us"])
+    if path is not None:
+        try:
+            with open(path + ".anchor.json") as f:
+                a = json.load(f)
+            return int(a["unix_us"]) - int(a["monotonic_us"])
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+    return None
+
+
+def trace_merge(prefix: str, out_path: Optional[str] = None) -> str:
+    """Merge every ``<prefix><rank>.json`` into ``out_path`` (default
+    ``<prefix>merged.json``): one process lane per rank, clocks aligned
+    via the per-rank anchors.  Returns the output path."""
+    files = rank_files(prefix)
+    if not files:
+        raise FileNotFoundError(
+            f"no per-rank timeline files match {prefix}<rank>.json")
+    per_rank: Dict[int, List[dict]] = {}
+    offsets: Dict[int, Optional[int]] = {}
+    repaired_ranks: List[int] = []
+    for rank, path in files.items():
+        events, repaired = load_trace_events(path)
+        per_rank[rank] = events
+        offsets[rank] = _anchor_offset(events, path)
+        if repaired:
+            repaired_ranks.append(rank)
+    # Rebase the merged timeline so t=0 is the earliest aligned event
+    # (chrome renders absolute-µs traces fine, but small numbers are
+    # readable and diffable).
+    aligned_starts = [
+        min((int(e["ts"]) + off for e in evs if "ts" in e), default=None)
+        for r, evs in per_rank.items()
+        if (off := offsets[r]) is not None]
+    base = min((s for s in aligned_starts if s is not None), default=0)
+    merged: List[dict] = []
+    for rank, events in per_rank.items():
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "tid": 0, "ts": 0, "args": {"name": f"rank {rank}"}})
+        merged.append({"name": "process_sort_index", "ph": "M", "pid": rank,
+                       "tid": 0, "ts": 0, "args": {"sort_index": rank}})
+        off = offsets[rank]
+        if off is not None:
+            shift = off - base
+        else:
+            # No anchor: this rank cannot be wall-aligned; rebase its own
+            # first event to t=0 so its lane is at least readable.
+            tmin = min((int(e["ts"]) for e in events if "ts" in e),
+                       default=0)
+            shift = -tmin
+        for e in events:
+            if e.get("name") == _ANCHOR:
+                continue  # consumed; a lane-local M event would just confuse
+            ev = dict(e)
+            ev["pid"] = rank
+            if "ts" in ev:
+                ev["ts"] = int(ev["ts"]) + shift
+            merged.append(ev)
+    if out_path is None:
+        out_path = prefix + "merged.json"
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    unaligned = sorted(r for r, off in offsets.items() if off is None)
+    if unaligned:
+        import sys
+        print(f"trace-merge: rank(s) {unaligned} carry no clock anchor "
+              "(native writer or pre-anchor file); their lanes start at "
+              "t=0 instead of wall-aligned", file=sys.stderr)
+    if repaired_ranks:
+        import sys
+        print(f"trace-merge: repaired truncated input for rank(s) "
+              f"{repaired_ranks}", file=sys.stderr)
+    return out_path
+
+
+def phase_durations(events: List[dict]) -> Tuple[Dict[str, List[float]],
+                                                 int]:
+    """``({span name: [duration µs]}, unmatched_begins)`` from B/E pairs
+    (per pid/tid/cat/name stack, so nested and concurrent spans pair
+    correctly) and complete ``X`` events.
+
+    ``unmatched_begins`` counts B events whose E never arrived — dropped
+    under writer-queue overload or lost to file truncation.  Nonzero means
+    some durations for those span keys may be unreliable (a later E can
+    pair with a stale B and absorb the gap), so the summary must say so
+    rather than report an inflated tail silently."""
+    stacks: Dict[tuple, List[int]] = {}
+    durs: Dict[str, List[float]] = {}
+    for e in sorted((e for e in events if "ts" in e),
+                    key=lambda e: int(e["ts"])):
+        ph = e.get("ph")
+        name = e.get("name", "?")
+        if ph == "X":
+            durs.setdefault(name, []).append(float(e.get("dur", 0)))
+            continue
+        key = (e.get("pid"), e.get("tid"), e.get("cat"), name)
+        if ph == "B":
+            stacks.setdefault(key, []).append(int(e["ts"]))
+        elif ph == "E":
+            st = stacks.get(key)
+            if st:
+                durs.setdefault(name, []).append(float(int(e["ts"])
+                                                       - st.pop()))
+    unmatched = sum(len(st) for st in stacks.values())
+    return durs, unmatched
+
+
+def trace_summary(path: str) -> str:
+    """Per-phase p50/p95/p99 table (text) from a trace file's spans."""
+    import numpy as np
+    events, _ = load_trace_events(path)
+    durs, unmatched = phase_durations(events)
+    if not durs:
+        return "trace-summary: no complete spans found"
+    rows = []
+    for name in sorted(durs, key=lambda n: -sum(durs[n])):
+        d = np.asarray(durs[name])
+        p50, p95, p99 = np.percentile(d, [50, 95, 99])
+        rows.append((name, len(d), d.sum() / 1e3, p50 / 1e3, p95 / 1e3,
+                     p99 / 1e3))
+    width = max(len(r[0]) for r in rows)
+    header = (f"{'phase':<{width}}  {'count':>7}  {'total_ms':>10}  "
+              f"{'p50_ms':>9}  {'p95_ms':>9}  {'p99_ms':>9}")
+    lines = [header, "-" * len(header)]
+    for name, cnt, tot, p50, p95, p99 in rows:
+        lines.append(f"{name:<{width}}  {cnt:>7}  {tot:>10.3f}  "
+                     f"{p50:>9.3f}  {p95:>9.3f}  {p99:>9.3f}")
+    if unmatched:
+        lines.append(
+            f"WARNING: {unmatched} begin event(s) have no matching end "
+            "(dropped under writer overload or truncation) — tail "
+            "percentiles for their phases may be inflated")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m bluefog_tpu.tools", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    pm = sub.add_parser(
+        "trace-merge",
+        help="merge per-rank BLUEFOG_TIMELINE files into one aligned trace")
+    pm.add_argument("prefix", help="the BLUEFOG_TIMELINE prefix the run "
+                                   "used (files are <prefix><rank>.json)")
+    pm.add_argument("-o", "--output", default=None,
+                    help="output path (default <prefix>merged.json)")
+    ps = sub.add_parser(
+        "trace-summary",
+        help="per-phase p50/p95/p99 table from a (merged) trace")
+    ps.add_argument("trace", help="trace JSON file (merged or single-rank)")
+    args = parser.parse_args(argv)
+    if args.cmd == "trace-merge":
+        out = trace_merge(args.prefix, args.output)
+        events, _ = load_trace_events(out)
+        lanes = sorted({e.get("pid") for e in events})
+        print(f"trace-merge: wrote {out} ({len(events)} events, "
+              f"{len(lanes)} rank lane(s))")
+        return 0
+    print(trace_summary(args.trace))
+    return 0

@@ -1,0 +1,8 @@
+"""``python -m bluefog_tpu.tools`` — trace-merge / trace-summary CLI."""
+
+import sys
+
+from bluefog_tpu.tools import main
+
+if __name__ == "__main__":
+    sys.exit(main())

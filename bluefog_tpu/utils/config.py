@@ -19,6 +19,8 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_TELEMETRY         | 1     | 0: disable the metric registry entirely |
 | BLUEFOG_TPU_TELEMETRY_PORT    | unset | serve /metrics + /healthz (0=ephemeral) |
 | BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY | 10 | consensus-distance sample period (0=off) |
+| BLUEFOG_TPU_PROFILE           | 0     | 1: enable the step profiler's periodic sampling |
+| BLUEFOG_TPU_PROFILE_EVERY     | 50    | straggler-gather / synced-sample period (steps) |
 | BLUEFOG_TPU_SCHEDULE_OPT      | 1     | 0: skip the min-round schedule repack |
 | BLUEFOG_TPU_FUSION_BUCKET_MB  | 0     | fusion-buffer bucket cap in MiB (0=one bucket) |
 | BFTPU_COORDINATOR             | unset | set by bfrun: coordinator host:port |
@@ -84,6 +86,12 @@ class Config:
     # that COST communication (the collective optimizer family) stay off
     # unless the operator asked; free samplers use the default period.
     telemetry_consensus_set: bool
+    # Step profiler (utils/profiler.py): profile=1 turns on periodic
+    # synced-step sampling + cross-rank straggler gathers at period
+    # profile_every; an explicit profile_every= argument on the optimizer
+    # overrides both.  bf.step_profile() works regardless of this flag.
+    profile: bool
+    profile_every: int
 
     @staticmethod
     def from_env() -> "Config":
@@ -111,6 +119,9 @@ class Config:
             schedule_opt=_flag("BLUEFOG_TPU_SCHEDULE_OPT", default=True),
             fusion_bucket_mb=float(
                 os.environ.get("BLUEFOG_TPU_FUSION_BUCKET_MB", "0")),
+            profile=_flag("BLUEFOG_TPU_PROFILE"),
+            profile_every=int(
+                os.environ.get("BLUEFOG_TPU_PROFILE_EVERY", "50")),
         )
 
 

@@ -58,6 +58,10 @@ __all__ = [
     "enabled",
     "inc",
     "set_gauge",
+    "observe",
+    "start_timer",
+    "observe_since",
+    "histogram_percentiles",
     "snapshot",
     "telemetry_snapshot",
     "aggregate_snapshot",
@@ -76,7 +80,7 @@ _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 
 
 class _Registry:
-    """Process-local metric store.  One lock, two dicts — mutation is a
+    """Process-local metric store.  One lock, three dicts — mutation is a
     guarded dict add under the GIL-scale lock; the hot comm paths already
     pay a python dispatch, so this is noise next to them."""
 
@@ -84,6 +88,11 @@ class _Registry:
         self.lock = threading.Lock()
         self.counters: Dict[_Key, float] = {}
         self.gauges: Dict[_Key, float] = {}
+        # Histograms: key -> [per-bucket counts (len(_HIST_BUCKETS) + 1,
+        # last = overflow), running sum].  Buckets are FIXED and log-spaced
+        # (below) so cross-rank merge is elementwise addition — no
+        # per-series boundary negotiation.
+        self.hists: Dict[_Key, list] = {}
 
 
 _registry = _Registry()
@@ -120,11 +129,101 @@ def set_gauge(name: str, value: float, **labels) -> None:
         _registry.gauges[key] = float(value)
 
 
+# Log-spaced latency bucket boundaries, 1 µs .. 50 s (observations are
+# SECONDS).  Fixed for every histogram series: one shared boundary table
+# keeps observe() at a single bisect (≤ ~1µs) and makes the cross-rank
+# merge a blind elementwise add.  The 1-2.5-5 ladder gives ~3 buckets per
+# decade — enough resolution to separate p50 from p99 without label bloat.
+_HIST_BUCKETS: Tuple[float, ...] = tuple(
+    float(f"{m}e{e}")  # decimal literals: no float noise in the le labels
+    for e in range(-6, 2) for m in ("1", "2.5", "5"))
+
+
+def observe(name: str, value_seconds: float, **labels) -> None:
+    """Record one observation into a fixed-bucket latency histogram
+    (no-op when disabled — no registry mutation, nothing rendered).
+
+    Renders at snapshot/scrape time as the Prometheus histogram triple:
+    cumulative ``<name>_bucket{le=...}`` series, ``<name>_sum`` and
+    ``<name>_count``.  Merged across ranks by :func:`aggregate_snapshot`
+    (bucket counts and sums ADD, like counters)."""
+    if not config.get().telemetry:
+        return
+    import bisect
+    key = _key(name, labels)
+    i = bisect.bisect_left(_HIST_BUCKETS, value_seconds)
+    with _registry.lock:
+        h = _registry.hists.get(key)
+        if h is None:
+            h = _registry.hists[key] = [[0] * (len(_HIST_BUCKETS) + 1), 0.0]
+        h[0][i] += 1
+        h[1] += value_seconds
+
+
+def start_timer() -> Optional[float]:
+    """``perf_counter()`` when the registry records, else None — the one
+    guard-then-time idiom every latency-histogram site uses (pair with
+    :func:`observe_since`)."""
+    if not config.get().telemetry:
+        return None
+    import time
+    return time.perf_counter()
+
+
+def observe_since(t0: Optional[float], name: str,
+                  **labels) -> Optional[float]:
+    """Record elapsed seconds since a :func:`start_timer` stamp into the
+    named histogram; no-op (returns None) when the stamp is None —
+    telemetry was off at start, so nothing is recorded even if it was
+    toggled since.  Returns the elapsed seconds otherwise."""
+    if t0 is None:
+        return None
+    import time
+    dt = time.perf_counter() - t0
+    observe(name, dt, **labels)
+    return dt
+
+
+def histogram_percentiles(name: str, qs=(50.0, 95.0, 99.0),
+                          **labels) -> Optional[Dict[float, float]]:
+    """Approximate percentiles of a recorded histogram (``{q: seconds}``),
+    linearly interpolated within the containing bucket.  Quantiles landing
+    in the overflow bucket report the largest finite boundary (the
+    histogram cannot resolve beyond it).  None when the series has no
+    observations."""
+    key = _key(name, labels)
+    with _registry.lock:
+        h = _registry.hists.get(key)
+        if h is None:
+            return None
+        counts = list(h[0])
+    total = sum(counts)
+    if total == 0:
+        return None
+    out: Dict[float, float] = {}
+    for q in qs:
+        target = total * q / 100.0
+        cum = 0.0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target:
+                if i >= len(_HIST_BUCKETS):      # overflow bucket
+                    out[q] = _HIST_BUCKETS[-1]
+                else:
+                    lo = _HIST_BUCKETS[i - 1] if i else 0.0
+                    hi = _HIST_BUCKETS[i]
+                    frac = (target - (cum - c)) / c
+                    out[q] = lo + (hi - lo) * frac
+                break
+    return out
+
+
 def reset() -> None:
     """Drop every series (tests; a production registry is append-only)."""
     with _registry.lock:
         _registry.counters.clear()
         _registry.gauges.clear()
+        _registry.hists.clear()
 
 
 def _render_key(key: _Key) -> str:
@@ -135,12 +234,36 @@ def _render_key(key: _Key) -> str:
     return f"{name}{{{inner}}}"
 
 
+def _fmt_le(b: float) -> str:
+    """Bucket-boundary rendering for the ``le`` label (Prometheus spells
+    the overflow bucket ``+Inf``)."""
+    return "+Inf" if b == float("inf") else _fmt_value(b)
+
+
+def _flatten_hist(out: Dict[str, float], key: _Key, counts, total_sum) -> None:
+    """Append one histogram's ``_bucket``/``_sum``/``_count`` series (the
+    Prometheus triple, cumulative buckets) to a flat snapshot dict."""
+    name, labels = key
+    cum = 0
+    for b, c in zip(tuple(_HIST_BUCKETS) + (float("inf"),), counts):
+        cum += c
+        le_key = (name + "_bucket",
+                  tuple(sorted(labels + (("le", _fmt_le(b)),))))
+        out[_render_key(le_key)] = float(cum)
+    out[_render_key((name + "_sum", labels))] = float(total_sum)
+    out[_render_key((name + "_count", labels))] = float(cum)
+
+
 def snapshot() -> Dict[str, float]:
     """Flat ``{rendered_series: value}`` dict of the process-local registry
-    (counters and gauges together; counter names end in ``_total``)."""
+    (counters and gauges together; counter names end in ``_total``;
+    histograms render as their ``_bucket``/``_sum``/``_count`` triple)."""
     with _registry.lock:
         out = {_render_key(k): v for k, v in _registry.counters.items()}
         out.update({_render_key(k): v for k, v in _registry.gauges.items()})
+        hists = {k: (list(h[0]), h[1]) for k, h in _registry.hists.items()}
+    for k, (counts, s) in sorted(hists.items()):
+        _flatten_hist(out, k, counts, s)
     emit_timeline_counters()
     return out
 
@@ -150,13 +273,46 @@ def _raw_series() -> Tuple[Dict[_Key, float], Dict[_Key, float]]:
         return dict(_registry.counters), dict(_registry.gauges)
 
 
+def _raw_hists() -> Dict[_Key, tuple]:
+    with _registry.lock:
+        return {k: (list(h[0]), h[1]) for k, h in _registry.hists.items()}
+
+
 # ---------------------------------------------------------------------------
 # Cross-rank aggregation (rides the collective path, like metric_average)
 # ---------------------------------------------------------------------------
 
+def _merge_records(records: List[dict]) -> Dict[str, float]:
+    """Merge per-process registry records (the aggregate wire rows) into
+    one flat snapshot: counters summed, gauges maxed, histogram bucket
+    counts and sums added elementwise.  Pure — unit-testable without a
+    gang."""
+    agg_c: Dict[_Key, float] = {}
+    agg_g: Dict[_Key, float] = {}
+    agg_h: Dict[_Key, list] = {}
+    for rec in records:
+        for name, labels, v in rec.get("c", []):
+            k = (name, tuple((a, b) for a, b in labels))
+            agg_c[k] = agg_c.get(k, 0.0) + v
+        for name, labels, v in rec.get("g", []):
+            k = (name, tuple((a, b) for a, b in labels))
+            agg_g[k] = max(agg_g.get(k, float("-inf")), v)
+        for name, labels, counts, s in rec.get("h", []):
+            k = (name, tuple((a, b) for a, b in labels))
+            h = agg_h.setdefault(k, [[0] * len(counts), 0.0])
+            for i, c in enumerate(counts):
+                h[0][i] += c
+            h[1] += s
+    out = {_render_key(k): v for k, v in agg_c.items()}
+    out.update({_render_key(k): v for k, v in agg_g.items()})
+    for k, h in sorted(agg_h.items()):
+        _flatten_hist(out, k, h[0], h[1])
+    return out
+
+
 def aggregate_snapshot() -> Dict[str, float]:
-    """Cluster-wide snapshot: counters SUMMED and gauges MAXed across every
-    process's registry.
+    """Cluster-wide snapshot: counters SUMMED, gauges MAXed and histograms
+    bucket-merged across every process's registry.
 
     COLLECTIVE in multi-process runs — every process must call it together
     (it rides ``bf.allgather`` exactly like ``metric_average`` rides
@@ -171,10 +327,12 @@ def aggregate_snapshot() -> Dict[str, float]:
         return snapshot()
     import numpy as np
     counters, gauges = _raw_series()
+    hists = _raw_hists()
     blob = json.dumps({
         "proc": jax.process_index(),
         "c": [[k[0], list(k[1]), v] for k, v in counters.items()],
         "g": [[k[0], list(k[1]), v] for k, v in gauges.items()],
+        "h": [[k[0], list(k[1]), h[0], h[1]] for k, h in hists.items()],
     }).encode()
     n = basics.size()
     # Agree on the row width first (one tiny allgather): registries differ
@@ -191,8 +349,7 @@ def aggregate_snapshot() -> Dict[str, float]:
     # the output is all ranks' blobs back to back.
     gathered = np.asarray(basics.to_numpy(
         basics.allgather(rows)))[0].reshape(n, width)
-    agg_c: Dict[_Key, float] = {}
-    agg_g: Dict[_Key, float] = {}
+    records = []
     seen_procs = set()
     for r in range(n):
         raw = bytes(gathered[r]).rstrip(b"\0")
@@ -202,15 +359,8 @@ def aggregate_snapshot() -> Dict[str, float]:
         if rec["proc"] in seen_procs:  # one registry per process, not rank
             continue
         seen_procs.add(rec["proc"])
-        for name, labels, v in rec["c"]:
-            k = (name, tuple((a, b) for a, b in labels))
-            agg_c[k] = agg_c.get(k, 0.0) + v
-        for name, labels, v in rec["g"]:
-            k = (name, tuple((a, b) for a, b in labels))
-            agg_g[k] = max(agg_g.get(k, float("-inf")), v)
-    out = {_render_key(k): v for k, v in agg_c.items()}
-    out.update({_render_key(k): v for k, v in agg_g.items()})
-    return out
+        records.append(rec)
+    return _merge_records(records)
 
 
 def telemetry_snapshot(aggregate: bool = False) -> Dict[str, float]:
@@ -239,7 +389,8 @@ def _fmt_value(v: float) -> str:
 
 def render_prometheus() -> str:
     """The process-local registry in Prometheus text exposition format
-    (``# TYPE`` per family; ``*_total`` series are counters)."""
+    (``# TYPE`` per family; ``*_total`` series are counters; histograms
+    render as cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``)."""
     counters, gauges = _raw_series()
     lines: List[str] = []
     for store, mtype in ((counters, "counter"), (gauges, "gauge")):
@@ -250,6 +401,16 @@ def render_prometheus() -> str:
             lines.append(f"# TYPE {name} {mtype}")
             for key, v in series:
                 lines.append(f"{_render_key(key)} {_fmt_value(v)}")
+    hfamilies: Dict[str, list] = {}
+    for key, h in sorted(_raw_hists().items()):
+        hfamilies.setdefault(key[0], []).append((key, h))
+    for name, series in hfamilies.items():
+        lines.append(f"# TYPE {name} histogram")
+        for key, (counts, s) in series:
+            flat: Dict[str, float] = {}
+            _flatten_hist(flat, key, counts, s)
+            for rendered, v in flat.items():
+                lines.append(f"{rendered} {_fmt_value(v)}")
     return "\n".join(lines) + "\n"
 
 
@@ -259,7 +420,9 @@ def render_prometheus() -> str:
 
 def health() -> dict:
     """Liveness summary for ``/healthz``: overdue blocking waits from the
-    stall monitor and the window transport's unreachable-peer probe."""
+    stall monitor, the window transport's unreachable-peer probe, and —
+    when the step profiler has gathered one — the latest cross-rank
+    straggler report (``bf_straggler_score`` gauge + slowest rank)."""
     from bluefog_tpu.utils import stall
     overdue = stall._monitor.overdue_ops()
     body = {
@@ -268,6 +431,10 @@ def health() -> dict:
                         for name, sec in overdue],
         "stall_threshold_sec": config.get().stall_warning_sec,
     }
+    from bluefog_tpu.utils import profiler
+    straggler = profiler.last_straggler_report()
+    if straggler is not None:
+        body["straggler"] = straggler
     probe = stall._peer_probe
     if probe is not None:
         try:

@@ -15,6 +15,7 @@ reference ``basics.py:415-495``).
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import queue
@@ -35,6 +36,8 @@ __all__ = [
     "flush",
     "counter_event",
     "counter_events_supported",
+    "set_op_span_hook",
+    "CLOCK_ANCHOR_NAME",
 ]
 
 _TRACE_EVENT_SENTINEL = None
@@ -83,6 +86,7 @@ class _NativeTimelineWriter:
 
     def __init__(self, path: str):
         from bluefog_tpu import native
+        self.path = path
         self._lib = native.lib()
         assert self._lib is not None
         self._h = self._lib.bf_timeline_open(path.encode(), os.getpid())
@@ -141,13 +145,51 @@ def timeline_enabled() -> bool:
     return _writer is not None
 
 
+# Clock-anchor metadata event name: emitted once at timeline start, pairs
+# this process's monotonic event clock with wall time so the trace-merge
+# tool (``python -m bluefog_tpu.tools trace-merge``) can align per-rank
+# traces onto one timeline.
+CLOCK_ANCHOR_NAME = "bf_clock_anchor"
+
+_atexit_installed = False
+
+
+def _emit_clock_anchor() -> None:
+    w = _writer
+    if w is None:
+        return
+    mono_us = time.monotonic_ns() // 1000
+    args = {"monotonic_us": mono_us, "unix_us": time.time_ns() // 1000,
+            "rank": _process_index()}
+    if hasattr(w, "q"):
+        w.emit({"name": CLOCK_ANCHOR_NAME, "ph": "M", "ts": mono_us,
+                "pid": os.getpid(), "tid": 0, "args": args})
+        return
+    # Native writer: its wire format carries no args payload, so the
+    # anchor rides a SIDECAR file trace-merge also reads — wall alignment
+    # must not silently degrade on the default (native) writer.
+    try:
+        with open(w.path + ".anchor.json", "w") as f:
+            json.dump(args, f)
+    except OSError:
+        pass  # tracing must never take the job down; merge will warn
+
+
 def start_timeline(path: str) -> bool:
     """Begin writing a chrome-tracing file (parity: ``bf.timeline_start``)."""
-    global _writer
+    global _writer, _atexit_installed
     with _lock:
         if _writer is not None:
             return False
         _writer = _make_writer(path)
+        if not _atexit_installed:
+            # A process that never calls stop_timeline() must still close
+            # the JSON array on normal interpreter exit — a truncated file
+            # fails strict parsers (the trace-merge tool repairs them, but
+            # nothing else does).
+            atexit.register(stop_timeline)
+            _atexit_installed = True
+    _emit_clock_anchor()
     return True
 
 
@@ -242,24 +284,53 @@ def counter_event(name: str, value: float, cat: str = "telemetry") -> None:
             "tid": 0, "args": {"value": float(value)}})
 
 
+# Installed by utils.profiler while a StepProfiler is active: called as
+# ``hook(op_name, phase, seconds)`` for every completed TOP-LEVEL op span
+# so the profiler can attribute step time to phases even with no timeline
+# file.  Only outermost spans report (per-thread depth gate below): the
+# window family nests per-edge COMMUNICATE spans inside the op-level span,
+# and reporting both would double-count the same wall time.
+_span_hook = None
+_span_depth = threading.local()
+
+
+def set_op_span_hook(hook) -> None:
+    """Register (or clear, with ``None``) the op-span duration observer."""
+    global _span_hook
+    _span_hook = hook
+
+
 @contextmanager
 def op_span(op_name: str, phase: str):
     """Framework-internal op-phase span (ENQUEUE/COMMUNICATE/UPDATE...):
     the automatic analogue of the reference's per-phase ActivityStart/End
     hooks (``mpi_controller.cc:540-561``).  Near-zero cost when tracing is
-    off (one module-global check, no autostart probe)."""
-    if _writer is None and not os.environ.get("BLUEFOG_TIMELINE"):
+    off and no profiler is active (two module-global checks, no autostart
+    probe)."""
+    hook = _span_hook
+    if hook is None and _writer is None \
+            and not os.environ.get("BLUEFOG_TIMELINE"):
         yield
         return
     _maybe_autostart()
     w = _writer
-    if w is None:
+    if w is None and hook is None:
         yield
         return
+    counted = hook is not None
+    if counted:
+        _span_depth.d = getattr(_span_depth, "d", 0) + 1
+        t0 = time.perf_counter()
     base = {"name": phase, "cat": op_name, "pid": os.getpid(),
             "tid": threading.get_ident()}
-    w.emit({**base, "ph": "B", "ts": time.monotonic_ns() // 1000})
+    if w is not None:
+        w.emit({**base, "ph": "B", "ts": time.monotonic_ns() // 1000})
     try:
         yield
     finally:
-        w.emit({**base, "ph": "E", "ts": time.monotonic_ns() // 1000})
+        if w is not None:
+            w.emit({**base, "ph": "E", "ts": time.monotonic_ns() // 1000})
+        if counted:
+            _span_depth.d -= 1
+            if _span_depth.d == 0 and _span_hook is not None:
+                _span_hook(op_name, phase, time.perf_counter() - t0)
