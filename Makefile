@@ -6,14 +6,14 @@
 PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
-        bench-comm-smoke native telemetry-smoke prof-smoke
+        bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
 # schedule-regression smoke (bench_comm asserts the min-round repack is
 # output-equivalent and never worse than naive — a broken repack fails
 # here loudly, not as a silent slowdown).
-test: test-fast bench-comm-smoke prof-smoke
+test: test-fast bench-comm-smoke prof-smoke transport-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -57,6 +57,14 @@ prof-smoke:
 	env JAX_PLATFORMS=cpu \
 	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	    python -m bluefog_tpu.utils.profiler
+
+# CPU-runnable loopback two-transport exchange over the coalesced DCN
+# path: asserts batched delivery actually happened (OP_BATCH frames on
+# the wire, vectorized drain) and that the batch telemetry series exist.
+# No timing assertion — `make bench-comm` style full runs check the >= 2x
+# messages/s win (bench_comm.py --transport).
+transport-smoke:
+	python bench_comm.py --transport-smoke
 
 native:
 	$(MAKE) -C bluefog_tpu/native

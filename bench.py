@@ -45,6 +45,18 @@ def _cpu_fallback_or_exit(reason: str) -> bool:
     import sys
     if os.environ.get("BLUEFOG_TPU_BENCH_ALLOW_CPU") not in (
             "1", "true", "True", "yes"):  # same spellings as config._flag
+        # Still emit a BENCH artifact (status: no_backend, value null) so
+        # the perf trajectory records the attempt — BENCH_r05 had three
+        # rounds with NO artifact because this path printed only stderr.
+        # rc stays 3: a null-valued JSON is evidence of the outage, never
+        # a throughput claim a driver could mistake for success.
+        print(json.dumps({
+            "metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": None,
+            "unit": "img/s/chip",
+            "status": "no_backend",
+            "detail": {"reason": reason},
+        }))
         raise SystemExit(3)
     print(f"bench: {reason} — BLUEFOG_TPU_BENCH_ALLOW_CPU=1 set, falling "
           "back to a CPU smoke run (metric will be labeled backend=cpu)",

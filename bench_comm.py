@@ -8,6 +8,17 @@ that matter — shift-structured (ring, Exp2: already optimal, the repack
 must be a no-op), star (irregular hub) and random-regular (the stress
 case: ~n naive rounds vs degree optimal).
 
+``--transport`` / ``--transport-smoke`` instead run the DCN window
+transport loopback microbench (no jax needed): two ``WindowTransport``
+endpoints on localhost exchange small gossip rows with coalescing OFF
+(one blocking native RPC + one Python apply per row — the legacy path)
+vs ON (per-peer batching, OP_BATCH frames, vectorized zero-copy drain),
+reporting end-to-end messages/s and MB/s for both.  The smoke variant is
+the CI gate (``make transport-smoke``): tiny counts, asserts batched
+delivery actually happened and the batch metrics exist, no timing
+assertion (shared CI boxes jitter); the full variant asserts the >= 2x
+messages/s win for 4 KB rows that motivated the tentpole.
+
 CPU-runnable by design: ppermute schedules compile and execute on the
 virtual host-platform mesh, so schedule regressions are caught by
 ``make bench-comm-smoke`` with no accelerator attached.  On CPU the script
@@ -47,11 +58,149 @@ def _parse_args():
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--smoke", action="store_true",
                    help="tiny fast configuration for CI (n=8, few iters)")
+    p.add_argument("--transport", action="store_true",
+                   help="run the window-transport loopback microbench "
+                        "(coalescing on vs off) instead of the schedule "
+                        "bench; asserts the >= 2x messages/s win")
+    p.add_argument("--transport-smoke", action="store_true",
+                   help="tiny CI variant of --transport: asserts batched "
+                        "delivery + metric presence, no timing assertion")
+    p.add_argument("--rows", type=int, default=5000,
+                   help="transport bench: messages per mode (default 5000)")
+    p.add_argument("--row-bytes", type=int, default=4096,
+                   help="transport bench: payload bytes per message "
+                        "(default 4096 — the small-gossip-row regime)")
     return p.parse_args()
+
+
+def _transport_one_mode(coalesce: bool, rows: int, row_bytes: int) -> dict:
+    """Loopback exchange of ``rows`` messages in one mode; returns rates.
+
+    End-to-end timing: the clock stops when the LAST message has been
+    applied at the receiver, so the drain side (per-message Python apply
+    vs vectorized batch apply) is part of what's measured — exactly the
+    two halves the tentpole rebuilt."""
+    import threading
+
+    import numpy as np
+
+    from bluefog_tpu.ops.transport import OP_ACCUMULATE, WindowTransport
+    from bluefog_tpu.utils import config
+
+    os.environ["BLUEFOG_TPU_WIN_COALESCE"] = "1" if coalesce else "0"
+    # Long linger: the bench flushes explicitly (as window ops do at op
+    # boundaries), so batch sizes reflect the queue, not the clock.
+    os.environ.setdefault("BLUEFOG_TPU_WIN_COALESCE_LINGER_MS", "5")
+    config.reload()
+
+    state = {"n": 0, "batches": 0}
+    done = threading.Event()
+    target = [0]
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        state["n"] += 1
+        if state["n"] >= target[0]:
+            done.set()
+
+    def apply_batch(msgs):
+        state["batches"] += 1
+        for m in msgs:
+            apply(*m)
+
+    server = WindowTransport(apply, apply_batch=apply_batch,
+                             drain_interval=0.0005)
+    client = WindowTransport(lambda *a: None)
+    try:
+        row = np.arange(row_bytes // 4, dtype=np.float32)
+        host, port = "127.0.0.1", server.port
+
+        def exchange(count):
+            done.clear()
+            target[0] = state["n"] + count
+            if state["n"] >= target[0]:
+                done.set()
+            t0 = time.perf_counter()
+            for _ in range(count):
+                client.send(host, port, OP_ACCUMULATE, "bench", 0, 1,
+                            1.0, row)
+            client.flush()
+            assert done.wait(timeout=120), \
+                f"only {state['n']}/{target[0]} messages arrived"
+            return time.perf_counter() - t0
+
+        exchange(min(rows // 10 + 1, 200))  # warm the connection pool
+        dt = exchange(rows)
+        return {
+            "coalesce": coalesce,
+            "msgs_per_s": round(rows / dt, 1),
+            "mb_per_s": round(rows * row_bytes / dt / 1e6, 2),
+            "batches_seen": state["batches"],
+        }
+    finally:
+        client.stop()
+        server.stop()
+        config.reload()
+
+
+def transport_main(args) -> int:
+    """Loopback transport microbench (and the `make transport-smoke` CI
+    gate): coalescing off vs on, same wire, same rows."""
+    import sys
+
+    from bluefog_tpu import native
+    from bluefog_tpu.utils import telemetry
+
+    smoke = args.transport_smoke
+    rows = min(args.rows, 400) if smoke else args.rows
+    if not native.available():
+        print(json.dumps({
+            "metric": "win_transport_coalesce_speedup",
+            "value": None, "unit": "x", "status": "no_native",
+            "detail": {"reason": "native core not built"}}))
+        return 0 if smoke else 2
+
+    off = _transport_one_mode(False, rows, args.row_bytes)
+    assert off["batches_seen"] == 0, \
+        "legacy path must not deliver batch frames"
+    on = _transport_one_mode(True, rows, args.row_bytes)
+    assert on["batches_seen"] > 0, \
+        "coalescing on but no batch frame arrived"
+
+    snap = telemetry.snapshot() if telemetry.enabled() else {}
+    batches = snap.get("bf_win_tx_batches_total", 0)
+    batched_msgs = snap.get("bf_win_tx_batched_msgs_total", 0)
+    assert batches > 0 and batched_msgs > batches, (
+        "batch metrics missing or degenerate: "
+        f"batches={batches} msgs={batched_msgs}")
+    for series in ("bf_win_tx_batch_size_count", "bf_win_tx_coalesce_ratio"):
+        assert any(k.startswith(series) for k in snap), \
+            f"expected telemetry series {series!r} after a coalesced run"
+
+    ratio = on["msgs_per_s"] / max(off["msgs_per_s"], 1e-9)
+    if not smoke and ratio < 2.0:
+        print(f"bench_comm: coalescing speedup {ratio:.2f}x < 2x for "
+              f"{args.row_bytes}-byte rows", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "metric": "win_transport_coalesce_speedup",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "detail": {
+            "rows": rows,
+            "row_bytes": args.row_bytes,
+            "smoke": smoke,
+            "off": off,
+            "on": on,
+            "avg_batch_msgs": round(batched_msgs / batches, 1),
+        },
+    }))
+    return 0
 
 
 def main():
     args = _parse_args()
+    if args.transport or args.transport_smoke:
+        return transport_main(args)
     if args.smoke:
         args.n = args.n or 8
         args.payload = min(args.payload, 1024)

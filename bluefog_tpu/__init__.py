@@ -104,6 +104,7 @@ from bluefog_tpu.ops.window import (  # noqa: F401
     win_poll,
     win_mutex,
     win_fence,
+    win_flush,
     win_state_dict,
     win_load_state_dict,
     get_win_version,

@@ -62,7 +62,8 @@ typedef struct bf_winsvc bf_winsvc_t;
 
 /* Inbound message, drained by the host framework (Python window store). */
 typedef struct {
-  uint8_t op;          /* 1=put 2=accumulate 3=get_request */
+  uint8_t op;          /* opaque; ops/transport.py defines the codes
+                        * (1=put 2=accumulate ... 10=batch container) */
   int32_t src;
   int32_t dst;
   double weight;
@@ -82,8 +83,10 @@ int32_t bf_winsvc_port(bf_winsvc_t* s);
 int32_t bf_winsvc_recv(bf_winsvc_t* s, bf_win_msg_t* msg, uint8_t* payload,
                        uint64_t cap);
 
-/* Send a one-sided message to host:port (blocking; pooled connections).
- * Returns 0 on success, negative errno-style code on failure. */
+/* Send a one-sided message to host:port (blocking; pooled connections;
+ * the whole frame leaves in one sendmsg).  Returns 0 on success, negative
+ * code on failure (-1 resolve, -2 connect, -3 write, -4 name too long
+ * for the receiver's 128-byte field — deterministic, don't retry). */
 int32_t bf_winsvc_send(const char* host, int32_t port, uint8_t op,
                        const char* name, int32_t src, int32_t dst,
                        double weight, double p_weight, const uint8_t* payload,

@@ -12,18 +12,36 @@ This is the structural analogue of the reference's NCCL window machinery
 requests, with the control plane folded into the data message (no MPI
 request/ack/done handshake needed because TCP already orders and backpressures
 the stream).
+
+Coalescing (default on, ``BLUEFOG_TPU_WIN_COALESCE=0`` restores the legacy
+per-message path): ``send()`` enqueues onto a bounded per-peer queue serviced
+by one sender worker per peer — parallel across neighbors, blocking
+backpressure when full.  A worker flushes its queue as a single ``OP_BATCH``
+wire frame (version-flagged sub-message stream, many puts in one native
+send) on a byte threshold, a short linger timeout, an "urgent" op (fence /
+mutex / get traffic), or an explicit :meth:`WindowTransport.flush` that
+window ops call at op boundaries.  Because EVERY message to a peer rides
+that peer's queue and the worker writes batches in enqueue order over the
+one pooled TCP connection, per-peer FIFO — the property ``win_fence`` and
+the distributed mutex rely on — is exactly preserved: a FENCE_REQ enqueued
+after puts is decoded after them from the same batch stream.  Small
+per-parameter gossip rows then cost wire time per BYTE, not per message
+(HiCCL's aggregation argument, arxiv 2408.05962).
 """
 
 from __future__ import annotations
 
 import ctypes
+import struct
 import threading
 import time
-from typing import Callable
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from bluefog_tpu import native
+from bluefog_tpu.utils import config
 
 # Wire op codes — the single source of truth for the window protocol.  The
 # native layer carries ``op`` opaquely; codes beyond put/accumulate are
@@ -37,6 +55,11 @@ OP_FENCE_ACK = 6
 OP_MUTEX_ACQ = 7
 OP_MUTEX_GRANT = 8
 OP_MUTEX_REL = 9
+# Container frame: the payload is a version-flagged stream of sub-messages
+# (see _encode_batch), many one-sided ops shipped in ONE native send.  Never
+# combined with OP_BF16_FLAG at the frame level — compression is a per-sub-
+# message property, carried on each sub-message's own op byte.
+OP_BATCH = 10
 # Flag bit ORed into the op byte when the payload is bf16-compressed (an f32
 # window row shipped as bfloat16).  An explicit wire flag — never inferred
 # from payload size — so a future partial-row or batched payload can't be
@@ -45,13 +68,21 @@ OP_BF16_FLAG = 0x40
 
 __all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
            "OP_GET_REPLY", "OP_FENCE_REQ", "OP_FENCE_ACK", "OP_MUTEX_ACQ",
-           "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BF16_FLAG"]
+           "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BATCH", "OP_BF16_FLAG"]
 
 _OP_NAMES = {OP_PUT: "put", OP_ACCUMULATE: "accumulate",
              OP_GET_REQ: "get_req", OP_GET_REPLY: "get_reply",
              OP_FENCE_REQ: "fence_req", OP_FENCE_ACK: "fence_ack",
              OP_MUTEX_ACQ: "mutex_acq", OP_MUTEX_GRANT: "mutex_grant",
-             OP_MUTEX_REL: "mutex_rel"}
+             OP_MUTEX_REL: "mutex_rel", OP_BATCH: "batch"}
+
+# Ops whose latency is on a waiter's critical path (fence acks, mutex
+# grants, get replies): they flush the peer's queue immediately instead of
+# waiting out the linger, and — being enqueued AFTER any pending data —
+# certify that data once answered (the FIFO property win_fence needs).
+_URGENT_OPS = frozenset((OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
+                         OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT,
+                         OP_MUTEX_REL))
 
 
 def _op_label(op: int) -> str:
@@ -59,15 +90,266 @@ def _op_label(op: int) -> str:
     return _OP_NAMES.get(op & ~OP_BF16_FLAG, str(op))
 
 
+# ---------------------------------------------------------------------------
+# OP_BATCH framing
+# ---------------------------------------------------------------------------
+# Batch payload layout (little-endian), carried inside one ordinary wire
+# frame whose op byte is OP_BATCH:
+#   u8 version (=1) | u32 count | count x sub-message
+#   sub-message := u8 op | i32 src | i32 dst | f64 weight | f64 p_weight |
+#                  u16 name_len | name | u64 payload_len | payload
+# The sub-message layout deliberately mirrors the native single-message
+# frame (minus the magic), so the two paths stay trivially comparable; the
+# version byte means a future layout change is an explicit negotiation
+# failure, never a silent misdecode.
+
+BATCH_VERSION = 1
+_BATCH_HDR = struct.Struct("<BI")          # version, count
+_SUB_HDR = struct.Struct("<BiiddH")        # op, src, dst, weight, p_w, nlen
+_SUB_PLEN = struct.Struct("<Q")            # payload_len
+
+# One queued/decoded message: (op, name, src, dst, weight, p_weight,
+# payload) with payload any bytes-like (bytes on the send side, a zero-copy
+# memoryview into the recv buffer on the drain side).
+Msg = Tuple[int, str, int, int, float, float, "bytes | memoryview"]
+
+
+def _encode_batch(msgs: Sequence[Msg]) -> bytes:
+    """Serialize sub-messages into one OP_BATCH payload."""
+    parts: List[bytes] = [_BATCH_HDR.pack(BATCH_VERSION, len(msgs))]
+    for (op, name, src, dst, weight, p_weight, payload) in msgs:
+        nb = name.encode()
+        parts.append(_SUB_HDR.pack(op, src, dst, weight, p_weight, len(nb)))
+        parts.append(nb)
+        parts.append(_SUB_PLEN.pack(len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def _decode_batch(buf) -> List[Msg]:
+    """Decode one OP_BATCH payload.  ``buf`` is any bytes-like; sub-message
+    payloads are returned as ZERO-COPY slices of it (``memoryview`` in when
+    memoryview comes in) — valid only as long as the caller keeps ``buf``
+    stable, i.e. for the duration of the apply call."""
+    ver, count = _BATCH_HDR.unpack_from(buf, 0)
+    if ver != BATCH_VERSION:
+        raise ValueError(
+            f"window batch frame version {ver} != {BATCH_VERSION} — peer "
+            "runs an incompatible transport (refusing to guess the layout)")
+    off = _BATCH_HDR.size
+    out: List[Msg] = []
+    for _ in range(count):
+        op, src, dst, weight, p_weight, nlen = _SUB_HDR.unpack_from(buf, off)
+        off += _SUB_HDR.size
+        name = bytes(buf[off:off + nlen]).decode()
+        off += nlen
+        (plen,) = _SUB_PLEN.unpack_from(buf, off)
+        off += _SUB_PLEN.size
+        out.append((op, name, src, dst, weight, p_weight,
+                    buf[off:off + plen]))
+        off += plen
+    if off != len(buf):
+        raise ValueError(
+            f"window batch frame: {len(buf) - off} trailing bytes after "
+            f"{count} sub-messages — corrupt or mismatched framing")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Outbound: per-peer sender workers
+# ---------------------------------------------------------------------------
+
+class _PeerSender:
+    """One bounded queue + one worker thread per peer endpoint.
+
+    Parallel across peers (a slow neighbor only stalls its own queue),
+    FIFO within a peer (one worker, one pooled native connection).  The
+    worker flushes on: queue bytes >= the coalesce threshold, an urgent
+    control op, an explicit flush(), or the linger timeout — whichever
+    comes first."""
+
+    def __init__(self, transport: "WindowTransport", host: str, port: int):
+        self._t = transport
+        self.host, self.port = host, port
+        self.peer = f"{host}:{port}"
+        self.cond = threading.Condition()
+        self.q: deque = deque()           # of Msg; guarded by cond
+        self.bytes_pending = 0
+        self.flush_now = False
+        self.closing = False
+        self.error: Optional[Exception] = None
+        # Monotonic count of failed batch sends TO THIS PEER.  A dropped
+        # batch may have carried several ops' messages and the stored
+        # ``error`` reaches only the first flusher; ops snapshot the sum
+        # over their peers (transport.error_token) before sending and
+        # flush(since=token) raises for every op that overlapped the
+        # failure — scoped per peer, so a dead neighbor never fails ops
+        # that only addressed healthy ones.
+        self.err_count = 0
+        # Point-in-time flush markers: messages ever enqueued / messages
+        # whose batch send has completed (successfully or dropped — the
+        # error paths report drops).  flush() waits for ITS snapshot of
+        # seq_enq, not for an empty queue, so concurrent producers on a
+        # slow peer cannot starve it past its own messages' departure.
+        self.seq_enq = 0
+        self.seq_done = 0
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name=f"bf-win-tx-{self.peer}")
+        self.thread.start()
+
+    def enqueue(self, msg: Msg, urgent: bool) -> None:
+        with self.cond:
+            if self.error is not None:
+                err, self.error = self.error, None
+                raise err
+            # Backpressure: a full queue blocks the CALLER (the window
+            # worker pool), exactly like the blocking native send did —
+            # gossip is never dropped, the producer is paced.
+            while (len(self.q) >= self._t._tx_queue_max
+                   and not self.closing and self.error is None):
+                self.cond.wait(0.05)
+            if self.error is not None:
+                err, self.error = self.error, None
+                raise err
+            if self.closing:
+                # The worker may already have exited: an append now would
+                # sit in the queue forever and read as sent.
+                raise ConnectionError(
+                    f"win transport to {self.peer} is stopping; message "
+                    "not sent")
+            self.q.append(msg)
+            self.seq_enq += 1
+            self.bytes_pending += len(msg[6])
+            if urgent or self.bytes_pending >= self._t._flush_bytes:
+                self.flush_now = True
+            self.cond.notify_all()
+
+    def flush(self, timeout: float) -> None:
+        """Block until everything enqueued BEFORE this call has been
+        handed to the native send (TCP kernel buffer) — the coalesced
+        path's equivalent of the legacy blocking ``send()`` returning.
+        Point-in-time: messages other producers enqueue while we wait do
+        not extend the wait."""
+        with self.cond:
+            target = self.seq_enq
+            if self.q:
+                # Only arm flush_now with work pending: the flag is reset
+                # at drain time, so setting it on an empty queue would
+                # make the NEXT message skip its linger and ship as an
+                # uncoalesced singleton.
+                self.flush_now = True
+            self.cond.notify_all()
+            ok = self.cond.wait_for(
+                lambda: self.error is not None or self.seq_done >= target
+                or self.closing,
+                timeout=timeout)
+            if self.error is not None:
+                err, self.error = self.error, None
+                raise err
+            if self.seq_done >= target:
+                return
+            if self.closing:
+                # stop() raced this flush.  The worker drains its queue
+                # before exiting, so give it the same grace stop()'s join
+                # allows; if the messages still were not handed off, the
+                # contract is "handed to TCP or raises".
+                self.cond.wait_for(
+                    lambda: self.error is not None
+                    or self.seq_done >= target,
+                    timeout=min(5.0, timeout))
+                if self.error is not None:
+                    err, self.error = self.error, None
+                    raise err
+                if self.seq_done >= target:
+                    return
+                raise ConnectionError(
+                    f"win transport to {self.peer} stopped with "
+                    f"{target - self.seq_done} message(s) unsent")
+            if not ok:
+                raise ConnectionError(
+                    f"win transport flush to {self.peer} timed out after "
+                    f"{timeout:.0f}s ({len(self.q)} messages still queued)")
+
+    def stop(self) -> None:
+        with self.cond:
+            self.closing = True
+            self.cond.notify_all()
+        self.thread.join(timeout=5)
+
+    def _run(self) -> None:
+        from bluefog_tpu.utils import telemetry
+        linger = self._t._linger
+        while True:
+            with self.cond:
+                while not self.q and not self.closing:
+                    self.cond.wait()
+                if not self.q:
+                    return  # closing with a drained queue
+                if not self.flush_now and linger > 0:
+                    # Linger briefly so back-to-back edge sends coalesce.
+                    # wait_for, not a bare wait: every enqueue notifies
+                    # this condition, and only an urgent op / threshold
+                    # crossing / close may cut the linger short — a paced
+                    # producer must not collapse it to "until the next
+                    # message".
+                    self.cond.wait_for(
+                        lambda: self.flush_now or self.closing,
+                        timeout=linger)
+                # Drain up to the byte threshold, not the whole queue: a
+                # backlog built while the peer backpressured must not
+                # become one multi-GB frame (encode copy here, recv-buffer
+                # doubling at the peer) — residual messages go next round.
+                batch: List[Msg] = []
+                nbytes = 0
+                while self.q and (not batch
+                                  or nbytes < self._t._flush_bytes):
+                    m = self.q.popleft()
+                    batch.append(m)
+                    nbytes += len(m[6])
+                self.bytes_pending -= nbytes
+                self.flush_now = bool(self.q)  # keep draining a backlog
+                self.cond.notify_all()  # wake backpressured producers
+            try:
+                self._t._send_frames(self.host, self.port, batch)
+            except Exception as e:  # noqa: BLE001 — surfaced to callers
+                import logging
+                logging.getLogger("bluefog_tpu").warning(
+                    "window transport: batch of %d message(s) to %s "
+                    "dropped: %s", len(batch), self.peer, e)
+                with self.cond:
+                    self.error = e
+                    self.err_count += 1
+            finally:
+                with self.cond:
+                    # Advance past dropped batches too: their flushers are
+                    # woken by `error` first (the predicate checks it
+                    # before seq_done), so a drop can never read as a
+                    # silent success for the op that owned it.
+                    self.seq_done += len(batch)
+                    if telemetry.enabled():
+                        # Residual backlog AFTER the drain: 0 when the
+                        # sender keeps up, pinned near the queue bound
+                        # when this peer backpressures us.
+                        telemetry.set_gauge("bf_win_tx_queue_depth",
+                                            len(self.q), peer=self.peer)
+                    self.cond.notify_all()
+
+
 class WindowTransport:
     """One per-process TCP endpoint for window gossip.
 
-    ``apply(op, name, src, dst, weight, p_weight, payload)`` is invoked on the
-    drain thread for every inbound message; the window store supplies it.
+    ``apply(op, name, src, dst, weight, p_weight, payload)`` is invoked on
+    the drain thread for every inbound message; the window store supplies
+    it.  ``payload`` is a ZERO-COPY view into the transport's recv buffer,
+    valid only for the duration of the call — ``apply`` must copy anything
+    it keeps.  ``apply_batch(msgs)``, when supplied, receives one decoded
+    OP_BATCH frame as a list of such messages (arrival order); without it,
+    batches fall back to per-message ``apply`` calls.
     """
 
-    def __init__(self, apply: Callable, *, port: int = 0,
-                 max_pending: int = 4096, drain_interval: float = 0.002):
+    def __init__(self, apply: Callable, *, apply_batch: Callable = None,
+                 port: int = 0, max_pending: int = 4096,
+                 drain_interval: float = 0.002):
         self._lib = native.lib()
         if self._lib is None:
             raise RuntimeError(
@@ -77,7 +359,23 @@ class WindowTransport:
         if not self._svc:
             raise OSError(f"cannot start window service on port {port}")
         self._apply = apply
+        self._apply_batch = apply_batch
         self._interval = drain_interval
+        cfg = config.get()
+        self.coalesce = bool(cfg.win_coalesce)
+        self._linger = max(0.0, cfg.win_coalesce_linger_ms) / 1e3
+        self._flush_bytes = max(1, cfg.win_coalesce_bytes)
+        self._tx_queue_max = max(1, cfg.win_tx_queue)
+        self._senders: Dict[Tuple[str, int], _PeerSender] = {}
+        self._senders_lock = threading.Lock()
+        # Cumulative coalescing stats behind one lock: sender workers on
+        # several threads update them, and a racy read-modify-write would
+        # drift the ratio gauge.
+        self._stats_lock = threading.Lock()
+        # Cumulative coalescing inputs for the ratio gauge (sub-messages
+        # per native send, 1.0 = no coalescing happening).
+        self._tx_frames = 0
+        self._tx_msgs = 0
         self._stop = threading.Event()
         self._buf = np.empty(1 << 20, dtype=np.uint8)  # grows on demand
         self._drainer = threading.Thread(target=self._drain, daemon=True,
@@ -93,26 +391,160 @@ class WindowTransport:
              dst: int, weight: float, tensor: np.ndarray,
              p_weight: float = 0.0) -> None:
         from bluefog_tpu.utils import telemetry
+        if len(name.encode()) >= 128:
+            # Deterministic, path-independent rejection: the receiver's
+            # fixed name[128] field caps every route.  Without this check
+            # a long window name would ship fine inside a multi-message
+            # batch (u16 name_len) but fail natively (-4) whenever it
+            # flushed as a singleton — a timing-dependent error.
+            raise ValueError(
+                f"window transport: name exceeds 127 bytes: {name!r}")
         payload = np.ascontiguousarray(tensor).view(np.uint8).reshape(-1)
         # Guard BEFORE building labels: the disabled path must not pay the
         # per-message f-string/op-name allocations on the gossip hot path.
-        t0 = None
         if telemetry.enabled():
             telemetry.inc("bf_win_tx_msgs_total", op=_op_label(op))
             telemetry.inc("bf_win_tx_bytes_total", float(payload.size),
                           peer=f"{host}:{port}")
-            t0 = time.perf_counter()
-        rc = self._lib.bf_winsvc_send(
-            host.encode(), port, op, name.encode(), src, dst,
-            float(weight), float(p_weight),
-            payload.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            payload.size)
-        if t0 is not None:
-            # Per-message RPC latency: serialize + connect/enqueue on the
-            # native client (TCP backpressure shows up here as tail mass).
-            # Guarded so the disabled path skips the label build too.
-            telemetry.observe_since(t0, "bf_win_rpc_seconds",
-                                    op=_op_label(op))
+        if not self.coalesce:
+            t0 = telemetry.start_timer()
+            self._native_send(host, port, op, name, src, dst, weight,
+                              p_weight, payload)
+            if t0 is not None:
+                # Per-message RPC latency: serialize + connect/enqueue on
+                # the native client (TCP backpressure shows up here as
+                # tail mass).  Guarded so the disabled path skips the
+                # per-message label build too.
+                telemetry.observe_since(t0, "bf_win_rpc_seconds",
+                                        op=_op_label(op))
+            return
+        # Coalesced path: own a copy (the caller may free/reuse the array
+        # the moment we return) and enqueue; the peer's worker ships it.
+        msg: Msg = (op, name, src, dst, float(weight), float(p_weight),
+                    payload.tobytes())
+        self._sender(host, port).enqueue(
+            msg, urgent=(op & ~OP_BF16_FLAG) in _URGENT_OPS)
+
+    def kick(self) -> None:
+        """Non-blocking flush request: wake every per-peer sender with a
+        pending queue so it ships without waiting out the linger.  Used by
+        overlap-mode optimizers to pace gossip onto the wire while the
+        caller goes back to compute."""
+        with self._senders_lock:
+            senders = list(self._senders.values())
+        for s in senders:
+            with s.cond:
+                if s.q:
+                    s.flush_now = True
+                    s.cond.notify_all()
+
+    def error_token(self, addrs=None) -> int:
+        """Snapshot for ``flush(since=...)``: take it BEFORE sending (for
+        the same ``addrs``), and the flush raises if any batch to those
+        peers failed in between — even one whose stored error a concurrent
+        flusher already consumed.  Scoped per peer: failures on peers
+        outside ``addrs`` never count."""
+        return sum(s.err_count for s in self._select_senders(addrs))
+
+    def _select_senders(self, addrs) -> List[_PeerSender]:
+        with self._senders_lock:
+            if addrs is None:
+                return list(self._senders.values())
+            want = set(addrs)
+            return [s for k, s in self._senders.items() if k in want]
+
+    def flush(self, timeout: float = 300.0, addrs=None,
+              since: Optional[int] = None) -> None:
+        """Drain per-peer queues to the native send and surface any
+        asynchronous send error.  Window ops call this at op boundaries so
+        op completion keeps its legacy meaning (payload handed to TCP).
+
+        ``addrs`` (iterable of ``(host, port)``) restricts the drain to
+        the peers an op actually addressed — a dead or backpressuring
+        neighbor must only stall ops that target it, exactly like the
+        legacy blocking send.  ``since`` is an :meth:`error_token`
+        snapshot taken over the SAME ``addrs``: any batch failure to
+        those peers after it raises here, even when the per-sender error
+        was already consumed by a concurrent flusher.  No-op on the
+        legacy per-message path and on empty queues."""
+        senders = self._select_senders(addrs)
+        errors = []
+        for s in senders:
+            try:
+                s.flush(timeout)
+            except Exception as e:  # noqa: BLE001 — all peers must drain
+                errors.append(e)
+        if errors:
+            raise errors[0]
+        if since is not None and \
+                sum(s.err_count for s in senders) > since:
+            raise ConnectionError(
+                "win transport: a batched send containing this op's "
+                "message(s) failed on a sender worker (see the "
+                "bluefog_tpu log for the peer and cause)")
+
+    def _sender(self, host: str, port: int) -> _PeerSender:
+        key = (host, port)
+        with self._senders_lock:
+            s = self._senders.get(key)
+            if s is None:
+                s = self._senders[key] = _PeerSender(self, host, port)
+            return s
+
+    def _send_frames(self, host: str, port: int, batch: List[Msg]) -> None:
+        """Worker-side: ship a drained queue as ONE native send (an
+        OP_BATCH frame), or as the plain single frame when only one message
+        coalesced (no container overhead, bit-identical legacy wire)."""
+        from bluefog_tpu.utils import telemetry
+        if len(batch) == 1:
+            op, name, src, dst, weight, p_weight, payload = batch[0]
+            blob = np.frombuffer(payload, np.uint8)
+            t0 = telemetry.start_timer()
+            self._native_send(host, port, op, name, src, dst, weight,
+                              p_weight, blob)
+            if t0 is not None:
+                telemetry.observe_since(t0, "bf_win_rpc_seconds",
+                                        op=_op_label(op))
+        else:
+            blob = np.frombuffer(_encode_batch(batch), np.uint8)
+            t0 = telemetry.start_timer()
+            self._native_send(host, port, OP_BATCH, "", -1, -1, 0.0, 0.0,
+                              blob)
+            if t0 is not None:
+                telemetry.observe_since(t0, "bf_win_rpc_seconds",
+                                        op="batch")
+        with self._stats_lock:  # several sender threads update the ratio
+            self._tx_frames += 1
+            self._tx_msgs += len(batch)
+            ratio = self._tx_msgs / self._tx_frames
+        if telemetry.enabled():
+            telemetry.observe("bf_win_tx_batch_size", float(len(batch)))
+            if len(batch) > 1:
+                telemetry.inc("bf_win_tx_batches_total")
+                telemetry.inc("bf_win_tx_batched_msgs_total",
+                              float(len(batch)))
+            telemetry.set_gauge("bf_win_tx_coalesce_ratio", ratio)
+
+    def _native_send(self, host: str, port: int, op: int, name: str,
+                     src: int, dst: int, weight: float, p_weight: float,
+                     payload: np.ndarray) -> None:
+        """One native RPC, with a single short-backoff retry on transient
+        failure (a peer restarting between the pooled connection's own
+        stale-fd retry and now) before raising ConnectionError."""
+        from bluefog_tpu.utils import telemetry
+        args = (host.encode(), port, op, name.encode(), src, dst,
+                float(weight), float(p_weight),
+                payload.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                payload.size)
+        rc = self._lib.bf_winsvc_send(*args)
+        # Retry only transient failures (connect/write to a restarting
+        # peer); -1 (address resolution, the directory carries numeric
+        # IPs) and -4 (name too long) are deterministic.
+        if rc not in (0, -1, -4):
+            telemetry.inc("bf_win_tx_retries_total",
+                          peer=f"{host}:{port}")
+            time.sleep(0.05)
+            rc = self._lib.bf_winsvc_send(*args)
         if rc != 0:
             if telemetry.enabled():
                 telemetry.inc("bf_win_tx_errors_total",
@@ -153,22 +585,49 @@ class WindowTransport:
             if not burst:
                 burst_t0 = time.perf_counter()
             burst += 1
-            if telemetry.enabled():  # skip label rendering when off
-                telemetry.inc("bf_win_rx_msgs_total",
-                              op=_op_label(int(msg.op) & ~OP_BF16_FLAG))
-                telemetry.inc("bf_win_rx_bytes_total",
-                              float(msg.payload_len))
-            payload = bytes(self._buf[:msg.payload_len])
+            # Zero-copy view into the recv buffer: apply copies what it
+            # keeps (the arithmetic it performs materializes fresh arrays
+            # anyway; only parked/deferred messages need an explicit copy).
+            payload = memoryview(self._buf)[:msg.payload_len]
+            op = int(msg.op)
             try:
-                self._apply(int(msg.op), msg.name.decode(), int(msg.src),
-                            int(msg.dst), float(msg.weight),
-                            float(msg.p_weight), payload)
+                if op == OP_BATCH:
+                    self._dispatch_batch(payload)
+                else:
+                    if telemetry.enabled():  # skip label render when off
+                        telemetry.inc("bf_win_rx_msgs_total",
+                                      op=_op_label(op))
+                        telemetry.inc("bf_win_rx_bytes_total",
+                                      float(msg.payload_len))
+                    self._apply(op, msg.name.decode(), int(msg.src),
+                                int(msg.dst), float(msg.weight),
+                                float(msg.p_weight), payload)
             except Exception:  # noqa: BLE001 — drain thread must survive
                 import logging
                 logging.getLogger("bluefog_tpu").exception(
                     "window transport apply failed")
 
+    def _dispatch_batch(self, payload: memoryview) -> None:
+        from bluefog_tpu.utils import telemetry
+        msgs = _decode_batch(payload)
+        if telemetry.enabled():
+            telemetry.inc("bf_win_rx_batches_total")
+            telemetry.inc("bf_win_rx_bytes_total", float(len(payload)))
+            telemetry.observe("bf_win_rx_batch_size", float(len(msgs)))
+            for m in msgs:
+                telemetry.inc("bf_win_rx_msgs_total", op=_op_label(m[0]))
+        if self._apply_batch is not None:
+            self._apply_batch(msgs)
+        else:
+            for m in msgs:
+                self._apply(*m)
+
     def stop(self):
+        with self._senders_lock:
+            senders = list(self._senders.values())
+            self._senders.clear()
+        for s in senders:
+            s.stop()
         self._stop.set()
         self._drainer.join(timeout=5)
         if self._svc:

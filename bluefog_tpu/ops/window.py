@@ -54,7 +54,8 @@ __all__ = [
     "win_create", "win_free", "win_put", "win_put_nonblocking",
     "win_get", "win_get_nonblocking", "win_accumulate",
     "win_accumulate_nonblocking", "win_update", "win_update_then_collect",
-    "win_wait", "win_poll", "win_mutex", "win_fence", "get_win_version",
+    "win_wait", "win_poll", "win_mutex", "win_fence", "win_flush",
+    "get_win_version",
     "win_state_dict", "win_load_state_dict",
     "get_current_created_window_names", "win_associated_p",
     "turn_on_win_ops_with_associated_p", "turn_off_win_ops_with_associated_p",
@@ -328,7 +329,8 @@ def init_transport() -> bool:
     if jax.process_count() == 1:
         return False
     from bluefog_tpu.ops.transport import WindowTransport
-    transport = WindowTransport(_apply_inbound)
+    transport = WindowTransport(_apply_inbound,
+                                apply_batch=_apply_inbound_batch)
     me = f"{_local_host_addr()}:{transport.port}".encode()
     if len(me) > 64:
         raise ValueError(f"transport address too long: {me!r}")
@@ -425,8 +427,56 @@ def _send_to_rank_owner(rank: int, op: int, name: str, src: int, dst: int,
                   weight, p_weight, payload)
 
 
-def _payload_row(win: _Window, payload: bytes,
-                 compressed: bool = False) -> np.ndarray:
+def _flush_transport(procs=None, since=None, timeout=None) -> None:
+    """Drain the transport's send queues (coalesced path) so the enclosing
+    op's completion keeps its legacy meaning: every edge payload handed to
+    TCP, every asynchronous send error surfaced HERE (on the worker that
+    owns the op) rather than lost on a sender thread.
+
+    ``procs`` restricts the drain to the peer processes the op actually
+    addressed — one dead or slow neighbor must only stall ops targeting
+    it, as with the legacy blocking send.  ``since`` is the transport's
+    :meth:`error_token` snapshot from before the op's sends (batch
+    failures between then and now raise even if another op's flush
+    consumed the stored error first).  No-op single-process, with legacy
+    per-message sends, or on empty queues."""
+    d = _store.distrib
+    if d is None:
+        return
+    addrs = None if procs is None else {d.proc_addr[p] for p in procs}
+    if addrs is not None and not addrs:
+        return
+    d.transport.flush(timeout=_MSG_TIMEOUT_SEC if timeout is None
+                      else timeout, addrs=addrs, since=since)
+
+
+def win_flush(wait: bool = True, timeout: Optional[float] = None) -> None:
+    """Flush the DCN window transport's per-peer send queues.
+
+    With coalescing on (``BLUEFOG_TPU_WIN_COALESCE``, default), one-sided
+    ops enqueue their edge payloads onto per-peer sender queues; the window
+    ops already flush at their own boundaries, so ``win_wait``/``win_fence``
+    semantics are unchanged — this entry point exists for callers pacing
+    raw ``*_nonblocking`` streams who want queued gossip on the wire NOW
+    instead of after the linger.  ``wait=False`` only kicks the sender
+    workers (no blocking, no error surfacing — pacing, not a barrier);
+    ``timeout`` overrides the per-peer drain wait (default
+    ``BLUEFOG_TPU_WIN_TIMEOUT``).  No-op in single-process runs."""
+    if wait:
+        _flush_transport(timeout=timeout)
+    else:
+        d = _store.distrib
+        if d is not None:
+            d.transport.kick()
+
+
+def _payload_row(win: _Window, payload, compressed: bool = False,
+                 copy: bool = True) -> np.ndarray:
+    """Decode one wire payload (bytes or a zero-copy memoryview into the
+    transport's recv buffer) to a window-shaped row.  ``copy=False`` skips
+    the defensive copy — for callers that immediately fold the row into a
+    fresh array (scale/accumulate) and never retain the view past the
+    apply call."""
     expected = int(np.prod(win.shape)) * win.dtype.itemsize
     if compressed:
         # bf16-compressed edge (sender had BLUEFOG_TPU_WIN_COMPRESSION=bf16),
@@ -442,7 +492,8 @@ def _payload_row(win: _Window, payload: bytes,
             f"window {win.name!r}: payload of {len(payload)} bytes does not "
             f"match the {expected}-byte row (shape {win.shape}, "
             f"dtype {win.dtype})")
-    return np.frombuffer(payload, dtype=win.dtype).reshape(win.shape).copy()
+    row = np.frombuffer(payload, dtype=win.dtype).reshape(win.shape)
+    return row.copy() if copy else row
 
 
 def _reply_get(name: str, src: int, dst: int, weight: float) -> None:
@@ -477,7 +528,13 @@ def _remote_mutex(name: str, rank: int, my_rank: int):
             import time as _time
             from bluefog_tpu.utils import telemetry
             t0 = _time.monotonic()
+            proc = d.rank_owner[rank]
+            tok = d.transport.error_token({d.proc_addr[proc]})
             _send_to_rank_owner(rank, OP_MUTEX_ACQ, name, my_rank, rank, 0.0)
+            # Surface a coalesced send failure NOW (the legacy blocking
+            # send raised here synchronously) instead of burning the full
+            # grant timeout on a peer that never saw the ACQ.
+            _flush_transport({proc}, since=tok)
             if not granted.wait(timeout=_MSG_TIMEOUT_SEC):
                 raise ConnectionError(
                     f"win_mutex({name!r}): rank {rank}'s owner did not grant "
@@ -487,9 +544,18 @@ def _remote_mutex(name: str, rank: int, my_rank: int):
                           _time.monotonic() - t0, kind="remote")
             yield
         finally:
-            _send_to_rank_owner(rank, OP_MUTEX_REL, name, my_rank, rank, 0.0)
-            with d.cv:
-                d.grant_events.pop((name, rank), None)
+            try:
+                proc = d.rank_owner[rank]
+                tok = d.transport.error_token({d.proc_addr[proc]})
+                _send_to_rank_owner(rank, OP_MUTEX_REL, name, my_rank,
+                                    rank, 0.0)
+                # As with the legacy blocking send, a REL that cannot
+                # reach the owner raises here (the owner would otherwise
+                # hold the mutex until its own timeout).
+                _flush_transport({proc}, since=tok)
+            finally:
+                with d.cv:
+                    d.grant_events.pop((name, rank), None)
 
 
 def _hold_mutex_for_remote(name: str, rank: int, requester: int) -> None:
@@ -507,8 +573,14 @@ def _hold_mutex_for_remote(name: str, rank: int, requester: int) -> None:
         d.remote_holds[key] = release
     try:
         with win.mutexes[rank]:
+            proc = d.rank_owner[requester]
+            tok = d.transport.error_token({d.proc_addr[proc]})
             _send_to_rank_owner(requester, OP_MUTEX_GRANT, name, requester,
                                 rank, 0.0)
+            # A GRANT that cannot reach the requester raises here (as the
+            # legacy blocking send did), releasing the mutex immediately
+            # instead of holding it for the requester's full timeout.
+            _flush_transport({proc}, since=tok)
             release.wait(timeout=_MSG_TIMEOUT_SEC)
     finally:
         with d.cv:
@@ -519,10 +591,15 @@ def _hold_mutex_for_remote(name: str, rank: int, requester: int) -> None:
 
 
 def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
-                   p_weight: float, payload: bytes) -> None:
+                   p_weight: float, payload) -> None:
     """Drain-thread entry: apply one inbound transport message to the local
     (owned) window state.  Must never block on peers — replies and mutex
-    holds are pushed onto the worker pool."""
+    holds are pushed onto the worker pool.
+
+    ``payload`` may be a zero-copy memoryview into the transport's recv
+    buffer (valid only for this call): every retaining path (parking)
+    snapshots it to bytes; every applying path folds it into a fresh
+    array before returning."""
     orig_op = op  # parked/replayed messages must keep the wire flag bits
     compressed = bool(op & OP_BF16_FLAG)
     op &= ~OP_BF16_FLAG
@@ -531,9 +608,11 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         with _store.lock:
             if _store.distrib is None:
                 # Directory not installed yet (peer finished init first):
-                # buffer — init_transport replays in arrival order.
+                # buffer — init_transport replays in arrival order.  The
+                # recv buffer is reused after this call: own the bytes.
                 _store.preinit_msgs.append(
-                    (orig_op, name, src, dst, weight, p_weight, payload))
+                    (orig_op, name, src, dst, weight, p_weight,
+                     bytes(payload)))
                 return
             d = _store.distrib
     if op == OP_FENCE_REQ:
@@ -561,9 +640,10 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         win = _store.windows.get(name)
         if win is None:
             # SPMD skew: the peer created + wrote this window before our
-            # win_create ran.  Park; win_create replays in arrival order.
+            # win_create ran.  Park; win_create replays in arrival order
+            # (payload snapshotted — the recv buffer is reused).
             d.parked.setdefault(name, []).append(
-                (orig_op, name, src, dst, weight, p_weight, payload))
+                (orig_op, name, src, dst, weight, p_weight, bytes(payload)))
             return
     if op in (OP_PUT, OP_ACCUMULATE, OP_GET_REPLY):
         # Applied (not parked) data payload: inbound bytes per peer process
@@ -580,7 +660,9 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         # is the sender's job via the distributed mutex (_remote_mutex).
         from bluefog_tpu.utils.timeline import op_span
         with op_span(f"win_apply.{name}.{src}->{dst}", "COMMUNICATE"):
-            row = _payload_row(win, payload, compressed)
+            # copy=False: the scale below materializes a fresh array; the
+            # transient view is never retained.
+            row = _payload_row(win, payload, compressed, copy=False)
             with win.lock:
                 if (dst, src) not in win.staging:
                     return
@@ -599,7 +681,9 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
     elif op == OP_GET_REPLY:
         from bluefog_tpu.utils.timeline import op_span
         with op_span(f"win_apply.{name}.{src}->{dst}", "COMMUNICATE"):
-            row = _payload_row(win, payload, compressed)
+            # copy=False: the scale below materializes a fresh array; the
+            # transient view is never retained.
+            row = _payload_row(win, payload, compressed, copy=False)
             with win.lock:
                 if (dst, src) in win.staging:
                     win.staging[(dst, src)] = row * win.dtype.type(weight)
@@ -614,6 +698,112 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         threading.Thread(target=_hold_mutex_for_remote,
                          args=(name, dst, src), daemon=True,
                          name=f"bf-win-hold-{dst}").start()
+
+
+def _apply_inbound_batch(msgs) -> None:
+    """Drain-thread entry for one decoded OP_BATCH frame.
+
+    Sub-messages apply in arrival order (the FIFO contract fence and mutex
+    REL rely on), but runs of consecutive puts/accumulates into the SAME
+    window take the vectorized path: rows are decoded and scaled outside
+    the lock, consecutive contributions to one staging slot are pre-folded,
+    and the whole run commits under ONE ``win.lock`` hold — per-message
+    mutex traffic was the receive side's dominant cost for small gossip
+    rows.  Control messages (fence, mutex, get) and anything that must
+    park fall through to the per-message path, which owns its copies.
+
+    Exception isolation matches the legacy drain loop: one malformed
+    sub-message (payload validation, SPMD shape skew) loses only itself,
+    never the rest of the frame — a fence request riding behind a bad put
+    must still be answered, or the sender's win_fence would time out on a
+    healthy peer."""
+    import logging
+    i, n = 0, len(msgs)
+    while i < n:
+        base_op = msgs[i][0] & ~OP_BF16_FLAG
+        if base_op not in (OP_PUT, OP_ACCUMULATE):
+            try:
+                _apply_inbound(*msgs[i])
+            except Exception:  # noqa: BLE001 — isolate per message
+                logging.getLogger("bluefog_tpu").exception(
+                    "window transport apply failed (batched control msg)")
+            i += 1
+            continue
+        name = msgs[i][1]
+        j = i + 1
+        while (j < n and msgs[j][1] == name
+               and (msgs[j][0] & ~OP_BF16_FLAG) in (OP_PUT, OP_ACCUMULATE)):
+            j += 1
+        try:
+            _apply_data_run(name, msgs[i:j])
+        except Exception:  # noqa: BLE001 — isolate per run
+            logging.getLogger("bluefog_tpu").exception(
+                "window transport apply failed (batched data run)")
+        i = j
+
+
+def _apply_data_run(name: str, group) -> None:
+    """Apply a run of put/accumulate messages for one window, vectorized:
+    decode + scale outside the lock, fold consecutive same-slot
+    contributions (put-then-accumulate folds into the put: ``A`` then
+    ``+= B`` is ``A + B`` with both version ticks kept), commit the whole
+    run under one lock hold."""
+    d = _store.distrib
+    with _store.lock:
+        win = _store.windows.get(name) if _store.distrib is not None else None
+    if d is None or win is None:
+        # Preinit or SPMD-skew parking: the per-message path owns the
+        # bookkeeping (and snapshots each payload to bytes).
+        for m in group:
+            _apply_inbound(*m)
+        return
+    from bluefog_tpu.utils import telemetry
+    if telemetry.enabled():
+        for (_op, _n, src, _dst, _w, _pw, payload) in group:
+            telemetry.inc("bf_win_proc_rx_bytes_total", float(len(payload)),
+                          proc=d.rank_owner.get(src, -1))
+    # -- decode + fold outside the lock ------------------------------------
+    # entries: [replace, (dst, src), scaled_row, p_mass, version_ticks]
+    entries = []
+    for (op, _n, src, dst, weight, p_weight, payload) in group:
+        compressed = bool(op & OP_BF16_FLAG)
+        accumulate = (op & ~OP_BF16_FLAG) == OP_ACCUMULATE
+        try:
+            row = _payload_row(win, payload, compressed, copy=False)
+        except ValueError:
+            # One malformed payload (shape/flag skew) loses only itself —
+            # per-message isolation, as on the legacy drain path.
+            import logging
+            logging.getLogger("bluefog_tpu").exception(
+                "window transport apply failed (batched row decode)")
+            continue
+        scaled = row * win.dtype.type(weight)  # fresh array: view not kept
+        key = (dst, src)
+        if accumulate and entries and entries[-1][1] == key:
+            # Fold into the previous same-slot entry (put or accumulate):
+            # the slot would have received both anyway, in this order.
+            entries[-1][2] += scaled
+            entries[-1][3] += p_weight
+            entries[-1][4] += 1
+        else:
+            entries.append([not accumulate, key, scaled, p_weight, 1])
+    # -- commit under one lock hold ----------------------------------------
+    from bluefog_tpu.utils.timeline import op_span
+    with op_span(f"win_apply_batch.{name}", "COMMUNICATE"):
+        with win.lock:
+            for replace, key, scaled, p_mass, ticks in entries:
+                if key not in win.staging:
+                    continue
+                if replace:
+                    win.staging[key] = scaled
+                else:
+                    win.staging[key] += scaled
+                win.versions[key] += ticks
+                if _store.associated_p_enabled:
+                    if replace:
+                        win.p_staging[key] = p_mass
+                    else:
+                        win.p_staging[key] += p_mass
 
 
 def _neighbors_from_topology():
@@ -783,6 +973,14 @@ def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
         return  # window freed after dispatch; put becomes a no-op
     op = OP_ACCUMULATE if accumulate else OP_PUT
     kind = "win_accumulate" if accumulate else "win_put"
+    d = _store.distrib
+    remote_procs = ({d.rank_owner[dst] for (src, dst) in edges
+                     if _owns(src) and not _owns(dst)}
+                    if d is not None else set())
+    # Error token scoped to the peers THIS op will address (taken before
+    # any enqueue): failures on other peers' senders never fail this op.
+    tok = (d.transport.error_token({d.proc_addr[p] for p in remote_procs})
+           if remote_procs else None)
     for (src, dst), w in edges.items():
         if not _owns(src):
             continue  # src's owner performs this edge
@@ -793,6 +991,13 @@ def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
         with op_span(f"{kind}.{name}.{src}->{dst}", "COMMUNICATE"):
             _do_put_edge(win, name, tensor, row, src, dst, w, op,
                          accumulate, require_mutex)
+    # Op boundary: every remote edge enqueued above must be handed to TCP
+    # (and any sender-worker error surfaced on THIS op's future) before the
+    # op reports complete — win_wait keeps its local-completion meaning.
+    # Scoped to the peers this op addressed: an unrelated slow neighbor
+    # does not stall it.
+    if remote_procs:
+        _flush_transport(remote_procs, since=tok)
     if self_weight is not None:
         _publish_self(win, tensor, self_weight)
 
@@ -982,6 +1187,9 @@ def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
     if remote:
         # One-sided pull: request each remote row, then wait for the replies
         # (the blocking analogue of chunked MPI_Get, mpi_controller.cc:1123).
+        req_procs = {d.rank_owner[src] for (_, src, _) in remote}
+        tok = d.transport.error_token(
+            {d.proc_addr[p] for p in req_procs})
         with d.cv:
             for (dst, src, w) in remote:
                 key = (name, dst, src)
@@ -989,6 +1197,11 @@ def _do_get(name: str, edges: Dict[tuple, float], require_mutex: bool) -> None:
         for (dst, src, w) in remote:
             with op_span(f"win_get_req.{name}.{src}->{dst}", "COMMUNICATE"):
                 _send_to_rank_owner(src, OP_GET_REQ, name, src, dst, w)
+        # GET_REQs are urgent (the senders flush them on sight); the
+        # explicit flush — scoped to the owners actually asked — surfaces
+        # any send error here instead of a timeout below misread as a
+        # dead peer.
+        _flush_transport(req_procs, since=tok)
         deadline_keys = [(name, dst, src) for (dst, src, _) in remote]
         with d.cv:
             ok = d.cv.wait_for(
@@ -1349,8 +1562,14 @@ def win_fence(name: Optional[str] = None) -> None:
         peers = [p for p in d.proc_addr if p != d.my_proc]
         with d.cv:
             d.fence_acks = 0
+        tok = d.transport.error_token()
         for p in peers:
             _send_to_proc(p, OP_FENCE_REQ, name or "", d.my_rank, -1, 0.0)
+        # Fence requests always flush the peer's queue first: FENCE_REQ is
+        # an urgent op (enqueued BEHIND any still-queued puts, flushed on
+        # sight), and this explicit drain surfaces send errors before the
+        # ack wait — so the ack still certifies every prior put applied.
+        _flush_transport(since=tok)
         with d.cv:
             ok = d.cv.wait_for(lambda: d.fence_acks >= len(peers),
                                timeout=_MSG_TIMEOUT_SEC)

@@ -289,6 +289,19 @@ class _WindowOptimizerBase:
                                             float(dist.max()))
 
     def free(self):
+        # Flush the transport's send queues first: a coalesced edge payload
+        # still lingering in a per-peer queue when its window dies here
+        # would land at the peer as gossip for a window we no longer track.
+        # Best-effort — teardown must complete even when a peer is dead,
+        # and promptly even when one is wedged (the legacy free()
+        # succeeded locally regardless of peers), hence the short timeout.
+        try:
+            W.win_flush(timeout=5.0)
+        except Exception:  # noqa: BLE001 — never abort cleanup
+            from bluefog_tpu.utils.logging import get_logger
+            get_logger().warning(
+                "window optimizer free(): transport flush failed "
+                "(dead peer?); continuing teardown", exc_info=True)
         for name in self._names or []:
             W.win_free(name)
         self._names = None
@@ -298,6 +311,11 @@ class _WindowOptimizerBase:
         multi-process, fence the transport) so a snapshot cannot miss
         queued or in-flight gossip mass."""
         if W._store.distrib is not None:
+            # Flush-before-fence: queued coalesced sends reach TCP first,
+            # so the fence's acks certify THEM applied too (the fence also
+            # flushes internally — this surfaces send errors at the
+            # snapshot call site instead of inside the fence wait).
+            W.win_flush()
             W.win_fence()
 
     def _require_windows(self, what: str):
@@ -391,6 +409,12 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
                                       require_mutex=require_mutex)
                 for name, payload in zip(self._names, payloads)]
             if self.overlap:
+                # Overlapped puts flush themselves when their worker-pool
+                # job finishes; kick the transport NOW (non-blocking — the
+                # per-peer senders flush on their own threads) so gossip
+                # already enqueued rides the wire during the next
+                # forward/backward instead of waiting out the linger.
+                W.win_flush(wait=False)
                 self._pending = handles
             else:
                 for h in handles:

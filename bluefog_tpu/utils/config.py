@@ -16,6 +16,10 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_PORT          | 0     | DCN window-service port (0=ephemeral) |
 | BLUEFOG_TPU_WIN_MAX_PENDING   | 4096  | inbound window-message queue bound |
 | BLUEFOG_TPU_WIN_COMPRESSION   | none  | bf16: halve cross-host window payloads |
+| BLUEFOG_TPU_WIN_COALESCE      | 1     | 0: legacy per-message transport sends |
+| BLUEFOG_TPU_WIN_COALESCE_LINGER_MS | 1.0 | sender-worker linger before flushing a partial batch |
+| BLUEFOG_TPU_WIN_COALESCE_BYTES | 1 MiB | queued bytes that force an immediate batch flush |
+| BLUEFOG_TPU_WIN_TX_QUEUE      | 1024  | per-peer outbound queue bound (messages); full blocks the producer |
 | BLUEFOG_TPU_TELEMETRY         | 1     | 0: disable the metric registry entirely |
 | BLUEFOG_TPU_TELEMETRY_PORT    | unset | serve /metrics + /healthz (0=ephemeral) |
 | BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY | 10 | consensus-distance sample period (0=off) |
@@ -71,6 +75,13 @@ class Config:
     win_port: int
     win_max_pending: int
     win_compression: str
+    # DCN transport coalescing (ops/transport.py): on by default — sends
+    # enqueue onto per-peer queues flushed as OP_BATCH frames; off is the
+    # escape hatch restoring one blocking native RPC per message.
+    win_coalesce: bool
+    win_coalesce_linger_ms: float
+    win_coalesce_bytes: int
+    win_tx_queue: int
     telemetry: bool
     telemetry_port: Optional[int]
     telemetry_consensus_every: int
@@ -108,6 +119,13 @@ class Config:
                 os.environ.get("BLUEFOG_TPU_WIN_MAX_PENDING", "4096")),
             win_compression=_validated_compression(os.environ.get(
                 "BLUEFOG_TPU_WIN_COMPRESSION", "none").lower()),
+            win_coalesce=_flag("BLUEFOG_TPU_WIN_COALESCE", default=True),
+            win_coalesce_linger_ms=float(os.environ.get(
+                "BLUEFOG_TPU_WIN_COALESCE_LINGER_MS", "1.0")),
+            win_coalesce_bytes=int(os.environ.get(
+                "BLUEFOG_TPU_WIN_COALESCE_BYTES", str(1 << 20))),
+            win_tx_queue=int(os.environ.get(
+                "BLUEFOG_TPU_WIN_TX_QUEUE", "1024")),
             telemetry=_flag("BLUEFOG_TPU_TELEMETRY", default=True),
             telemetry_port=(
                 None if os.environ.get("BLUEFOG_TPU_TELEMETRY_PORT") is None

@@ -435,6 +435,20 @@ def health() -> dict:
     straggler = profiler.last_straggler_report()
     if straggler is not None:
         body["straggler"] = straggler
+    # Transport-coalescing health (tentpole PR 4): sub-messages per native
+    # send (1.0 = nothing coalescing) and the deepest per-peer tx backlog
+    # remaining after a drain — 0 when senders keep up; pinned near
+    # BLUEFOG_TPU_WIN_TX_QUEUE means a peer is backpressuring this host's
+    # gossip.
+    with _registry.lock:
+        ratio = _registry.gauges.get(_key("bf_win_tx_coalesce_ratio", {}))
+        depths = [(k[1][0][1], v) for k, v in _registry.gauges.items()
+                  if k[0] == "bf_win_tx_queue_depth"]
+    if ratio is not None:
+        body["win_tx_coalesce_ratio"] = round(ratio, 2)
+    if depths:
+        peer, depth = max(depths, key=lambda kv: kv[1])
+        body["win_tx_deepest_queue"] = {"peer": peer, "depth": depth}
     probe = stall._peer_probe
     if probe is not None:
         try:

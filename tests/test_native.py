@@ -169,7 +169,9 @@ def test_window_transport_large_payload():
     done = __import__("threading").Event()
 
     def apply(op, name, src, dst, weight, p_weight, payload):
-        got.append(np.frombuffer(payload, np.float32))
+        # payload is a zero-copy view into the recv buffer, valid only
+        # for the duration of this call — snapshot before retaining.
+        got.append(np.frombuffer(payload, np.float32).copy())
         done.set()
 
     server = WindowTransport(apply)

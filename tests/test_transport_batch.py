@@ -1,0 +1,448 @@
+"""Coalesced DCN window transport (PR 4): OP_BATCH wire framing, per-peer
+sender workers, ordering under coalescing, the vectorized batched apply,
+and the transient-send retry."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import native
+from bluefog_tpu import topology as topo
+from bluefog_tpu.ops import transport as T
+from bluefog_tpu.ops import window as W
+from bluefog_tpu.utils import config, telemetry
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native core not built")
+
+_ALL_OPS = (T.OP_PUT, T.OP_ACCUMULATE, T.OP_GET_REQ, T.OP_GET_REPLY,
+            T.OP_FENCE_REQ, T.OP_FENCE_ACK, T.OP_MUTEX_ACQ,
+            T.OP_MUTEX_GRANT, T.OP_MUTEX_REL)
+
+
+@pytest.fixture
+def coalesce_env(monkeypatch):
+    """Set coalescing knobs for a test and restore the config cache after."""
+    def set_env(**kv):
+        for k, v in kv.items():
+            monkeypatch.setenv(k, str(v))
+        config.reload()
+    yield set_env
+    config.reload()
+
+
+# ---------------------------------------------------------------------------
+# Wire framing
+# ---------------------------------------------------------------------------
+
+def test_batch_roundtrip_property():
+    """Random batches of mixed ops — bf16-flagged payloads, zero-length
+    fence/mutex messages, awkward names — encode -> decode bit-identically
+    (pure Python: the framing has no native dependency)."""
+    rng = np.random.RandomState(0)
+    names = ["w", "", "very.long/param:name", "π-window", "x" * 127]
+    for _ in range(50):
+        count = int(rng.randint(1, 40))
+        msgs = []
+        for _ in range(count):
+            op = int(rng.choice(_ALL_OPS))
+            if op in (T.OP_PUT, T.OP_ACCUMULATE) and rng.rand() < 0.3:
+                op |= T.OP_BF16_FLAG
+            payload = rng.bytes(int(rng.choice([0, 1, 7, 64, 4096])))
+            msgs.append((op, str(rng.choice(names)), int(rng.randint(-1, 64)),
+                         int(rng.randint(-1, 64)), float(rng.randn()),
+                         float(rng.randn()), payload))
+        blob = T._encode_batch(msgs)
+        out = T._decode_batch(memoryview(blob))
+        assert len(out) == len(msgs)
+        for a, b in zip(msgs, out):
+            assert a[:6] == b[:6]
+            assert a[6] == bytes(b[6])  # payload bit-identical
+
+
+def test_batch_decode_rejects_bad_version_and_trailing_bytes():
+    msgs = [(T.OP_PUT, "w", 0, 1, 1.0, 0.0, b"\x01\x02")]
+    blob = bytearray(T._encode_batch(msgs))
+    blob[0] = T.BATCH_VERSION + 1
+    with pytest.raises(ValueError, match="version"):
+        T._decode_batch(bytes(blob))
+    with pytest.raises(ValueError, match="trailing"):
+        T._decode_batch(T._encode_batch(msgs) + b"\x00")
+
+
+# ---------------------------------------------------------------------------
+# Loopback: ordering, fence-after-puts, env hatch
+# ---------------------------------------------------------------------------
+
+class _Recorder:
+    def __init__(self):
+        self.msgs = []
+        self.batches = 0
+        self.cv = threading.Condition()
+
+    def apply(self, op, name, src, dst, weight, p_weight, payload):
+        with self.cv:
+            # payload is a zero-copy view into the recv buffer — snapshot.
+            self.msgs.append((op, name, src, dst, weight, p_weight,
+                              bytes(payload)))
+            self.cv.notify_all()
+
+    def apply_batch(self, msgs):
+        self.batches += 1
+        for m in msgs:
+            self.apply(*m)
+
+    def wait_for(self, n, timeout=20):
+        with self.cv:
+            ok = self.cv.wait_for(lambda: len(self.msgs) >= n,
+                                  timeout=timeout)
+        assert ok, f"only {len(self.msgs)}/{n} messages arrived"
+
+
+@needs_native
+def test_loopback_coalesced_preserves_fifo_and_fence_ordering(coalesce_env):
+    """With coalescing ON (the default), a burst of puts followed by a
+    FENCE_REQ arrives in exact send order — the fence trails every put on
+    the same stream, which is the property win_fence's ack certification
+    rests on — and the puts actually travel batched."""
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=5)
+    rec = _Recorder()
+    server = T.WindowTransport(rec.apply, apply_batch=rec.apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        n_puts = 64
+        for i in range(n_puts):
+            client.send("127.0.0.1", server.port, T.OP_PUT, "w", i, 0,
+                        float(i), np.full(8, i, np.float32), p_weight=0.5)
+        client.send("127.0.0.1", server.port, T.OP_FENCE_REQ, "", 0, -1,
+                    0.0, np.zeros(0, np.float32))
+        client.flush()
+        rec.wait_for(n_puts + 1)
+        ops = [m[0] for m in rec.msgs]
+        assert ops[-1] == T.OP_FENCE_REQ  # fence NEVER overtakes a put
+        assert ops[:-1] == [T.OP_PUT] * n_puts
+        assert [m[2] for m in rec.msgs[:-1]] == list(range(n_puts))  # FIFO
+        for i, m in enumerate(rec.msgs[:-1]):  # payloads land intact
+            np.testing.assert_array_equal(
+                np.frombuffer(m[6], np.float32), np.full(8, i, np.float32))
+        assert rec.batches >= 1, "coalescing on but nothing batched"
+    finally:
+        client.stop()
+        server.stop()
+
+
+@needs_native
+def test_coalesce_env_hatch_restores_per_message_path(coalesce_env):
+    """BLUEFOG_TPU_WIN_COALESCE=0: every message is its own native frame
+    (no batch frames at the receiver), same delivery, same order."""
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=0)
+    rec = _Recorder()
+    server = T.WindowTransport(rec.apply, apply_batch=rec.apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        assert not client.coalesce
+        for i in range(8):
+            client.send("127.0.0.1", server.port, T.OP_ACCUMULATE, "w",
+                        i, 0, 1.0, np.full(4, i, np.float32))
+        client.flush()  # no-op on the legacy path (no queues exist)
+        rec.wait_for(8)
+        assert rec.batches == 0
+        assert [m[2] for m in rec.msgs] == list(range(8))
+    finally:
+        client.stop()
+        server.stop()
+
+
+@needs_native
+def test_send_retry_counts_telemetry_and_raises(coalesce_env):
+    """A dead endpoint: the native send is retried once with backoff
+    (bf_win_tx_retries_total counts it) and then raises ConnectionError —
+    synchronously on the legacy path, at flush() on the coalesced path."""
+    import socket
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))  # bound, never listening: connect refused
+    port = dead.getsockname()[1]
+    telemetry.reset()
+    try:
+        coalesce_env(BLUEFOG_TPU_WIN_COALESCE=0)
+        direct = T.WindowTransport(lambda *a: None)
+        try:
+            with pytest.raises(ConnectionError):
+                direct.send("127.0.0.1", port, T.OP_PUT, "w", 0, 1, 1.0,
+                            np.zeros(4, np.float32))
+        finally:
+            direct.stop()
+        snap = telemetry.snapshot()
+        key = f'bf_win_tx_retries_total{{peer="127.0.0.1:{port}"}}'
+        assert snap.get(key) == 1.0, snap
+
+        coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1)
+        queued = T.WindowTransport(lambda *a: None)
+        try:
+            queued.send("127.0.0.1", port, T.OP_PUT, "w", 0, 1, 1.0,
+                        np.zeros(4, np.float32))  # enqueue: no error yet
+            with pytest.raises(ConnectionError):
+                queued.flush(timeout=30)
+        finally:
+            queued.stop()
+        assert telemetry.snapshot().get(key) == 2.0
+    finally:
+        dead.close()
+
+
+@needs_native
+def test_flush_bytes_caps_frame_size(coalesce_env):
+    """A backlog larger than BLUEFOG_TPU_WIN_COALESCE_BYTES is shipped as
+    MULTIPLE batch frames (bounded encode copies and recv-buffer growth),
+    still in order."""
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1,
+                 BLUEFOG_TPU_WIN_COALESCE_BYTES=8192,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=20)
+    rec = _Recorder()
+    server = T.WindowTransport(rec.apply, apply_batch=rec.apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        row = np.zeros(1024, np.float32)  # 4 KB
+        for i in range(64):  # 256 KB total vs an 8 KB frame cap
+            client.send("127.0.0.1", server.port, T.OP_PUT, "w", i, 0,
+                        1.0, row)
+        client.flush()
+        rec.wait_for(64)
+        assert rec.batches >= 8, rec.batches  # many frames, not one blob
+        assert [m[2] for m in rec.msgs] == list(range(64))
+    finally:
+        client.stop()
+        server.stop()
+
+
+@needs_native
+def test_error_token_surfaces_failure_to_late_flusher(coalesce_env):
+    """A dropped batch can carry several ops' messages but the stored
+    per-sender error reaches only the first flusher; flush(since=token)
+    raises for every op that overlapped the failure window."""
+    import socket
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1)
+    t = T.WindowTransport(lambda *a: None)
+    try:
+        tok = t.error_token()
+        t.send("127.0.0.1", port, T.OP_PUT, "w", 0, 1, 1.0,
+               np.zeros(4, np.float32))
+        with pytest.raises(ConnectionError):  # first flusher: stored error
+            t.flush(timeout=30)
+        with pytest.raises(ConnectionError):  # late flusher: token catches
+            t.flush(timeout=30, since=tok)
+        t.flush(timeout=30, since=t.error_token())  # fresh token: clean
+    finally:
+        t.stop()
+        dead.close()
+
+
+@needs_native
+def test_error_token_is_scoped_per_peer(coalesce_env):
+    """A failure on one peer's sender must not fail a flush scoped to a
+    healthy peer (the legacy behavior: a dead neighbor only stalls ops
+    that address it)."""
+    import socket
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    dead_port = dead.getsockname()[1]
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1)
+    rec = _Recorder()
+    server = T.WindowTransport(rec.apply, apply_batch=rec.apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    live_addr = ("127.0.0.1", server.port)
+    try:
+        tok = client.error_token({live_addr})
+        client.send("127.0.0.1", dead_port, T.OP_PUT, "w", 0, 1, 1.0,
+                    np.zeros(4, np.float32))
+        client.send(*live_addr, T.OP_PUT, "w", 0, 2, 1.0,
+                    np.zeros(4, np.float32))
+        # The healthy peer's scoped flush succeeds even while the dead
+        # peer's sender records its failure.
+        client.flush(timeout=30, addrs={live_addr}, since=tok)
+        rec.wait_for(1)
+        with pytest.raises(ConnectionError):  # unscoped flush reports it
+            client.flush(timeout=30)
+    finally:
+        client.stop()
+        server.stop()
+        dead.close()
+
+
+@needs_native
+def test_backpressure_blocks_producer_not_forever(coalesce_env):
+    """A tiny per-peer queue bound paces the producer (send blocks until
+    the worker drains) instead of dropping gossip."""
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1, BLUEFOG_TPU_WIN_TX_QUEUE=4,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=0)
+    rec = _Recorder()
+    server = T.WindowTransport(rec.apply, apply_batch=rec.apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        for i in range(64):  # 16x the queue bound
+            client.send("127.0.0.1", server.port, T.OP_PUT, "w", i, 0,
+                        1.0, np.zeros(16, np.float32))
+        client.flush()
+        rec.wait_for(64)
+        assert [m[2] for m in rec.msgs] == list(range(64))
+    finally:
+        client.stop()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Vectorized batched apply (window store)
+# ---------------------------------------------------------------------------
+
+def _fake_distrib():
+    class _T:
+        def flush(self, timeout=None):
+            pass
+
+        def kick(self):
+            pass
+
+        def stop(self):
+            pass
+    return W._Distrib(_T(), rank_owner={r: 0 for r in range(8)},
+                      proc_addr={0: ("127.0.0.1", 1)}, my_proc=0)
+
+
+def test_batched_apply_matches_sequential_apply():
+    """_apply_inbound_batch (grouped, folded, one lock hold) produces the
+    same staging / versions / associated-P state as the per-message
+    _apply_inbound applied in the same order — including put-then-
+    accumulate runs on one slot and interleaved windows."""
+    bf.init(lambda: topo.RingGraph(8))
+    rng = np.random.RandomState(3)
+    x = rng.randn(8, 5).astype(np.float32)
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        assert bf.win_create(x, "ba", zero_init=True)
+        assert bf.win_create(x, "bb", zero_init=True)
+        # A message stream exercising fold rules: puts reset, accumulates
+        # add, window switches split runs, edges vary.
+        msgs = []
+        for k in range(40):
+            name = "ba" if (k // 7) % 2 == 0 else "bb"
+            dst = int(rng.randint(8))
+            src = (dst + 1) % 8 if rng.rand() < 0.5 else (dst - 1) % 8
+            op = T.OP_PUT if rng.rand() < 0.3 else T.OP_ACCUMULATE
+            row = rng.randn(5).astype(np.float32)
+            msgs.append((op, name, src, dst, float(rng.rand() + 0.1),
+                         float(rng.rand()), row.tobytes()))
+
+        saved = W._store.distrib
+        W._store.distrib = _fake_distrib()
+        try:
+            W._apply_inbound_batch(msgs)
+            batched = {n: bf.win_state_dict(n) for n in ("ba", "bb")}
+            bf.win_free("ba"), bf.win_free("bb")
+            assert bf.win_create(x, "ba", zero_init=True)
+            assert bf.win_create(x, "bb", zero_init=True)
+            for m in msgs:
+                W._apply_inbound(*m)
+            sequential = {n: bf.win_state_dict(n) for n in ("ba", "bb")}
+        finally:
+            W._store.distrib = saved
+        for n in ("ba", "bb"):
+            for part in ("staging", "versions", "p_staging"):
+                for k, v in sequential[n][part].items():
+                    got = batched[n][part][k]
+                    np.testing.assert_allclose(
+                        np.asarray(got), np.asarray(v), rtol=1e-6,
+                        atol=1e-6, err_msg=f"{n}.{part}[{k}]")
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
+        bf.win_free("ba")
+        bf.win_free("bb")
+
+
+def test_batched_apply_zero_copy_payloads_are_safe():
+    """Feeding memoryviews whose backing buffer is scribbled after the
+    call must not corrupt window state (the apply folds rows into fresh
+    arrays before returning)."""
+    bf.init(lambda: topo.RingGraph(8))
+    x = np.zeros((8, 4), np.float32)
+    assert bf.win_create(x, "zc", zero_init=True)
+    try:
+        buf = bytearray(np.full(4, 7.0, np.float32).tobytes())
+        msgs = [(T.OP_PUT, "zc", 1, 0, 1.0, 0.0, memoryview(buf))]
+        saved = W._store.distrib
+        W._store.distrib = _fake_distrib()
+        try:
+            W._apply_inbound_batch(msgs)
+        finally:
+            W._store.distrib = saved
+        buf[:] = b"\xff" * len(buf)  # transport reuses its recv buffer
+        win = W._store.get("zc")
+        np.testing.assert_array_equal(win.staging[(0, 1)],
+                                      np.full(4, 7.0, np.float32))
+    finally:
+        bf.win_free("zc")
+
+
+def test_win_flush_noop_single_process():
+    """win_flush is part of the public surface and must be callable (and a
+    no-op) without a transport."""
+    bf.init(lambda: topo.RingGraph(8))
+    bf.win_flush()
+    bf.win_flush(wait=False)
+
+
+@needs_native
+def test_batch_frame_through_store_fence_like_sequence(coalesce_env):
+    """End-to-end through a real loopback transport INTO the window store:
+    puts + accumulates ride one batch frame, the store's batched apply
+    lands them, and a trailing fence req is answered only after the data
+    was applied (ordering across the transport/store seam)."""
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=5)
+    bf.init(lambda: topo.RingGraph(8))
+    x = np.zeros((8, 3), np.float32)
+    assert bf.win_create(x, "e2e", zero_init=True)
+    applied_before_fence = []
+    fence_seen = threading.Event()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        if (op & ~T.OP_BF16_FLAG) == T.OP_FENCE_REQ:
+            win = W._store.get("e2e")
+            with win.lock:
+                applied_before_fence.append(win.versions[(0, 1)])
+            fence_seen.set()
+            return
+        W._apply_inbound(op, name, src, dst, weight, p_weight, payload)
+
+    def apply_batch(msgs):
+        for m in msgs:
+            apply(*m)
+
+    server = T.WindowTransport(apply, apply_batch=apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    saved = W._store.distrib
+    W._store.distrib = _fake_distrib()
+    try:
+        row = np.arange(3, dtype=np.float32)
+        for _ in range(5):
+            client.send("127.0.0.1", server.port, T.OP_ACCUMULATE, "e2e",
+                        1, 0, 1.0, row)
+        client.send("127.0.0.1", server.port, T.OP_FENCE_REQ, "", 1, -1,
+                    0.0, np.zeros(0, np.float32))
+        client.flush()
+        assert fence_seen.wait(timeout=20)
+        # All 5 accumulates were applied BEFORE the fence was serviced.
+        assert applied_before_fence == [5]
+        win = W._store.get("e2e")
+        np.testing.assert_allclose(win.staging[(0, 1)], 5 * row)
+    finally:
+        W._store.distrib = saved
+        client.stop()
+        server.stop()
+        bf.win_free("e2e")
