@@ -6,14 +6,15 @@
 PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
-        bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke
+        bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
+        placement-smoke
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
 # schedule-regression smoke (bench_comm asserts the min-round repack is
 # output-equivalent and never worse than naive — a broken repack fails
 # here loudly, not as a silent slowdown).
-test: test-fast bench-comm-smoke prof-smoke transport-smoke
+test: test-fast bench-comm-smoke prof-smoke transport-smoke placement-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -57,6 +58,14 @@ prof-smoke:
 	env JAX_PLATFORMS=cpu \
 	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	    python -m bluefog_tpu.utils.profiler
+
+# Physical-placement CI gate: modeled link-load report on simulated 4x8
+# and 8x8 tori (asserts the optimizer+packer cut random-regular max-link-
+# load >= 2x and never worsen shift-structured placements) plus an end-to-
+# end check that the placement permutation is BIT-identical to enumeration
+# order on the virtual CPU mesh.
+placement-smoke:
+	env JAX_PLATFORMS=cpu python bench_comm.py --placement-smoke
 
 # CPU-runnable loopback two-transport exchange over the coalesced DCN
 # path: asserts batched delivery actually happened (OP_BATCH frames on

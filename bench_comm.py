@@ -70,6 +70,18 @@ def _parse_args():
     p.add_argument("--row-bytes", type=int, default=4096,
                    help="transport bench: payload bytes per message "
                         "(default 4096 — the small-gossip-row regime)")
+    p.add_argument("--placement", action="store_true",
+                   help="run the physical-placement cost-model report "
+                        "(modeled link-load naive vs optimized across "
+                        "ring/Exp2/star/random-regular on simulated 4x8 "
+                        "and 8x8 tori) plus an end-to-end output-"
+                        "equivalence check on the virtual CPU mesh")
+    p.add_argument("--placement-smoke", action="store_true",
+                   help="CI variant of --placement (same assertions, "
+                        "same tori — the cost model is pure host math)")
+    p.add_argument("--placement-iters", type=int, default=1000,
+                   help="simulated-annealing refinement iterations for "
+                        "the placement search (default 1000)")
     return p.parse_args()
 
 
@@ -197,10 +209,167 @@ def transport_main(args) -> int:
     return 0
 
 
+def _effective_w(sched, n):
+    """Reconstruct the effective weight matrix a compiled schedule applies
+    (the repack-equivalence oracle: regrouping rounds must never change it)."""
+    import numpy as np
+    w = np.zeros((n, n))
+    w[np.arange(n), np.arange(n)] = sched.self_scale
+    for rnd in sched.rounds:
+        for s, d in rnd.pairs:
+            w[s, d] = rnd.send_scale[s]
+    return w
+
+
+def placement_main(args) -> int:
+    """Physical-placement report (and the `make placement-smoke` CI gate).
+
+    Part 1 is pure host math (no jax): for each simulated torus and each
+    topology family, compare modeled max-link-load under identity
+    placement vs the optimized permutation vs optimized + congestion-aware
+    round packing; assert random-regular improves >= 2x on the 8x8 torus,
+    shift-structured families are never made worse, and the effective
+    weight matrix survives the repack bit-identically.  Part 2 drives the
+    real op on the virtual 8-device CPU mesh: placement on (fake torus)
+    must produce BIT-IDENTICAL outputs vs BLUEFOG_TPU_PLACEMENT=0 (the
+    permutation only moves ranks to other devices), and the congestion
+    repack stays within 1e-6 (fp summation order only)."""
+    import numpy as np
+
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu.ops import schedule_opt as SO
+
+    smoke = args.placement_smoke
+    seed = args.seed
+    tori = {}
+    for dims in ((4, 8), (8, 8)):
+        n = dims[0] * dims[1]
+        model = PL.synthetic_torus(dims)
+        per_topo = {}
+        for name, make in (
+                ("ring", lambda: topo.RingGraph(n)),
+                ("exp2", lambda: topo.ExponentialTwoGraph(n)),
+                ("star", lambda: topo.StarGraph(n)),
+                ("random_regular",
+                 lambda: topo.RandomRegularGraph(n, 4, seed=seed))):
+            w = topo.weight_matrix(make())
+            sched = S._build_schedule(w, optimize=True)
+            res = PL.optimize_placement(model, sched, n,
+                                        iters=args.placement_iters,
+                                        seed=seed)
+            packed = SO.congestion_aware_repack(
+                sched, model, res.perm, budget_factor=2.0)
+            pc = PL.schedule_cost(model, packed, res.perm)
+            assert np.array_equal(_effective_w(sched, n),
+                                  _effective_w(packed, n)), \
+                f"{name}@{dims}: repack changed the effective weight matrix"
+            assert (res.optimized_cost.max_link_load
+                    <= res.identity_cost.max_link_load), \
+                f"{name}@{dims}: placement made max-link-load WORSE"
+            assert pc.max_link_load <= res.optimized_cost.max_link_load, \
+                f"{name}@{dims}: congestion repack made max-link-load WORSE"
+            per_topo[name] = {
+                "max_link_load_naive": res.identity_cost.max_link_load,
+                "max_link_load_placed": res.optimized_cost.max_link_load,
+                "max_link_load_packed": pc.max_link_load,
+                "hop_bytes_naive": res.identity_cost.hop_bytes,
+                "hop_bytes_opt": res.optimized_cost.hop_bytes,
+                "rounds": len(sched.rounds),
+                "rounds_packed": len(packed.rounds),
+                "identity_placement": res.is_identity,
+                "improvement_ratio": round(
+                    res.identity_cost.max_link_load
+                    / max(pc.max_link_load, 1e-12), 3),
+            }
+        tori["x".join(map(str, dims))] = per_topo
+
+    rr = tori["8x8"]["random_regular"]
+    assert rr["improvement_ratio"] >= 2.0, (
+        "placement+packing must cut modeled max-link-load >= 2x for "
+        f"random-regular(4, 64) on the 8x8 torus, got "
+        f"{rr['improvement_ratio']}x")
+
+    # ---- Part 2: end-to-end output equivalence on the virtual CPU mesh.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import bluefog_tpu as bf
+    from bluefog_tpu.utils import config
+
+    topo_fn = lambda: topo.RandomRegularGraph(8, 4, seed=1)
+    x = np.random.default_rng(seed).standard_normal((8, 64)).astype(
+        np.float32)
+    knobs = ("BLUEFOG_TPU_PLACEMENT", "BLUEFOG_TPU_FAKE_TORUS",
+             "BLUEFOG_TPU_PLACEMENT_ROUND_BUDGET")
+    saved = {k: os.environ.get(k) for k in knobs}
+
+    def run(**env):
+        for k in knobs:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        config.reload()
+        bf.init(topo_fn)
+        out = np.asarray(bf.neighbor_allreduce(x))
+        info = bf.placement_info()
+        bf.shutdown()
+        return out, info
+
+    try:
+        out_off, info_off = run(BLUEFOG_TPU_PLACEMENT="0",
+                                BLUEFOG_TPU_FAKE_TORUS="2x4")
+        out_place, info_on = run(BLUEFOG_TPU_PLACEMENT="1",
+                                 BLUEFOG_TPU_FAKE_TORUS="2x4",
+                                 BLUEFOG_TPU_PLACEMENT_ROUND_BUDGET="0")
+        out_pack, _ = run(BLUEFOG_TPU_PLACEMENT="1",
+                          BLUEFOG_TPU_FAKE_TORUS="2x4")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        config.reload()
+    assert info_off is None, "PLACEMENT=0 must disable the physical model"
+    assert info_on is not None and (info_on["max_link_load_opt"]
+                                    <= info_on["max_link_load_naive"])
+    assert np.array_equal(out_off, out_place), (
+        "placement permutation must be BIT-identical to enumeration order "
+        "(it only moves ranks to other devices)")
+    pack_diff = float(np.abs(out_off - out_pack).max())
+    assert pack_diff <= 1e-6, \
+        f"congestion repack drifted outputs by {pack_diff} (> 1e-6)"
+
+    print(json.dumps({
+        "metric": "gossip_placement_max_link_load_reduction_random_regular",
+        "value": rr["improvement_ratio"],
+        "unit": "x",
+        "detail": {
+            "smoke": smoke,
+            "tori": tori,
+            "e2e": {
+                "mesh": "8-device CPU, fake torus 2x4",
+                "bit_identical_placement_only": True,
+                "packed_max_output_diff": pack_diff,
+                "placement_info": info_on,
+            },
+        },
+    }))
+    return 0
+
+
 def main():
     args = _parse_args()
     if args.transport or args.transport_smoke:
         return transport_main(args)
+    if args.placement or args.placement_smoke:
+        return placement_main(args)
     if args.smoke:
         args.n = args.n or 8
         args.payload = min(args.payload, 1024)
@@ -290,8 +459,8 @@ def main():
         w = topo.weight_matrix(make())
         naive = S._build_schedule(w, optimize=False)
         opt = S._build_schedule(w, optimize=True)
-        r0, e0 = C.schedule_wire_stats(naive)
-        r1, e1 = C.schedule_wire_stats(opt)
+        r0, e0, _ = C.schedule_wire_stats(naive)
+        r1, e1, _ = C.schedule_wire_stats(opt)
         assert e0 == e1, f"{name}: repack changed the edge set ({e0} -> {e1})"
         assert r1 <= r0, f"{name}: repack emitted MORE rounds ({r0} -> {r1})"
         assert r1 == SO.min_rounds(opt), \

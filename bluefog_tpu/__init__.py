@@ -42,6 +42,7 @@ from bluefog_tpu.basics import (  # noqa: F401
     hierarchical_mesh,
     set_topology,
     set_machine_topology,
+    placement_info,
     load_topology,
     load_machine_topology,
     in_neighbor_ranks,

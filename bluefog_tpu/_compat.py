@@ -1,15 +1,20 @@
-"""Cross-version jax shims, installed at package import.
+"""Cross-version jax/orbax shims, installed at package import.
 
 The codebase targets the modern ``jax.shard_map`` spelling; on jax
 releases where it still lives in ``jax.experimental.shard_map`` (< 0.5)
 every op would die with ``AttributeError`` at dispatch.  Alias it (with
 the ``check_vma`` → ``check_rep`` kwarg rename) so one import works on
-both sides of the move.
+both sides of the move.  The same treatment covers the varying-manual-axes
+(vma) surface the Pallas kernels use (``lax.pvary``,
+``ShapeDtypeStruct(vma=...)``, ``pltpu.CompilerParams``) and the orbax
+checkpoint-metadata accessor, all of which moved between the versions
+this image may carry.
 """
 
 from __future__ import annotations
 
 import functools
+import inspect
 
 import jax
 
@@ -42,5 +47,57 @@ def _install_axis_size() -> None:
     jax.lax.axis_size = axis_size
 
 
+def _install_pvary() -> None:
+    """``lax.pvary`` (vma tracking, jax >= 0.6) marks a replicated value as
+    varying over manual axes.  Older jax has no vma system at all — under
+    ``check_rep=False`` shard_map the marker is semantically a no-op — so
+    the shim is the identity.  (``lax.pcast`` callers probe for it with
+    hasattr and fall back to ``pvary``, so only ``pvary`` needs to exist.)"""
+    if hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast"):
+        return
+    jax.lax.pvary = lambda x, axis_names: x
+
+
+# Does this jax's ShapeDtypeStruct carry varying-manual-axes metadata?
+_SDS_HAS_VMA = "vma" in inspect.signature(
+    jax.ShapeDtypeStruct.__init__).parameters
+
+
+def shape_dtype_struct(shape, dtype, vma=None) -> jax.ShapeDtypeStruct:
+    """``jax.ShapeDtypeStruct`` with the ``vma=`` kwarg dropped on jax
+    releases that predate vma tracking (< 0.6): there the avals carry no
+    varying-axes metadata, so omitting it is exact, not an approximation.
+    Used by the Pallas kernels, whose out_shape must propagate vma on
+    modern jax to stay composable with ``shard_map(check_vma=True)``."""
+    if _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` was spelled ``TPUCompilerParams`` before the
+    jax 0.6 rename; same fields (``dimension_semantics`` et al.) on both."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def checkpoint_tree_metadata(checkpointer, path):
+    """Tree metadata of a saved orbax checkpoint, across the metadata-API
+    move: modern orbax returns a ``CheckpointMetadata`` wrapper exposing
+    ``.item_metadata.tree``; 0.x returned the metadata tree directly."""
+    meta = checkpointer.metadata(path)
+    item = getattr(meta, "item_metadata", None)
+    if item is not None:
+        meta = item
+    tree = getattr(meta, "tree", None)
+    if tree is not None:
+        meta = tree
+    return meta
+
+
 _install_shard_map()
 _install_axis_size()
+_install_pvary()

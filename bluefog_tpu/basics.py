@@ -54,9 +54,26 @@ class _Context:
         self.initialized = False
         self.suspended = False
         self.devices: list = []
+        # Enumeration-order device list (the BLUEFOG_TPU_PLACEMENT=0 view);
+        # ``devices``/``mesh`` hold the physically-placed permutation of it.
+        self.base_devices: list = []
         self.mesh: Optional[Mesh] = None            # 1-D (rank,)
         self.hier_mesh: Optional[Mesh] = None       # 2-D (machine, local)
         self.local_size: int = 1
+        # Physical placement (ops/placement.py): the interconnect model
+        # built from base_devices (None on flat hosts), the logical-rank →
+        # base-device-index permutation actually applied (None = identity)
+        # and the optimizer's cost report for telemetry/bench.
+        self.placement_model = None
+        self.placement: Optional[np.ndarray] = None
+        self.placement_result = None
+        # Atomic (model, perm) snapshot read by _physical_repack, plus a
+        # generation folded into the schedule cache keys: a dispatch racing
+        # set_topology must never pair the new model with the old perm, nor
+        # leave a schedule repacked against the outgoing placement cached
+        # under a key the refreshed context will keep serving.
+        self._placement_state: tuple = (None, None)
+        self.placement_generation: int = 0
         self.topology: Optional[nx.DiGraph] = None
         self.machine_topology: Optional[nx.DiGraph] = None
         self.is_topo_weighted: bool = False
@@ -118,6 +135,12 @@ def _reset_for_tests():
     # The throttle depth derives from the mesh platform, which a re-init
     # can change — a cached value must not outlive the context.
     _inflight_depth = None
+    # The wire-cost telemetry reads the placement context process-wide; a
+    # dead context must not keep pricing schedules against its model.
+    from bluefog_tpu.ops import placement as _placement
+    _placement.set_active(None, None)
+    _placement_model_cache.clear()
+    _placement_search_cache.clear()
 
 
 def _require_init() -> _Context:
@@ -157,6 +180,7 @@ def init(topology_fn=None, is_weighted: bool = False, *,
     devs = list(devices) if devices is not None else list(jax.devices())
     n = len(devs)
     _ctx.devices = devs
+    _ctx.base_devices = list(devs)
     _ctx.mesh = Mesh(np.asarray(devs), (RANK_AXIS,))
     if local_size is None:
         local_size = jax.local_device_count() if jax.process_count() > 1 else n
@@ -410,6 +434,7 @@ def set_topology(topology: Optional[nx.DiGraph] = None,
     ctx.is_topo_weighted = is_weighted
     ctx.topology_version += 1
     ctx.invalidate_schedules()
+    _refresh_placement(ctx)
     return True
 
 
@@ -426,6 +451,201 @@ def set_machine_topology(topology: nx.DiGraph, is_weighted: bool = False) -> boo
     ctx.machine_topology_version += 1
     ctx.invalidate_schedules()
     return True
+
+
+# How many dynamic one-peer phases the placement search will jointly
+# optimize over; larger periods fall back to the static schedule alone
+# (whose edge set contains every phase's edges anyway).
+_PLACEMENT_MAX_DYN_PHASES = 16
+
+# Interconnect models keyed by (spec knobs, device identity): the model's
+# route/table caches are the expensive part, and devices never change
+# within a process — one model serves every set_topology.
+_placement_model_cache: dict = {}
+
+# Memoized search results keyed by (model geometry, schedule edge
+# structure, search knobs): optimize_placement and the gauge-pricing
+# repacks depend only on round/pair structure (unit payload), so
+# re-installing a previously seen topology must not redo the multi-second
+# search.  FIFO-bounded.
+_placement_search_cache: "collections.OrderedDict" = collections.OrderedDict()
+_PLACEMENT_SEARCH_CACHE_MAX = 64
+
+
+def _placement_model(devices):
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.utils import config
+    cfg = config.get()
+    key = (cfg.fake_torus, cfg.torus_wrap, tuple(map(str, devices)))
+    if key not in _placement_model_cache:
+        if len(_placement_model_cache) > 8:
+            _placement_model_cache.clear()
+        _placement_model_cache[key] = PL.build_model(devices)
+    return _placement_model_cache[key]
+
+
+def _placement_search(model, scheds, n, *, iters, block, budget):
+    """Memoized ``(PlacementResult, packed max-link-load)`` for a model +
+    schedule set (see ``_placement_search_cache``)."""
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.ops import schedule_opt as SO
+    sig = []
+    for s in scheds:
+        phs = getattr(s, "phases", None)
+        for ph in (phs if phs is not None else (s,)):
+            sig.extend(rnd.pairs for rnd in ph.rounds)
+    key = (model.name, model.dims, model.wrap_dims, model.device_node,
+           tuple(sig), n, iters, block, budget)
+    hit = _placement_search_cache.get(key)
+    if hit is not None:
+        _placement_search_cache.move_to_end(key)
+        return hit
+    result = PL.optimize_placement(model, scheds, n, iters=iters, seed=0,
+                                   block=block)
+    # The bf_schedule_max_link_load gauge describes what actually
+    # dispatches: the placed AND congestion-packed schedules (record=
+    # False — these pricing repacks never run, the dispatch-layer ones
+    # recount the moves).
+    packed = []
+    for s in scheds:
+        phs = getattr(s, "phases", None)
+        for ph in (phs if phs is not None else (s,)):
+            packed.append(SO.congestion_aware_repack(
+                ph, model, result.perm, budget_factor=budget,
+                record=False))
+    packed_mll = PL.schedule_cost(model, packed, result.perm).max_link_load
+    _placement_search_cache[key] = (result, packed_mll)
+    if len(_placement_search_cache) > _PLACEMENT_SEARCH_CACHE_MAX:
+        _placement_search_cache.popitem(last=False)
+    return result, packed_mll
+
+
+def _refresh_placement(ctx) -> None:
+    """Recompute the physical rank placement for the active topology.
+
+    Builds the interconnect model from the enumeration-order device list
+    (real TPU coords / ``BLUEFOG_TPU_FAKE_TORUS``; flat hosts have none
+    and skip everything), searches the logical-rank → physical-device
+    permutation minimizing modeled ``(max_link_load, hop_bytes)`` jointly
+    over the static schedule AND the one-peer dynamic phase table (one
+    mesh serves every phase), then rebuilds the mesh with the permuted
+    device order.  The weight matrix is untouched — mesh position ``i``
+    still computes logical rank ``i``'s row, only the physical chip under
+    it moves — so results are bit-identical, and
+    ``BLUEFOG_TPU_PLACEMENT=0`` restores enumeration order exactly.
+    Deterministic (seeded search over identical inputs), so every SPMD
+    process installs the identical mesh.
+
+    In multi-process runs (``local_size < n``) the search is constrained
+    to permute ranks only WITHIN their enumeration-order machine block:
+    the hierarchical ``(machine, local)`` mesh reshapes consecutive
+    device blocks, and a cross-machine swap would silently turn every
+    LOCAL_AXIS collective into DCN traffic."""
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.utils import config, telemetry
+    cfg = config.get()
+    n = len(ctx.base_devices)
+    model = None
+    perm = None
+    result = None
+    packed_mll = None
+    if cfg.placement and n > 1 and ctx.topology is not None:
+        model = _placement_model(ctx.base_devices)
+    if model is not None:
+        scheds = [S.compile_static(
+            ctx.topology, use_topo_weights=ctx.is_topo_weighted)]
+        try:
+            phases = topology_util.dynamic_phase_table(
+                ctx.topology, max_phases=_PLACEMENT_MAX_DYN_PHASES)
+            scheds.append(S.compile_dynamic(phases, n))
+        except ValueError:
+            pass  # period too long: the static edge set covers the union
+        block = ctx.local_size if 0 < ctx.local_size < n else None
+        result, packed_mll = _placement_search(
+            model, scheds, n, iters=cfg.placement_iters, block=block,
+            budget=cfg.placement_round_budget)
+        if not result.is_identity:
+            perm = result.perm
+    devs = ctx.base_devices if perm is None else \
+        [ctx.base_devices[int(p)] for p in perm]
+    mesh = Mesh(np.asarray(devs), (RANK_AXIS,))
+    hier_mesh = ctx.hier_mesh
+    if ctx.local_size and n % ctx.local_size == 0:
+        hier_mesh = Mesh(
+            np.asarray(devs).reshape(n // ctx.local_size, ctx.local_size),
+            (MACHINE_AXIS, LOCAL_AXIS))
+    with ctx._lock:
+        ctx.placement_model = model
+        ctx.placement = perm
+        ctx.placement_result = result
+        ctx._placement_state = (model, perm)
+        ctx.placement_generation += 1
+        ctx.devices = devs
+        ctx.mesh = mesh
+        ctx.hier_mesh = hier_mesh
+        # Second invalidation: a dispatch that raced in between the
+        # caller's invalidate_schedules() and this publish compiled (and
+        # repacked) against the OUTGOING placement; the generation bump
+        # already retires its cache key, this just frees the entry.
+        ctx.invalidate_schedules()
+    PL.set_active(model, perm)
+    if result is not None:
+        telemetry.set_gauge("bf_placement_improvement_ratio",
+                            result.improvement_ratio)
+        telemetry.set_gauge("bf_schedule_max_link_load",
+                            packed_mll if packed_mll is not None
+                            else result.optimized_cost.max_link_load)
+    else:
+        # No model active (flat host, PLACEMENT=0, ...): a stale last
+        # value from a previous topology would misreport /metrics.
+        telemetry.clear_gauge("bf_placement_improvement_ratio")
+        telemetry.clear_gauge("bf_schedule_max_link_load")
+
+
+def _physical_repack(sched, _state=None):
+    """Congestion-aware round repack of a compiled static schedule under
+    the active interconnect model + placement (no-op without a model or
+    with ``BLUEFOG_TPU_PLACEMENT_ROUND_BUDGET=0``).  Applied at the
+    context layer — the process-wide matrix compile cache stays purely
+    logical, so changing the placement never poisons it.  The (model,
+    perm) pair is read as ONE snapshot: reading the attributes separately
+    could blend a new model with the old permutation mid-set_topology."""
+    from bluefog_tpu.utils import config
+    model, perm = _ctx._placement_state if _state is None else _state
+    if model is None:
+        return sched
+    from bluefog_tpu.ops import schedule_opt as SO
+    return SO.congestion_aware_repack(
+        sched, model, perm,
+        budget_factor=config.get().placement_round_budget)
+
+
+def _physical_repack_dynamic(dyn):
+    state = _ctx._placement_state
+    if state[0] is None:
+        return dyn
+    return S.DynamicSchedule(
+        n=dyn.n, phases=tuple(_physical_repack(ph, state)
+                              for ph in dyn.phases))
+
+
+def placement_info() -> Optional[dict]:
+    """Summary of the active physical placement (None when no interconnect
+    model is active): model name, whether a non-identity permutation is
+    installed, and the modeled identity vs optimized link costs."""
+    ctx = _require_init()
+    res = ctx.placement_result
+    if res is None:
+        return None
+    return {
+        "model": res.model_name,
+        "identity": bool(res.is_identity),
+        "max_link_load_naive": res.identity_cost.max_link_load,
+        "max_link_load_opt": res.optimized_cost.max_link_load,
+        "hop_bytes_naive": res.identity_cost.hop_bytes,
+        "hop_bytes_opt": res.optimized_cost.hop_bytes,
+        "improvement_ratio": res.improvement_ratio,
+    }
 
 
 def load_topology() -> nx.DiGraph:
@@ -764,15 +984,20 @@ def _nbr_schedule(weights: Optional[np.ndarray]):
     The key doubles as the jit-cache key component, so compiled closures are
     tied to schedule *content*, never to recyclable object identities."""
     ctx = _require_init()
+    # placement_generation keys the physical repack: a schedule compiled
+    # while set_topology was mid-placement-refresh stays under the old
+    # generation and is never served against the new placement.
     if weights is not None:
-        key = ("static_override", weights.tobytes())
+        key = ("static_override", weights.tobytes(),
+               ctx.placement_generation)
         return ctx.static_schedule(
-            key,
-            lambda: S.compile_static(load_topology(), src_weights=weights)), key
-    key = ("static", ctx.topology_version, ctx.is_topo_weighted)
+            key, lambda: _physical_repack(
+                S.compile_static(load_topology(), src_weights=weights))), key
+    key = ("static", ctx.topology_version, ctx.is_topo_weighted,
+           ctx.placement_generation)
     return ctx.static_schedule(
-        key, lambda: S.compile_static(
-            load_topology(), use_topo_weights=ctx.is_topo_weighted)), key
+        key, lambda: _physical_repack(S.compile_static(
+            load_topology(), use_topo_weights=ctx.is_topo_weighted))), key
 
 
 def neighbor_allreduce_nonblocking(x, *, self_weight=None, src_weights=None,
@@ -800,15 +1025,17 @@ def dynamic_neighbor_allreduce_nonblocking(x, step: int, *,
 
     ``phases`` defaults to the phase table of the active topology."""
     ctx = _require_init()
-    key = ("dynamic", ctx.topology_version) if phases is None else (
-        "dynphases", tuple(ph.send_to for ph in phases))
+    gen = ctx.placement_generation
+    key = ("dynamic", ctx.topology_version, gen) if phases is None else (
+        "dynphases", tuple(ph.send_to for ph in phases), gen)
     if phases is None:
         sched = ctx.static_schedule(
-            key, lambda: S.compile_dynamic(
-                topology_util.dynamic_phase_table(load_topology()), size()))
+            key, lambda: _physical_repack_dynamic(S.compile_dynamic(
+                topology_util.dynamic_phase_table(load_topology()), size())))
     else:
         sched = ctx.static_schedule(
-            key, lambda: S.compile_dynamic(phases, size()))
+            key, lambda: _physical_repack_dynamic(
+                S.compile_dynamic(phases, size())))
     step_arr = jnp.asarray(step, dtype=jnp.int32)
     fn = partial(C.dynamic_neighbor_allreduce, sched=sched, axis_name=RANK_AXIS)
     return _dispatch_flat(("dynamic_neighbor_allreduce", key),

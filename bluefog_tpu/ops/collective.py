@@ -49,26 +49,44 @@ __all__ = [
 
 
 def schedule_wire_stats(sched) -> tuple:
-    """``(rounds, edges)`` of a compiled schedule — the per-call wire-cost
-    metadata telemetry records at dispatch time (the op bodies here are
-    traced into one XLA program, so Python-side counters cannot live in
-    them; the schedule is the ground truth for what the program moves).
+    """``(rounds, edges, hops)`` of a compiled schedule — the per-call
+    wire-cost metadata telemetry records at dispatch time (the op bodies
+    here are traced into one XLA program, so Python-side counters cannot
+    live in them; the schedule is the ground truth for what the program
+    moves).
 
     ``StaticSchedule``/``PairGossipSchedule``: rounds is the ppermute count
     per call, edges the total (src, dst) pairs across them.  A
     ``DynamicSchedule`` executes ONE phase per call (``lax.switch``), so
-    rounds/edges are averaged over the period — the exact per-call value
+    all three are averaged over the period — the exact per-call value
     for uniform phases (one-peer walks), the expectation otherwise.
+
+    ``hops`` is the modeled physical cost: the weighted link-crossing
+    count of one call under the active interconnect model and placement
+    (``ops/placement``), at unit payload per edge — the dispatch layer
+    scales it by the per-rank row bytes into
+    ``bf_schedule_hop_bytes_total``.  None when no physical model is
+    active (the historical two-element view, extended).
 
     Counts reflect the schedule AS COMPILED: with the min-round repack on
     (``BLUEFOG_TPU_SCHEDULE_OPT``, default) the rounds gauge is the
     optimized ``max(max_outdeg, max_indeg)`` count, not the shift-distance
     decomposition's; edges are invariant under repacking."""
     phases = getattr(sched, "phases", None)
+    from bluefog_tpu.ops import placement as PL
     if phases is not None:  # DynamicSchedule
-        per = [schedule_wire_stats(ph) for ph in phases]
+        per = [_logical_rounds_edges(ph) for ph in phases]
         k = max(len(per), 1)
-        return (sum(r for r, _ in per) / k, sum(e for _, e in per) / k)
+        # Hops delegate to the one implementation of the per-call phase
+        # average (it caches the dynamic-level value, so per-phase hops
+        # are not recomputed here just to be discarded).
+        return (sum(r for r, _ in per) / k,
+                sum(e for _, e in per) / k,
+                PL.modeled_schedule_hops(sched))
+    return _logical_rounds_edges(sched) + (PL.modeled_schedule_hops(sched),)
+
+
+def _logical_rounds_edges(sched) -> tuple:
     rnd = getattr(sched, "round", None)
     rounds = sched.rounds if rnd is None else [rnd]
     return (len(rounds), sum(len(r.pairs) for r in rounds))
@@ -310,27 +328,13 @@ def dynamic_neighbor_allreduce(x: jnp.ndarray, step: jnp.ndarray,
     return lax.switch(step % sched.period, branches, x)
 
 
-def _slot_tables(sched: StaticSchedule) -> list[np.ndarray]:
-    """Per-round output slot of each receiving rank for ordered concat.
-
-    Slot = position of the arriving src in the receiver's ascending in-neighbor
-    list (the order ``neighbor_allgather`` outputs use), -1 when silent.
-    """
-    in_nbrs: list[list[int]] = [[] for _ in range(sched.n)]
-    for rnd in sched.rounds:
-        for s, d in rnd.pairs:
-            in_nbrs[d].append(s)
-    for lst in in_nbrs:
-        lst.sort()
-    tables = []
-    for rnd in sched.rounds:
-        slot = np.full(sched.n, -1, dtype=np.int32)
-        for dst in range(sched.n):
-            s = rnd.src_of[dst]
-            if s >= 0:
-                slot[dst] = in_nbrs[dst].index(int(s))
-        tables.append(slot)
-    return tables
+def _slot_tables(sched: StaticSchedule) -> list:
+    """Per-round output slot tables for ordered concat — now cached on the
+    schedule itself (``StaticSchedule.slot_tables``), so repeated retraces
+    of ``neighbor_allgather`` against one schedule don't rebuild
+    O(rounds·n) Python tables each time.  Kept as a thin delegate for
+    callers/tests addressing the historical name."""
+    return list(sched.slot_tables)
 
 
 def neighbor_allgather(x: jnp.ndarray, sched: StaticSchedule,
@@ -347,7 +351,7 @@ def neighbor_allgather(x: jnp.ndarray, sched: StaticSchedule,
     idx = _axis_index(axis_name)
     k = max(sched.max_indegree, 1)
     out = jnp.zeros((k,) + x.shape, dtype=x.dtype)
-    for rnd, slots in zip(sched.rounds, _slot_tables(sched)):
+    for rnd, slots in zip(sched.rounds, sched.slot_tables):
         recv = lax.ppermute(x, axis_name, rnd.pairs)  # zeros when silent
         slot = jnp.maximum(_const(slots, jnp.int32)[idx], 0)
         out = lax.dynamic_update_index_in_dim(

@@ -31,6 +31,8 @@ import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
+from bluefog_tpu import _compat
+
 __all__ = ["flash_attention", "flash_attention_lse",
            "flash_attention_impl"]
 
@@ -138,13 +140,13 @@ def _fwd(q, k, v, *, causal, block_q, block_k, interpret, vma=None):
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, S, D), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, S, 1), jnp.float32, vma=vma),
+            _compat.shape_dtype_struct((bh, S, D), q.dtype, vma=vma),
+            _compat.shape_dtype_struct((bh, S, 1), jnp.float32, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)
@@ -284,7 +286,7 @@ def _bwd(block_q, block_k, interpret, vma, res, cotangents):
         red_dq = red_kv = lambda i, j: j
 
     from jax.experimental.pallas import tpu as pltpu
-    params = dict(compiler_params=pltpu.CompilerParams(
+    params = dict(compiler_params=_compat.tpu_compiler_params(
         dimension_semantics=("parallel", "parallel", "arbitrary")))
 
     dq = pl.pallas_call(
@@ -294,7 +296,7 @@ def _bwd(block_q, block_k, interpret, vma, res, cotangents):
         in_specs=[q_at(own), k_at(red_dq), k_at(red_dq), q_at(own),
                   r_at(own), r_at(own)],
         out_specs=q_at(own),
-        out_shape=jax.ShapeDtypeStruct((bh, S, D), qf.dtype, vma=vma),
+        out_shape=_compat.shape_dtype_struct((bh, S, D), qf.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret, **params,
     )(qf, kf, vf, dof, lse3, delta)
@@ -307,8 +309,8 @@ def _bwd(block_q, block_k, interpret, vma, res, cotangents):
                   r_at(red_kv), r_at(red_kv)],
         out_specs=[k_at(own), k_at(own)],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, S, D), kf.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, S, D), vf.dtype, vma=vma),
+            _compat.shape_dtype_struct((bh, S, D), kf.dtype, vma=vma),
+            _compat.shape_dtype_struct((bh, S, D), vf.dtype, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],

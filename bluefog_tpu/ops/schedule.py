@@ -105,6 +105,30 @@ class StaticSchedule:
         return bool((self.indegree == self.indegree[0]).all()
                     and (self.outdegree == self.outdegree[0]).all())
 
+    @cached_property
+    def slot_tables(self) -> Tuple[np.ndarray, ...]:
+        """Per-round output slot of each receiving rank for ordered concat
+        (``neighbor_allgather``): slot = position of the arriving src in
+        the receiver's ascending in-neighbor list, -1 when silent.  Cached
+        on the schedule so ops retracing against it (new shapes/dtypes)
+        don't rebuild O(rounds·n) Python tables per trace — the same
+        retrace tax ``CommRound.dst_of`` already pays once."""
+        in_nbrs: List[List[int]] = [[] for _ in range(self.n)]
+        for rnd in self.rounds:
+            for s, d in rnd.pairs:
+                in_nbrs[d].append(s)
+        for lst in in_nbrs:
+            lst.sort()
+        tables = []
+        for rnd in self.rounds:
+            slot = np.full(self.n, -1, dtype=np.int32)
+            for dst in range(self.n):
+                s = rnd.src_of[dst]
+                if s >= 0:
+                    slot[dst] = in_nbrs[dst].index(int(s))
+            tables.append(slot)
+        return tuple(tables)
+
 
 @dataclass(frozen=True, eq=False)
 class DynamicSchedule:

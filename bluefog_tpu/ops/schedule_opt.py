@@ -26,6 +26,13 @@ each edge's (unchanged) weight to a different round, so the combine is
 identical up to floating-point summation order (≤1e-6 at fp32, verified by
 ``tests/test_schedule_opt.py`` against the naive schedule on a CPU mesh).
 
+When a physical interconnect model is active (:mod:`ops/placement`),
+:func:`congestion_aware_repack` extends the repack with the opposite move:
+edges of one round that share a saturated physical link serialize on the
+wire anyway, so they are SPLIT across rounds (up to a round-count budget,
+default 2x the König bound — ``BLUEFOG_TPU_PLACEMENT_ROUND_BUDGET``)
+whenever the link-load cost model says an extra round beats contending.
+
 The module also owns the process-level **compile cache**: dynamic phase
 tables recompile one ``StaticSchedule`` per phase every time a topology is
 (re)installed, and the pure-Python decomposition + coloring is O(n·edges) —
@@ -36,6 +43,7 @@ repack), ``bf_schedule_compile_cache_{hits,misses}_total``.
 
 from __future__ import annotations
 
+import math
 import threading
 from collections import OrderedDict
 from typing import Dict, List, Tuple
@@ -44,6 +52,7 @@ import numpy as np
 
 __all__ = [
     "optimize_schedule",
+    "congestion_aware_repack",
     "min_rounds",
     "cached_schedule_from_matrix",
     "clear_compile_cache",
@@ -167,6 +176,204 @@ def optimize_schedule(sched):
                   len(sched.rounds) - k)
     return StaticSchedule(
         n=n, rounds=tuple(rounds), self_scale=sched.self_scale,
+        indegree=sched.indegree, outdegree=sched.outdegree)
+
+
+# ---------------------------------------------------------------------------
+# Congestion-aware round packing (physical-topology extension of the repack)
+# ---------------------------------------------------------------------------
+
+def _rebuild_rounds(rounds_edges, n):
+    """Materialize CommRounds from per-round ``(src, dst, weight)`` groups."""
+    from bluefog_tpu.ops.schedule import CommRound
+    out = []
+    for grp in rounds_edges:
+        if not grp:
+            continue
+        pairs = tuple(sorted((s, d) for s, d, _ in grp))
+        send_scale = np.zeros(n)
+        recv_mask = np.zeros(n)
+        src_of = np.full(n, -1, dtype=np.int32)
+        for s, d, w in grp:
+            send_scale[s] = w
+            recv_mask[d] = 1.0
+            src_of[d] = s
+        out.append(CommRound(pairs, send_scale, recv_mask, src_of))
+    return tuple(out)
+
+
+def congestion_aware_repack(sched, model, perm=None, *,
+                            budget_factor: float = 2.0,
+                            max_moves: int = 256,
+                            record: bool = True):
+    """Split physically-contended rounds of a ``StaticSchedule``.
+
+    The König repack above packs edges into the *fewest* rounds — optimal
+    when every round costs one latency turn regardless of content.  On a
+    real interconnect a round costs its **bottleneck link**: several edges
+    of one round routed over the same physical link serialize on the wire
+    anyway, so a minimal-round schedule can be slower than one with more,
+    less-contended rounds.  This pass greedily moves edges off saturated
+    links into rounds (existing or new) where they fit as a partial
+    permutation, accepting a move only when the modeled cost strictly
+    improves — lexicographically ``(max per-round bottleneck link load,
+    Σ per-round squared-link-load energy, round count)`` (the convex
+    energy term records progress on rounds tied at the global max), the
+    same max-link-load-first objective the placement optimizer minimizes: an
+    edge is serialized into another round exactly when the cost model says
+    that beats contending on the saturated link.  The round count never
+    exceeds ``ceil(budget_factor * König)`` (default 2×);
+    ``budget_factor <= 0`` disables the pass.  Edge set and per-edge
+    weights are untouched, so the effective weight matrix is bit-identical
+    (outputs shift only by fp summation order, like the König repack
+    itself).
+
+    ``model``/``perm``: the active interconnect model and logical→device
+    permutation (:mod:`bluefog_tpu.ops.placement`).  Schedules whose rank
+    count does not match the model pass through unchanged.  ``record=
+    False`` skips the moves counter — for cost-pricing repacks (the
+    ``bf_schedule_max_link_load`` gauge) that never dispatch, so the
+    telemetry only counts moves applied to schedules that actually run.
+    """
+    from bluefog_tpu.ops.schedule import StaticSchedule
+    from bluefog_tpu.utils import telemetry
+
+    if model is None or budget_factor <= 0 or len(sched.rounds) <= 0:
+        return sched
+    n = sched.n
+    if len(model.device_node) != n:
+        return sched
+    node = np.asarray(model.device_node, np.int64)
+    if perm is None:
+        perm = np.arange(n, dtype=np.int64)
+    lw = model.link_weights
+    n_links = model.n_links
+
+    # Flatten to (src, dst, weight) + per-edge route ids.
+    edges = []
+    for rnd in sched.rounds:
+        for s, d in rnd.pairs:
+            edges.append((s, d, float(rnd.send_scale[s])))
+    routes = [model.route(int(node[perm[s]]), int(node[perm[d]]))
+              for s, d, _ in edges]
+    groups: List[List[int]] = []
+    counts: List[np.ndarray] = []
+    ei = 0
+    for rnd in sched.rounds:
+        grp = list(range(ei, ei + len(rnd.pairs)))
+        ei += len(rnd.pairs)
+        groups.append(grp)
+        c = np.zeros(n_links)
+        for e in grp:
+            np.add.at(c, routes[e], 1.0)
+        counts.append(c)
+
+    def bottleneck(c):
+        return float((c * lw).max()) if c.size else 0.0
+
+    def energy(c):
+        """Convex congestion energy Σ (weighted link load)².  Strictly
+        decreases on every decongesting move, so the greedy loop cannot
+        stall on a plateau where several rounds tie at the global max
+        (reducing ONE tied round leaves the max unchanged — the energy
+        term still records the progress)."""
+        return float(((c * lw) ** 2).sum())
+
+    botts = [bottleneck(c) for c in counts]
+    ens = [energy(c) for c in counts]
+    budget = max(len(groups),
+                 int(math.ceil(min_rounds(sched) * budget_factor)))
+    srcs_of = [set(edges[e][0] for e in grp) for grp in groups]
+    dsts_of = [set(edges[e][1] for e in grp) for grp in groups]
+
+    def total_key():
+        return (max(botts, default=0.0), sum(ens), len(groups))
+
+    moves = 0
+    for _ in range(max_moves):
+        if not groups:
+            break
+        base = total_key()
+        if base[0] <= 0:
+            break
+        # Every round currently pinned at the global bottleneck is a
+        # source candidate; within each, every edge crossing a maximally-
+        # loaded link.  (Considering only one argmax round would stall the
+        # pass as soon as a single tied round has no improving move.)
+        best = None  # (new_key, e, r_src, r2, is_new)
+        for r_star, c_star in enumerate(counts):
+            if botts[r_star] < base[0]:
+                continue
+            loads = c_star * lw
+            hot_links = np.nonzero(loads >= botts[r_star])[0]
+            candidates = [e for e in groups[r_star]
+                          if np.isin(routes[e], hot_links).any()]
+            for e in candidates:
+                s, d, _w = edges[e]
+                targets = [r2 for r2 in range(len(groups))
+                           if r2 != r_star and s not in srcs_of[r2]
+                           and d not in dsts_of[r2]]
+                if len(groups) < budget:
+                    targets.append(-1)  # open a new round
+                ec = np.zeros(n_links)
+                np.add.at(ec, routes[e], 1.0)
+                b1_new = bottleneck(c_star - ec)
+                e1_new = energy(c_star - ec)
+                for r2 in targets:
+                    if r2 >= 0:
+                        b2_old, e2_old = botts[r2], ens[r2]
+                        b2_new = bottleneck(counts[r2] + ec)
+                        e2_new = energy(counts[r2] + ec)
+                        new_rounds = len(groups)
+                    else:
+                        b2_old, e2_old = 0.0, 0.0
+                        b2_new, e2_new = bottleneck(ec), energy(ec)
+                        new_rounds = len(groups) + 1
+                    new_en = sum(ens) - ens[r_star] - e2_old \
+                        + e1_new + e2_new
+                    others = [b for i, b in enumerate(botts)
+                              if i not in (r_star, r2)]
+                    new_max = max(others + [b1_new, b2_new], default=0.0)
+                    new_key = (new_max, new_en, new_rounds)
+                    if new_key < base and (best is None
+                                           or new_key < best[0]):
+                        best = (new_key, e, r_star, r2, r2 < 0)
+        if best is None:
+            break
+        _, e, r_star, r2, is_new = best
+        s, d, _w = edges[e]
+        groups[r_star].remove(e)
+        ec = np.zeros(n_links)
+        np.add.at(ec, routes[e], 1.0)
+        counts[r_star] = counts[r_star] - ec
+        botts[r_star] = bottleneck(counts[r_star])
+        ens[r_star] = energy(counts[r_star])
+        srcs_of[r_star].discard(s)
+        dsts_of[r_star].discard(d)
+        if is_new:
+            groups.append([e])
+            counts.append(ec.copy())
+            botts.append(bottleneck(ec))
+            ens.append(energy(ec))
+            srcs_of.append({s})
+            dsts_of.append({d})
+        else:
+            groups[r2].append(e)
+            counts[r2] = counts[r2] + ec
+            botts[r2] = bottleneck(counts[r2])
+            ens[r2] = energy(counts[r2])
+            srcs_of[r2].add(s)
+            dsts_of[r2].add(d)
+        moves += 1
+
+    if moves == 0:
+        return sched
+    if record:
+        telemetry.inc("bf_schedule_congestion_moves_total", moves)
+    rounds = _rebuild_rounds(
+        [[edges[e] for e in grp] for grp in groups if grp], n)
+    return StaticSchedule(
+        n=n, rounds=rounds, self_scale=sched.self_scale,
         indegree=sched.indegree, outdegree=sched.outdegree)
 
 

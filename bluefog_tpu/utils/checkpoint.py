@@ -180,11 +180,13 @@ def restore_host(path: str, *, step: Optional[int] = None) -> Any:
     storage instead; the world-size resharding path of ``utils.elastic``
     fits the result to the live geometry afterwards."""
     import orbax.checkpoint as ocp
+
+    from bluefog_tpu import _compat
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step:010d}")
     ckpt = _checkpointer()
-    meta = ckpt.metadata(path).item_metadata.tree
+    meta = _compat.checkpoint_tree_metadata(ckpt, path)
     restore_args = jax.tree.map(
         lambda m: ocp.RestoreArgs(restore_type=np.ndarray), meta)
     return ckpt.restore(path,
@@ -195,10 +197,11 @@ def leaf_shapes(path: str, *, step: Optional[int] = None) -> list:
     """Shapes of the saved leaves in tree-leaf order, WITHOUT reading data
     (orbax metadata only) — lets a restarting run detect that a checkpoint
     was written by a different world geometry before attempting restore."""
+    from bluefog_tpu import _compat
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step:010d}")
-    meta = _checkpointer().metadata(path).item_metadata.tree
+    meta = _compat.checkpoint_tree_metadata(_checkpointer(), path)
     return [tuple(m.shape) for m in jax.tree.leaves(meta)]
 
 

@@ -26,6 +26,11 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_PROFILE           | 0     | 1: enable the step profiler's periodic sampling |
 | BLUEFOG_TPU_PROFILE_EVERY     | 50    | straggler-gather / synced-sample period (steps) |
 | BLUEFOG_TPU_SCHEDULE_OPT      | 1     | 0: skip the min-round schedule repack |
+| BLUEFOG_TPU_PLACEMENT         | 1     | 0: keep raw device-enumeration rank order |
+| BLUEFOG_TPU_PLACEMENT_ITERS   | 1000  | simulated-annealing refinement iterations |
+| BLUEFOG_TPU_PLACEMENT_ROUND_BUDGET | 2.0 | congestion-repack round budget (x König; 0=off) |
+| BLUEFOG_TPU_FAKE_TORUS        | unset | synthetic torus spec (e.g. 4x8) for CPU testing |
+| BLUEFOG_TPU_TORUS_WRAP        | auto  | real-coords wrap policy: auto / 1 (torus) / 0 (mesh) |
 | BLUEFOG_TPU_FUSION_BUCKET_MB  | 0     | fusion-buffer bucket cap in MiB (0=one bucket) |
 | BFTPU_COORDINATOR             | unset | set by bfrun: coordinator host:port |
 | BFTPU_NUM_PROCESSES           | unset | set by bfrun |
@@ -89,6 +94,26 @@ class Config:
     # on by default — off is the escape hatch for debugging a schedule by
     # its raw shift-distance decomposition.
     schedule_opt: bool
+    # Physical-topology-aware rank placement (ops/placement.py); on by
+    # default but structurally inert without an interconnect model (real
+    # TPU coords or BLUEFOG_TPU_FAKE_TORUS).  0 restores raw device-
+    # enumeration order exactly.
+    placement: bool
+    # Simulated-annealing refinement budget for the placement search.
+    placement_iters: int
+    # Congestion-aware round repack budget as a multiple of the König
+    # round bound (ops/schedule_opt.congestion_aware_repack); 0 disables
+    # the repack (placement permutation still applies).
+    placement_round_budget: float
+    # Synthetic torus spec ("RxC" / "XxYxZ") standing in for device
+    # coords — makes the whole placement layer testable on the CPU mesh.
+    fake_torus: Optional[str]
+    # Wraparound policy for real-coords interconnect models: "auto"
+    # (default — wrap 3-D dims that are multiples of 4 per the v4/v5p
+    # slice rule, model 2-D sub-pod slices as meshes), "1" force torus,
+    # "0" force mesh.  Modeling a wrap link that does not exist would let
+    # the optimizer install a placement that is wrong on hardware.
+    torus_wrap: str
     # Fusion-buffer bucket cap in MiB for the distributed optimizers
     # (optim/functional.py); 0 = one fused buffer (legacy behavior).  An
     # explicit fusion_buckets= argument on the optimizer overrides this.
@@ -135,6 +160,13 @@ class Config:
             telemetry_consensus_set=(
                 "BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY" in os.environ),
             schedule_opt=_flag("BLUEFOG_TPU_SCHEDULE_OPT", default=True),
+            placement=_flag("BLUEFOG_TPU_PLACEMENT", default=True),
+            placement_iters=int(
+                os.environ.get("BLUEFOG_TPU_PLACEMENT_ITERS", "1000")),
+            placement_round_budget=float(os.environ.get(
+                "BLUEFOG_TPU_PLACEMENT_ROUND_BUDGET", "2.0")),
+            fake_torus=os.environ.get("BLUEFOG_TPU_FAKE_TORUS"),
+            torus_wrap=os.environ.get("BLUEFOG_TPU_TORUS_WRAP", "auto"),
             fusion_bucket_mb=float(
                 os.environ.get("BLUEFOG_TPU_FUSION_BUCKET_MB", "0")),
             profile=_flag("BLUEFOG_TPU_PROFILE"),

@@ -129,6 +129,17 @@ def set_gauge(name: str, value: float, **labels) -> None:
         _registry.gauges[key] = float(value)
 
 
+def clear_gauge(name: str, **labels) -> None:
+    """Drop a gauge series, if present — for gauges describing a subsystem
+    that has been deactivated, where a stale last value would misreport
+    (e.g. the placement gauges after ``BLUEFOG_TPU_PLACEMENT=0``).
+    Runs even when telemetry is disabled: the registry renders
+    unconditionally, so a stale key must go regardless."""
+    key = _key(name, labels)
+    with _registry.lock:
+        _registry.gauges.pop(key, None)
+
+
 # Log-spaced latency bucket boundaries, 1 µs .. 50 s (observations are
 # SECONDS).  Fixed for every histogram series: one shared boundary table
 # keeps observe() at a single bisect (≤ ~1µs) and makes the cross-rank
@@ -600,23 +611,31 @@ def maybe_start_endpoint() -> Optional[int]:
 def record_comm_traffic(op: str, nbytes: float, *, size: int,
                         sched_stats=None, calls: float = 1.0) -> None:
     """The one accounting formula for collective traffic: calls, element
-    bytes, and — given ``sched_stats = (rounds, edges)`` from
+    bytes, and — given ``sched_stats = (rounds, edges[, hops])`` from
     ``collective.schedule_wire_stats`` — rounds/edges/estimated wire bytes
-    (one ``nbytes / size`` per-rank row per directed edge).  Used by the
-    dispatch layer (``basics._record_dispatch``) per call and by
-    ``bench.py`` to account a whole jitted run at once, so the two can
-    never drift apart."""
+    (one ``nbytes / size`` per-rank row per directed edge).  When the
+    stats carry a modeled hop count (a physical interconnect model is
+    active — ``ops/placement``), ``bf_schedule_hop_bytes_total`` records
+    the PHYSICAL wire cost: per-rank row bytes times weighted link
+    crossings, i.e. what the traffic actually costs the torus/DCN, not
+    just the logical edge count.  Used by the dispatch layer
+    (``basics._record_dispatch``) per call and by ``bench.py`` to account
+    a whole jitted run at once, so the two can never drift apart."""
     if not config.get().telemetry:
         return
     inc("bf_comm_calls_total", calls, op=op)
     inc("bf_comm_bytes_total", float(nbytes) * calls, op=op)
     if sched_stats is not None:
-        rounds, edges = sched_stats
+        rounds, edges = sched_stats[0], sched_stats[1]
+        hops = sched_stats[2] if len(sched_stats) > 2 else None
         inc("bf_comm_rounds_total", rounds * calls, op=op)
         inc("bf_comm_edges_total", edges * calls, op=op)
         set_gauge("bf_comm_peers", edges, op=op)
         inc("bf_comm_wire_bytes_total",
             float(nbytes) / max(size, 1) * edges * calls, op=op)
+        if hops is not None:
+            inc("bf_schedule_hop_bytes_total",
+                float(nbytes) / max(size, 1) * hops * calls, op=op)
 
 
 # ---------------------------------------------------------------------------

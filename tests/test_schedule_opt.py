@@ -179,8 +179,8 @@ def test_wire_stats_report_optimized_rounds():
     w = topo.weight_matrix(topo.RandomRegularGraph(32, 4, seed=0))
     naive = S._build_schedule(w, optimize=False)
     opt = S._build_schedule(w, optimize=True)
-    r0, e0 = C.schedule_wire_stats(naive)
-    r1, e1 = C.schedule_wire_stats(opt)
+    r0, e0, _ = C.schedule_wire_stats(naive)
+    r1, e1, _ = C.schedule_wire_stats(opt)
     assert r1 == 4 and r0 > r1
     assert e0 == e1 == 32 * 4
 
