@@ -7,14 +7,15 @@ PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
-        placement-smoke
+        placement-smoke synth-smoke
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
 # schedule-regression smoke (bench_comm asserts the min-round repack is
 # output-equivalent and never worse than naive — a broken repack fails
 # here loudly, not as a silent slowdown).
-test: test-fast bench-comm-smoke prof-smoke transport-smoke placement-smoke
+test: test-fast bench-comm-smoke prof-smoke transport-smoke placement-smoke \
+      synth-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -66,6 +67,17 @@ prof-smoke:
 # order on the virtual CPU mesh.
 placement-smoke:
 	env JAX_PLATFORMS=cpu python bench_comm.py --placement-smoke
+
+# Schedule-synthesis CI gate: modeled serial-link-time report across
+# ring/Exp2/star/random-regular on simulated 4x8, 8x8 and multi-slice
+# tori — asserts the sketch synthesis strictly beats the congestion
+# repack on the acceptance cases (and ties ONLY at the provable
+# busiest-link-total lower bound), preserves the effective weight matrix
+# bit-identically, stays within the round budget, drives a synthesized
+# schedule end-to-end on the virtual CPU mesh (<= 1e-6), and that
+# BLUEFOG_TPU_SCHEDULE_SYNTH=0 restores the PR-5 dispatch path.
+synth-smoke:
+	env JAX_PLATFORMS=cpu python bench_comm.py --synth-smoke
 
 # CPU-runnable loopback two-transport exchange over the coalesced DCN
 # path: asserts batched delivery actually happened (OP_BATCH frames on

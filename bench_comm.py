@@ -82,6 +82,16 @@ def _parse_args():
     p.add_argument("--placement-iters", type=int, default=1000,
                    help="simulated-annealing refinement iterations for "
                         "the placement search (default 1000)")
+    p.add_argument("--synth", action="store_true",
+                   help="run the schedule-synthesis report: modeled "
+                        "serial_link_time naive / congestion-packed / "
+                        "synthesized across ring/Exp2/star/random-regular "
+                        "on simulated 4x8, 8x8 and multi-slice tori, plus "
+                        "an end-to-end output-equivalence check of a "
+                        "synthesized schedule on the virtual CPU mesh")
+    p.add_argument("--synth-smoke", action="store_true",
+                   help="CI variant of --synth (same assertions — the "
+                        "cost model is pure host math)")
     return p.parse_args()
 
 
@@ -248,12 +258,7 @@ def placement_main(args) -> int:
         n = dims[0] * dims[1]
         model = PL.synthetic_torus(dims)
         per_topo = {}
-        for name, make in (
-                ("ring", lambda: topo.RingGraph(n)),
-                ("exp2", lambda: topo.ExponentialTwoGraph(n)),
-                ("star", lambda: topo.StarGraph(n)),
-                ("random_regular",
-                 lambda: topo.RandomRegularGraph(n, 4, seed=seed))):
+        for name, make in _topo_families(topo, n, seed):
             w = topo.weight_matrix(make())
             sched = S._build_schedule(w, optimize=True)
             res = PL.optimize_placement(model, sched, n,
@@ -364,12 +369,219 @@ def placement_main(args) -> int:
     return 0
 
 
+def _topo_families(topo, n, seed, degree=4):
+    """The four benchmark topology families every report sweeps."""
+    return (
+        ("ring", lambda: topo.RingGraph(n)),
+        ("exp2", lambda: topo.ExponentialTwoGraph(n)),
+        ("star", lambda: topo.StarGraph(n)),
+        ("random_regular",
+         lambda: topo.RandomRegularGraph(n, degree, seed=seed)),
+    )
+
+
+def synth_main(args) -> int:
+    """Schedule-synthesis report (and the `make synth-smoke` CI gate).
+
+    Part 1 is pure host math: for each simulated torus (4x8, 8x8, a
+    2-slice 4x8 and a 4-slice 4x4) and each topology family, compare
+    modeled serial_link_time of the König schedule, the congestion-aware
+    repack, and the sketch-synthesis selection, all under identity
+    placement (isolating the round-assignment axis).  Asserts the
+    selection NEVER loses to the packed schedule, beats it strictly on
+    the acceptance cases (exp2 + random-regular on the tori with
+    headroom), and — where it ties on exp2/random-regular — that the
+    packed schedule already sits on the provable busiest-link-total lower
+    bound, i.e. no schedule could do better.  Effective weight matrices
+    must survive synthesis bit-identically and round budgets must hold.
+
+    Part 2 drives a genuinely synthesized schedule end-to-end through the
+    real ppermute executor on a 32-device virtual CPU mesh and asserts
+    output equivalence <= 1e-6 vs the naive schedule, then checks the
+    `BLUEFOG_TPU_SCHEDULE_SYNTH=0` hatch restores the PR-5 dispatch path
+    (no synthesis info, no synthesis gauges) with equivalent outputs."""
+    import math as _math
+
+    # The e2e leg needs a >= 32-device virtual mesh: size it BEFORE any
+    # jax import (same contract as the schedule bench below).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=32")
+
+    import numpy as np
+
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu.ops import schedule_opt as SO
+    from bluefog_tpu.ops import synthesis as SY
+
+    smoke = args.synth_smoke
+    budget = 2.0
+    tori = {
+        "4x8": PL.synthetic_torus((4, 8)),
+        "8x8": PL.synthetic_torus((8, 8)),
+        "2x(4x8)": PL.synthetic_torus((4, 8), n_slices=2),
+        "4x(4x4)": PL.synthetic_torus((4, 4), n_slices=4),
+    }
+    # The acceptance cases: exp2 + random-regular(4) must win strictly
+    # wherever the packed schedule is NOT already at the lower bound.
+    detail = {}
+    strict_wins = []
+    for tname, model in tori.items():
+        n = len(model.device_node)
+        per_topo = {}
+        for name, make in _topo_families(topo, n, args.seed):
+            w = topo.weight_matrix(make())
+            naive = S._build_schedule(w, optimize=False)
+            konig = SO.optimize_schedule(naive)
+            packed = SO.congestion_aware_repack(
+                konig, model, None, budget_factor=budget, record=False)
+            chosen, ratio = SY.select_schedule(konig, packed, model, None,
+                                               budget_factor=budget)
+            ks = PL.schedule_cost(model, konig).serial_link_time
+            ps = PL.schedule_cost(model, packed).serial_link_time
+            cs = PL.schedule_cost(model, chosen).serial_link_time
+            lb = SY.serial_lower_bound(model, konig)
+            assert cs <= ps + 1e-9, \
+                f"{name}@{tname}: synthesis selection made serial WORSE"
+            assert np.array_equal(_effective_w(naive, n),
+                                  _effective_w(chosen, n)), \
+                f"{name}@{tname}: synthesis changed the weight matrix"
+            assert len(chosen.rounds) <= max(
+                len(konig.rounds),
+                _math.ceil(budget * SO.min_rounds(konig))), \
+                f"{name}@{tname}: synthesis exceeded the round budget"
+            if name in ("exp2", "random_regular"):
+                if cs < ps - 1e-9:
+                    strict_wins.append(f"{name}@{tname}")
+                else:
+                    # No win allowed ONLY at provable optimality.
+                    assert ps <= lb + 1e-9, (
+                        f"{name}@{tname}: synthesis tied the packed "
+                        f"schedule at {ps} > lower bound {lb} — headroom "
+                        "left on the table")
+            per_topo[name] = {
+                "serial_konig": ks, "serial_packed": ps,
+                "serial_synth": cs, "lower_bound": lb,
+                "rounds_synth": len(chosen.rounds),
+                "provenance": S.schedule_provenance(chosen),
+                "improvement_ratio": round(ps / max(cs, 1e-12), 3),
+            }
+        detail[tname] = per_topo
+    for required in ("exp2@8x8", "random_regular@8x8",
+                     "random_regular@4x(4x4)"):
+        assert required in strict_wins, (
+            f"synthesis must beat congestion_aware_repack strictly on "
+            f"{required}; wins: {strict_wins}")
+
+    # ---- Part 2a: synthesized schedule through the real ppermute path.
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    devs = jax.devices()
+    e2e = {}
+    if len(devs) >= 32:
+        n = 32
+        mesh = Mesh(np.asarray(devs[:n]), ("r",))
+        from bluefog_tpu.ops import collective as C
+        model = PL.synthetic_torus((4, 8))
+        w = topo.weight_matrix(topo.ExponentialTwoGraph(n))
+        naive = S._build_schedule(w, optimize=False)
+        konig = SO.optimize_schedule(naive)
+        packed = SO.congestion_aware_repack(konig, model, None,
+                                            budget_factor=budget,
+                                            record=False)
+        chosen, ratio = SY.select_schedule(konig, packed, model, None,
+                                           budget_factor=budget)
+        assert S.schedule_provenance(chosen).startswith("synthesized"), \
+            "e2e leg expected a synthesized win for exp2(32) on 4x8"
+        x = jnp.asarray(np.random.default_rng(args.seed)
+                        .standard_normal((n, 256)), jnp.float32)
+
+        def run(sched):
+            return np.asarray(jax.jit(jax.shard_map(
+                lambda b: C.neighbor_allreduce(b[0], sched, "r")[None],
+                mesh=mesh, in_specs=P("r"), out_specs=P("r"),
+                check_vma=False))(x))
+        diff = float(np.abs(run(naive) - run(chosen)).max())
+        assert diff <= 1e-6, \
+            f"synthesized schedule drifted outputs by {diff} (> 1e-6)"
+        e2e["synth_vs_naive_max_diff"] = diff
+        e2e["synth_provenance"] = S.schedule_provenance(chosen)
+        e2e["synth_serial"] = PL.schedule_cost(model, chosen).serial_link_time
+        e2e["packed_serial"] = PL.schedule_cost(model, packed).serial_link_time
+
+    # ---- Part 2b: the env hatch restores the PR-5 dispatch path.
+    import bluefog_tpu as bf
+    from bluefog_tpu.utils import config, telemetry
+    knobs = ("BLUEFOG_TPU_SCHEDULE_SYNTH", "BLUEFOG_TPU_FAKE_TORUS",
+             "BLUEFOG_TPU_PLACEMENT")
+    saved = {k: os.environ.get(k) for k in knobs}
+    topo_fn = lambda: topo.RandomRegularGraph(8, 4, seed=1)
+    x8 = np.random.default_rng(args.seed).standard_normal(
+        (8, 64)).astype(np.float32)
+
+    def run_ctx(**env):
+        for k in knobs:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        config.reload()
+        bf.init(topo_fn, devices=jax.devices()[:8])
+        out = np.asarray(bf.neighbor_allreduce(x8))
+        info = bf.synthesis_info()
+        snap = telemetry.snapshot() if telemetry.enabled() else {}
+        bf.shutdown()
+        return out, info, snap
+
+    try:
+        out_off, info_off, snap_off = run_ctx(
+            BLUEFOG_TPU_SCHEDULE_SYNTH="0", BLUEFOG_TPU_FAKE_TORUS="2x4")
+        out_on, info_on, snap_on = run_ctx(
+            BLUEFOG_TPU_SCHEDULE_SYNTH="1", BLUEFOG_TPU_FAKE_TORUS="2x4")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        config.reload()
+    assert info_off is None, \
+        "SCHEDULE_SYNTH=0 must disable the synthesis pipeline entirely"
+    assert "bf_schedule_synth_improvement_ratio" not in snap_off
+    assert info_on is not None and info_on["improvement_ratio"] >= 1.0
+    assert snap_on.get("bf_schedule_synth_improvement_ratio", 0) >= 1.0
+    hatch_diff = float(np.abs(out_off - out_on).max())
+    assert hatch_diff <= 1e-6, \
+        f"env hatch outputs drifted by {hatch_diff} (> 1e-6)"
+    e2e["hatch_max_diff"] = hatch_diff
+
+    rr = detail["8x8"]["random_regular"]
+    print(json.dumps({
+        "metric": "gossip_schedule_synth_serial_time_reduction_rr_8x8",
+        "value": rr["improvement_ratio"],
+        "unit": "x",
+        "detail": {
+            "smoke": smoke,
+            "strict_wins": strict_wins,
+            "tori": detail,
+            "e2e": e2e,
+        },
+    }))
+    return 0
+
+
 def main():
     args = _parse_args()
     if args.transport or args.transport_smoke:
         return transport_main(args)
     if args.placement or args.placement_smoke:
         return placement_main(args)
+    if args.synth or args.synth_smoke:
+        return synth_main(args)
     if args.smoke:
         args.n = args.n or 8
         args.payload = min(args.payload, 1024)
@@ -423,13 +635,8 @@ def main():
             f"--degree {args.degree} (n * degree must be even and "
             "0 < degree < n); use an even --n or a larger --degree")
 
-    topologies = {
-        "ring": lambda: topo.RingGraph(n),
-        "exp2": lambda: topo.ExponentialTwoGraph(n),
-        "star": lambda: topo.StarGraph(n),
-        "random_regular": lambda: topo.RandomRegularGraph(
-            n, rr_degree, seed=args.seed),
-    }
+    topologies = dict(_topo_families(topo, n, args.seed,
+                                     degree=rr_degree))
 
     rng = np.random.default_rng(args.seed)
     x = jnp.asarray(rng.standard_normal((n, args.payload)), jnp.float32)
@@ -459,8 +666,8 @@ def main():
         w = topo.weight_matrix(make())
         naive = S._build_schedule(w, optimize=False)
         opt = S._build_schedule(w, optimize=True)
-        r0, e0, _ = C.schedule_wire_stats(naive)
-        r1, e1, _ = C.schedule_wire_stats(opt)
+        r0, e0 = C.schedule_wire_stats(naive)[:2]
+        r1, e1 = C.schedule_wire_stats(opt)[:2]
         assert e0 == e1, f"{name}: repack changed the edge set ({e0} -> {e1})"
         assert r1 <= r0, f"{name}: repack emitted MORE rounds ({r0} -> {r1})"
         assert r1 == SO.min_rounds(opt), \

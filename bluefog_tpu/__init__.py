@@ -43,6 +43,7 @@ from bluefog_tpu.basics import (  # noqa: F401
     set_topology,
     set_machine_topology,
     placement_info,
+    synthesis_info,
     load_topology,
     load_machine_topology,
     in_neighbor_ranks,

@@ -67,6 +67,12 @@ class _Context:
         self.placement_model = None
         self.placement: Optional[np.ndarray] = None
         self.placement_result = None
+        # Schedule-synthesis pricing of the last placement refresh: the
+        # packed/chosen serial-time ratio over every priced phase and the
+        # provenance of the static schedule that will dispatch (None when
+        # synthesis or the model is off).
+        self.synthesis_ratio: Optional[float] = None
+        self.synthesis_provenance: Optional[str] = None
         # Atomic (model, perm) snapshot read by _physical_repack, plus a
         # generation folded into the schedule cache keys: a dispatch racing
         # set_topology must never pair the new model with the old perm, nor
@@ -141,6 +147,8 @@ def _reset_for_tests():
     _placement.set_active(None, None)
     _placement_model_cache.clear()
     _placement_search_cache.clear()
+    from bluefog_tpu.ops import synthesis as _synthesis
+    _synthesis.clear_synth_cache()
 
 
 def _require_init() -> _Context:
@@ -484,18 +492,29 @@ def _placement_model(devices):
     return _placement_model_cache[key]
 
 
-def _placement_search(model, scheds, n, *, iters, block, budget):
-    """Memoized ``(PlacementResult, packed max-link-load)`` for a model +
-    schedule set (see ``_placement_search_cache``)."""
+def _placement_search(model, scheds, n, *, iters, block, budget,
+                      synth=False, sketch="auto"):
+    """Memoized ``(PlacementResult, dispatched max-link-load, synthesis
+    improvement ratio, dispatched provenance)`` for a model + schedule set
+    (see ``_placement_search_cache``).
+
+    With ``synth`` on, the pricing runs the same packed-vs-synthesized
+    selection the dispatch path applies, so the gauge values describe the
+    schedules that actually run — and the cache key carries the synthesis
+    knobs (the provenance of the priced path), so a
+    ``BLUEFOG_TPU_SCHEDULE_SYNTH`` toggle mid-process can never be served
+    a stale-path entry."""
     from bluefog_tpu.ops import placement as PL
     from bluefog_tpu.ops import schedule_opt as SO
+    from bluefog_tpu.ops.schedule import schedule_provenance
     sig = []
     for s in scheds:
         phs = getattr(s, "phases", None)
         for ph in (phs if phs is not None else (s,)):
             sig.extend(rnd.pairs for rnd in ph.rounds)
     key = (model.name, model.dims, model.wrap_dims, model.device_node,
-           tuple(sig), n, iters, block, budget)
+           tuple(sig), n, iters, block, budget, synth,
+           sketch if synth else None)
     hit = _placement_search_cache.get(key)
     if hit is not None:
         _placement_search_cache.move_to_end(key)
@@ -503,21 +522,40 @@ def _placement_search(model, scheds, n, *, iters, block, budget):
     result = PL.optimize_placement(model, scheds, n, iters=iters, seed=0,
                                    block=block)
     # The bf_schedule_max_link_load gauge describes what actually
-    # dispatches: the placed AND congestion-packed schedules (record=
-    # False — these pricing repacks never run, the dispatch-layer ones
-    # recount the moves).
-    packed = []
+    # dispatches: the placed, congestion-packed AND (when enabled)
+    # synthesis-selected schedules (record=False — these pricing repacks
+    # never run, the dispatch-layer ones recount the moves).
+    dispatched = []
+    packed_serial = 0.0
+    chosen_serial = 0.0
+    static_prov = None
     for s in scheds:
         phs = getattr(s, "phases", None)
         for ph in (phs if phs is not None else (s,)):
-            packed.append(SO.congestion_aware_repack(
+            packed = SO.congestion_aware_repack(
                 ph, model, result.perm, budget_factor=budget,
-                record=False))
-    packed_mll = PL.schedule_cost(model, packed, result.perm).max_link_load
-    _placement_search_cache[key] = (result, packed_mll)
+                record=False)
+            chosen = packed
+            if synth:
+                from bluefog_tpu.ops import synthesis as SY
+                chosen, _r = SY.select_schedule(
+                    ph, packed, model, result.perm, sketch=sketch,
+                    budget_factor=budget)
+                packed_serial += PL.schedule_cost(
+                    model, packed, result.perm).serial_link_time
+                chosen_serial += PL.schedule_cost(
+                    model, chosen, result.perm).serial_link_time
+            if static_prov is None:  # scheds[0] == the static schedule
+                static_prov = schedule_provenance(chosen)
+            dispatched.append(chosen)
+    mll = PL.schedule_cost(model, dispatched, result.perm).max_link_load
+    ratio = (packed_serial / max(chosen_serial, 1e-12)
+             if synth and chosen_serial else None)
+    value = (result, mll, ratio, static_prov)
+    _placement_search_cache[key] = value
     if len(_placement_search_cache) > _PLACEMENT_SEARCH_CACHE_MAX:
         _placement_search_cache.popitem(last=False)
-    return result, packed_mll
+    return value
 
 
 def _refresh_placement(ctx) -> None:
@@ -549,6 +587,8 @@ def _refresh_placement(ctx) -> None:
     perm = None
     result = None
     packed_mll = None
+    synth_ratio = None
+    dispatch_prov = None
     if cfg.placement and n > 1 and ctx.topology is not None:
         model = _placement_model(ctx.base_devices)
     if model is not None:
@@ -561,9 +601,10 @@ def _refresh_placement(ctx) -> None:
         except ValueError:
             pass  # period too long: the static edge set covers the union
         block = ctx.local_size if 0 < ctx.local_size < n else None
-        result, packed_mll = _placement_search(
+        result, packed_mll, synth_ratio, dispatch_prov = _placement_search(
             model, scheds, n, iters=cfg.placement_iters, block=block,
-            budget=cfg.placement_round_budget)
+            budget=cfg.placement_round_budget,
+            synth=cfg.schedule_synth, sketch=cfg.schedule_synth_sketch)
         if not result.is_identity:
             perm = result.perm
     devs = ctx.base_devices if perm is None else \
@@ -578,6 +619,8 @@ def _refresh_placement(ctx) -> None:
         ctx.placement_model = model
         ctx.placement = perm
         ctx.placement_result = result
+        ctx.synthesis_ratio = synth_ratio
+        ctx.synthesis_provenance = dispatch_prov
         ctx._placement_state = (model, perm)
         ctx.placement_generation += 1
         ctx.devices = devs
@@ -589,6 +632,7 @@ def _refresh_placement(ctx) -> None:
         # already retires its cache key, this just frees the entry.
         ctx.invalidate_schedules()
     PL.set_active(model, perm)
+    from bluefog_tpu.ops import synthesis as SY
     if result is not None:
         telemetry.set_gauge("bf_placement_improvement_ratio",
                             result.improvement_ratio)
@@ -600,32 +644,76 @@ def _refresh_placement(ctx) -> None:
         # value from a previous topology would misreport /metrics.
         telemetry.clear_gauge("bf_placement_improvement_ratio")
         telemetry.clear_gauge("bf_schedule_max_link_load")
+    if synth_ratio is not None:
+        telemetry.set_gauge("bf_schedule_synth_improvement_ratio",
+                            synth_ratio)
+        SY._publish_provenance(dispatch_prov)
+    else:
+        # Synthesis off (or no model): stale synthesis gauges would claim
+        # a pipeline that is not running.
+        telemetry.clear_gauge("bf_schedule_synth_improvement_ratio")
+        SY._publish_provenance(None)
 
 
-def _physical_repack(sched, _state=None):
-    """Congestion-aware round repack of a compiled static schedule under
-    the active interconnect model + placement (no-op without a model or
-    with ``BLUEFOG_TPU_PLACEMENT_ROUND_BUDGET=0``).  Applied at the
-    context layer — the process-wide matrix compile cache stays purely
-    logical, so changing the placement never poisons it.  The (model,
-    perm) pair is read as ONE snapshot: reading the attributes separately
-    could blend a new model with the old permutation mid-set_topology."""
+def _physical_repack(sched, _state=None, _cfg=None):
+    """Physical-schedule pipeline of the dispatch path: congestion-aware
+    round repack, then (``BLUEFOG_TPU_SCHEDULE_SYNTH``, default on) the
+    sketch-guided synthesis selection — the synthesized candidate is
+    dispatched only when it strictly beats the packed schedule on modeled
+    ``serial_link_time``, so ``=0`` restores the PR-5 path exactly and
+    the synthesis path is never worse anywhere.  No-op without a model;
+    ``BLUEFOG_TPU_PLACEMENT_ROUND_BUDGET=0`` disables both the repack and
+    the synthesis (they share the round budget).  Applied at
+    the context layer — the process-wide matrix compile cache stays
+    purely logical, so changing the placement never poisons it.  The
+    (model, perm) pair is read as ONE snapshot: reading the attributes
+    separately could blend a new model with the old permutation
+    mid-set_topology.  For the same reason ``_cfg`` is the config
+    SNAPSHOT the caller computed its cache key from: re-reading
+    ``config.get()`` here could see a ``config.reload()`` that landed
+    between key time and build time and cache the other path's schedule
+    under a live key."""
     from bluefog_tpu.utils import config
     model, perm = _ctx._placement_state if _state is None else _state
     if model is None:
         return sched
     from bluefog_tpu.ops import schedule_opt as SO
-    return SO.congestion_aware_repack(
-        sched, model, perm,
-        budget_factor=config.get().placement_round_budget)
+    cfg = config.get() if _cfg is None else _cfg
+    packed = SO.congestion_aware_repack(
+        sched, model, perm, budget_factor=cfg.placement_round_budget)
+    from bluefog_tpu.ops import synthesis as SY
+    if not cfg.schedule_synth:
+        # A mid-process toggle (config.reload) switches the dispatch path
+        # here instantly; the set_topology-time synthesis gauges must not
+        # keep claiming the synthesized path still runs.
+        if _ctx.synthesis_ratio is not None:
+            from bluefog_tpu.utils import telemetry
+            _ctx.synthesis_ratio = None
+            _ctx.synthesis_provenance = None
+            telemetry.clear_gauge("bf_schedule_synth_improvement_ratio")
+            SY._publish_provenance(None)
+        return packed
+    # The symmetric 0->1 toggle: the last refresh ran with synthesis off
+    # (ratio None) but this dispatch synthesizes, so publish from this
+    # selection — otherwise bf.synthesis_info()/the gauges would claim
+    # synthesis is off while bf_comm_schedule_provenance_total counts
+    # synthesized calls.
+    publish = _ctx.synthesis_ratio is None
+    chosen, ratio = SY.select_schedule(
+        sched, packed, model, perm, sketch=cfg.schedule_synth_sketch,
+        budget_factor=cfg.placement_round_budget, record=publish)
+    if publish:
+        _ctx.synthesis_ratio = ratio
+        _ctx.synthesis_provenance = S.schedule_provenance(chosen)
+    return chosen
 
 
-def _physical_repack_dynamic(dyn):
+def _physical_repack_dynamic(dyn, _cfg=None):
     state = _ctx._placement_state
     if state[0] is None:
         return dyn
     return S.DynamicSchedule(
-        n=dyn.n, phases=tuple(_physical_repack(ph, state)
+        n=dyn.n, phases=tuple(_physical_repack(ph, state, _cfg)
                               for ph in dyn.phases))
 
 
@@ -645,6 +733,23 @@ def placement_info() -> Optional[dict]:
         "hop_bytes_naive": res.identity_cost.hop_bytes,
         "hop_bytes_opt": res.optimized_cost.hop_bytes,
         "improvement_ratio": res.improvement_ratio,
+    }
+
+
+def synthesis_info() -> Optional[dict]:
+    """Summary of the schedule-synthesis selection for the active topology
+    (None when synthesis is off or no interconnect model is active):
+    which sketch knob is set, the provenance of the schedule that
+    dispatches, and the packed→chosen modeled serial-time improvement."""
+    from bluefog_tpu.utils import config
+    ctx = _require_init()
+    cfg = config.get()
+    if not cfg.schedule_synth or ctx.synthesis_ratio is None:
+        return None
+    return {
+        "sketch": cfg.schedule_synth_sketch,
+        "provenance": ctx.synthesis_provenance,
+        "improvement_ratio": round(float(ctx.synthesis_ratio), 6),
     }
 
 
@@ -978,26 +1083,47 @@ def allgather(x, name: Optional[str] = None) -> jnp.ndarray:
     return synchronize(allgather_nonblocking(x, name))
 
 
+def _sched_path_tag(cfg=None) -> tuple:
+    """Provenance tag of the physical-schedule pipeline folded into every
+    context schedule-cache key: which passes would compile this schedule
+    (synthesis on/off + sketch, repack budget).  A knob toggle mid-process
+    (``config.reload()``) then misses the cache instead of serving a
+    schedule compiled under the other path — the cache can never hand the
+    synthesis path a stale PR-5 schedule or vice versa.  Callers pass the
+    SAME ``cfg`` snapshot to ``_physical_repack`` so a reload landing
+    between key time and build time cannot cache the other path's
+    schedule under this key."""
+    from bluefog_tpu.utils import config
+    if cfg is None:
+        cfg = config.get()
+    return (cfg.schedule_synth, cfg.schedule_synth_sketch,
+            cfg.placement_round_budget)
+
+
 def _nbr_schedule(weights: Optional[np.ndarray]):
     """Resolve (schedule, content-key) for the active static topology.
 
     The key doubles as the jit-cache key component, so compiled closures are
     tied to schedule *content*, never to recyclable object identities."""
+    from bluefog_tpu.utils import config
     ctx = _require_init()
+    cfg = config.get()
     # placement_generation keys the physical repack: a schedule compiled
     # while set_topology was mid-placement-refresh stays under the old
     # generation and is never served against the new placement.
     if weights is not None:
-        key = ("static_override", weights.tobytes(),
+        key = ("static_override", weights.tobytes(), _sched_path_tag(cfg),
                ctx.placement_generation)
         return ctx.static_schedule(
             key, lambda: _physical_repack(
-                S.compile_static(load_topology(), src_weights=weights))), key
+                S.compile_static(load_topology(), src_weights=weights),
+                _cfg=cfg)), key
     key = ("static", ctx.topology_version, ctx.is_topo_weighted,
-           ctx.placement_generation)
+           _sched_path_tag(cfg), ctx.placement_generation)
     return ctx.static_schedule(
         key, lambda: _physical_repack(S.compile_static(
-            load_topology(), use_topo_weights=ctx.is_topo_weighted))), key
+            load_topology(), use_topo_weights=ctx.is_topo_weighted),
+            _cfg=cfg)), key
 
 
 def neighbor_allreduce_nonblocking(x, *, self_weight=None, src_weights=None,
@@ -1024,18 +1150,22 @@ def dynamic_neighbor_allreduce_nonblocking(x, step: int, *,
     """Neighbor averaging with the one-peer dynamic walk at ``step``.
 
     ``phases`` defaults to the phase table of the active topology."""
+    from bluefog_tpu.utils import config
     ctx = _require_init()
     gen = ctx.placement_generation
-    key = ("dynamic", ctx.topology_version, gen) if phases is None else (
-        "dynphases", tuple(ph.send_to for ph in phases), gen)
+    cfg = config.get()
+    tag = _sched_path_tag(cfg)
+    key = ("dynamic", ctx.topology_version, tag, gen) if phases is None \
+        else ("dynphases", tuple(ph.send_to for ph in phases), tag, gen)
     if phases is None:
         sched = ctx.static_schedule(
             key, lambda: _physical_repack_dynamic(S.compile_dynamic(
-                topology_util.dynamic_phase_table(load_topology()), size())))
+                topology_util.dynamic_phase_table(load_topology()), size()),
+                _cfg=cfg))
     else:
         sched = ctx.static_schedule(
             key, lambda: _physical_repack_dynamic(
-                S.compile_dynamic(phases, size())))
+                S.compile_dynamic(phases, size()), _cfg=cfg))
     step_arr = jnp.asarray(step, dtype=jnp.int32)
     fn = partial(C.dynamic_neighbor_allreduce, sched=sched, axis_name=RANK_AXIS)
     return _dispatch_flat(("dynamic_neighbor_allreduce", key),

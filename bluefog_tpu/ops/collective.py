@@ -49,11 +49,11 @@ __all__ = [
 
 
 def schedule_wire_stats(sched) -> tuple:
-    """``(rounds, edges, hops)`` of a compiled schedule — the per-call
-    wire-cost metadata telemetry records at dispatch time (the op bodies
-    here are traced into one XLA program, so Python-side counters cannot
-    live in them; the schedule is the ground truth for what the program
-    moves).
+    """``(rounds, edges, hops, provenance)`` of a compiled schedule — the
+    per-call wire-cost metadata telemetry records at dispatch time (the op
+    bodies here are traced into one XLA program, so Python-side counters
+    cannot live in them; the schedule is the ground truth for what the
+    program moves).
 
     ``StaticSchedule``/``PairGossipSchedule``: rounds is the ppermute count
     per call, edges the total (src, dst) pairs across them.  A
@@ -71,9 +71,17 @@ def schedule_wire_stats(sched) -> tuple:
     Counts reflect the schedule AS COMPILED: with the min-round repack on
     (``BLUEFOG_TPU_SCHEDULE_OPT``, default) the rounds gauge is the
     optimized ``max(max_outdeg, max_indeg)`` count, not the shift-distance
-    decomposition's; edges are invariant under repacking."""
-    phases = getattr(sched, "phases", None)
+    decomposition's; edges are invariant under repacking.
+
+    ``provenance`` is the :class:`~bluefog_tpu.ops.schedule.CompiledSchedule`
+    artifact's pipeline tag (``naive`` / ``konig`` / ``congestion`` /
+    ``synthesized:<sketch>``; a ``DynamicSchedule`` reports its phases'
+    consensus, ``mixed`` when they disagree) — what
+    ``bf_comm_schedule_provenance_total`` labels per-op calls with."""
     from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.ops.schedule import schedule_provenance
+    phases = getattr(sched, "phases", None)
+    prov = schedule_provenance(sched)
     if phases is not None:  # DynamicSchedule
         per = [_logical_rounds_edges(ph) for ph in phases]
         k = max(len(per), 1)
@@ -82,8 +90,9 @@ def schedule_wire_stats(sched) -> tuple:
         # are not recomputed here just to be discarded).
         return (sum(r for r, _ in per) / k,
                 sum(e for _, e in per) / k,
-                PL.modeled_schedule_hops(sched))
-    return _logical_rounds_edges(sched) + (PL.modeled_schedule_hops(sched),)
+                PL.modeled_schedule_hops(sched), prov)
+    return _logical_rounds_edges(sched) + (
+        PL.modeled_schedule_hops(sched), prov)
 
 
 def _logical_rounds_edges(sched) -> tuple:

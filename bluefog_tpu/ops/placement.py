@@ -251,24 +251,33 @@ def parse_torus_spec(spec: str) -> Tuple[int, ...]:
 
 
 def synthetic_torus(dims: Sequence[int], n_devices: Optional[int] = None,
-                    name: Optional[str] = None) -> TorusModel:
-    """Single-slice torus with device ``i`` on node ``i`` (row-major).
+                    name: Optional[str] = None,
+                    n_slices: int = 1) -> TorusModel:
+    """Synthetic torus with device ``i`` on node ``i`` (row-major;
+    slice-contiguous when ``n_slices > 1`` — devices ``0..nodes-1`` fill
+    slice 0, the next block slice 1, ... with one shared DCN link per
+    ordered slice pair, exactly like the real-coords multi-slice model).
 
     ``n_devices`` may exceed the node count when several devices share a
     chip (must divide evenly: devices ``i`` maps to node
     ``i // (n_devices/nodes)``)."""
     dims = tuple(int(d) for d in dims)
-    nodes = int(np.prod(dims))
+    n_slices = int(n_slices)
+    nodes = int(np.prod(dims)) * max(n_slices, 1)
     n_devices = nodes if n_devices is None else int(n_devices)
     if n_devices % nodes:
         raise ValueError(
             f"{n_devices} devices do not divide evenly over a "
             f"{'x'.join(map(str, dims))} torus ({nodes} nodes)")
     per = n_devices // nodes
+    base = "fake-torus-" + "x".join(map(str, dims))
+    if n_slices > 1:
+        base += f"-{n_slices}slices"
     return TorusModel(
-        name=name or ("fake-torus-" + "x".join(map(str, dims))),
+        name=name or base,
         dims=dims,
-        device_node=tuple(i // per for i in range(n_devices)))
+        device_node=tuple(i // per for i in range(n_devices)),
+        n_slices=max(n_slices, 1))
 
 
 def build_model(devices) -> Optional[TorusModel]:

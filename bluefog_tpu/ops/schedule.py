@@ -46,12 +46,15 @@ from bluefog_tpu import topology as topo_mod
 __all__ = [
     "CommRound",
     "StaticSchedule",
+    "CompiledSchedule",
     "DynamicSchedule",
     "PairGossipSchedule",
     "compile_static",
     "compile_dynamic",
     "compile_pair_gossip",
     "uniform_weights",
+    "as_compiled",
+    "schedule_provenance",
 ]
 
 
@@ -130,6 +133,89 @@ class StaticSchedule:
         return tuple(tables)
 
 
+    def window_plan(self) -> Tuple[Tuple[Tuple[int, float], ...], ...]:
+        """Per-source lowering for the one-sided WINDOW executor: entry
+        ``s`` is the ``(dst, weight)`` list rank ``s`` pushes each step
+        (``win_put``/``win_accumulate`` targets), round structure erased —
+        the window transport has no round barrier, only per-peer FIFOs.
+        The diagonal (``self_scale``) stays with the combiner.  This is
+        the second lowering target a :class:`CompiledSchedule` can
+        declare; ``lax.ppermute`` rounds are the first."""
+        plan: List[List[Tuple[int, float]]] = [[] for _ in range(self.n)]
+        for rnd in self.rounds:
+            for s, d in rnd.pairs:
+                plan[s].append((d, float(rnd.send_scale[s])))
+        return tuple(tuple(p) for p in plan)
+
+
+@dataclass(frozen=True, eq=False)
+class CompiledSchedule(StaticSchedule):
+    """First-class compiled schedule artifact.
+
+    A ``StaticSchedule`` plus the metadata that used to live implicitly in
+    whichever pipeline stage produced the rounds:
+
+    ``provenance``   — how the rounds were derived: ``naive`` (shift-
+                       distance decomposition), ``konig`` (min-round
+                       bipartite-coloring repack), ``congestion``
+                       (congestion-aware link-load repack) or
+                       ``synthesized:<sketch>`` (:mod:`ops/synthesis`).
+    ``modeled_cost`` — the :class:`ops.placement.CostReport` the producer
+                       priced the rounds at (None when no interconnect
+                       model was active — logical-only compilation).
+    ``lowering``     — executor the rounds target: ``ppermute`` (rounds
+                       become ``lax.ppermute`` calls inside one XLA
+                       program) or ``window`` (rounds flatten to the
+                       per-peer push plan of :meth:`window_plan`).
+    ``sketch``       — the communication sketch a synthesized schedule
+                       was grown from (None for non-synthesized).
+
+    It IS a ``StaticSchedule`` (every executor, cache and cost-model
+    consumer keeps working on the artifact unchanged); the metadata rides
+    along for telemetry (``schedule_wire_stats`` provenance labels), cache
+    keying and the ``tools schedule-dump`` inspector.
+    """
+    provenance: str = "naive"
+    modeled_cost: Optional[object] = None
+    lowering: str = "ppermute"
+    sketch: Optional[str] = None
+
+
+_UNSET = object()
+
+
+def as_compiled(sched: StaticSchedule, *, provenance=None, modeled_cost=_UNSET,
+                lowering=None, sketch=_UNSET) -> CompiledSchedule:
+    """Wrap (or re-tag) a schedule as a :class:`CompiledSchedule` artifact.
+
+    Unspecified fields inherit from ``sched`` when it already is an
+    artifact, else take the defaults — so every pipeline stage can stamp
+    only the metadata it owns (the König repack stamps provenance, the
+    synthesis stamps provenance+sketch+cost) without erasing the rest."""
+    prov = provenance if provenance is not None else \
+        getattr(sched, "provenance", "naive")
+    cost = modeled_cost if modeled_cost is not _UNSET else \
+        getattr(sched, "modeled_cost", None)
+    low = lowering if lowering is not None else \
+        getattr(sched, "lowering", "ppermute")
+    sk = sketch if sketch is not _UNSET else getattr(sched, "sketch", None)
+    return CompiledSchedule(
+        n=sched.n, rounds=sched.rounds, self_scale=sched.self_scale,
+        indegree=sched.indegree, outdegree=sched.outdegree,
+        provenance=prov, modeled_cost=cost, lowering=low, sketch=sk)
+
+
+def schedule_provenance(sched) -> str:
+    """Provenance tag of any schedule object: the artifact's own tag, a
+    ``DynamicSchedule``'s phase consensus (``mixed`` when phases disagree),
+    ``naive`` for plain pre-artifact schedules."""
+    phases = getattr(sched, "phases", None)
+    if phases is not None:
+        tags = {schedule_provenance(ph) for ph in phases}
+        return tags.pop() if len(tags) == 1 else "mixed"
+    return getattr(sched, "provenance", "naive")
+
+
 @dataclass(frozen=True, eq=False)
 class DynamicSchedule:
     """Periodic dynamic topology: step ``t`` runs ``phases[t % len(phases)]``."""
@@ -139,6 +225,10 @@ class DynamicSchedule:
     @property
     def period(self) -> int:
         return len(self.phases)
+
+    @property
+    def provenance(self) -> str:
+        return schedule_provenance(self)
 
 
 @dataclass(frozen=True, eq=False)
@@ -225,12 +315,13 @@ def _build_schedule(w: np.ndarray,
     n = w.shape[0]
     off_diag = w.copy()
     np.fill_diagonal(off_diag, 0.0)
-    sched = StaticSchedule(
+    sched = CompiledSchedule(
         n=n,
         rounds=_rounds_from_matrix(w),
         self_scale=np.diag(w).copy(),
         indegree=(off_diag != 0).sum(axis=0).astype(np.int32),
         outdegree=(off_diag != 0).sum(axis=1).astype(np.int32),
+        provenance="naive",
     )
     do_opt = config.get().schedule_opt if optimize is None else optimize
     if do_opt:

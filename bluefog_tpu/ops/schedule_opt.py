@@ -140,7 +140,7 @@ def optimize_schedule(sched):
     at the bound (every shift-structured topology) are returned unchanged,
     bit-identically.
     """
-    from bluefog_tpu.ops.schedule import CommRound, StaticSchedule
+    from bluefog_tpu.ops.schedule import CommRound, as_compiled
     from bluefog_tpu.utils import telemetry
 
     target = min_rounds(sched)
@@ -174,9 +174,11 @@ def optimize_schedule(sched):
         rounds.append(CommRound(pairs, send_scale, recv_mask, src_of))
     telemetry.inc("bf_schedule_opt_rounds_saved_total",
                   len(sched.rounds) - k)
-    return StaticSchedule(
-        n=n, rounds=tuple(rounds), self_scale=sched.self_scale,
-        indegree=sched.indegree, outdegree=sched.outdegree)
+    import dataclasses
+    # modeled_cost/sketch describe the INPUT's round grouping; the repack
+    # just changed it, so they must not ride along.
+    return as_compiled(dataclasses.replace(sched, rounds=tuple(rounds)),
+                       provenance="konig", modeled_cost=None, sketch=None)
 
 
 # ---------------------------------------------------------------------------
@@ -235,7 +237,7 @@ def congestion_aware_repack(sched, model, perm=None, *,
     ``bf_schedule_max_link_load`` gauge) that never dispatch, so the
     telemetry only counts moves applied to schedules that actually run.
     """
-    from bluefog_tpu.ops.schedule import StaticSchedule
+    from bluefog_tpu.ops.schedule import as_compiled
     from bluefog_tpu.utils import telemetry
 
     if model is None or budget_factor <= 0 or len(sched.rounds) <= 0:
@@ -372,9 +374,12 @@ def congestion_aware_repack(sched, model, perm=None, *,
         telemetry.inc("bf_schedule_congestion_moves_total", moves)
     rounds = _rebuild_rounds(
         [[edges[e] for e in grp] for grp in groups if grp], n)
-    return StaticSchedule(
-        n=n, rounds=rounds, self_scale=sched.self_scale,
-        indegree=sched.indegree, outdegree=sched.outdegree)
+    import dataclasses
+    # modeled_cost/sketch describe the INPUT's round grouping; the repack
+    # just changed it, so they must not ride along.
+    return as_compiled(dataclasses.replace(sched, rounds=rounds),
+                       provenance="congestion", modeled_cost=None,
+                       sketch=None)
 
 
 # ---------------------------------------------------------------------------
@@ -393,8 +398,17 @@ def clear_compile_cache() -> None:
 
 
 def compile_cache_info() -> dict:
+    """Cache occupancy, tallied by artifact provenance — a toggle of the
+    schedule pipeline knobs mid-process must show up as DISTINCT entries
+    here (the keys carry the flags), never as one entry silently serving
+    both paths."""
     with _cache_lock:
-        return {"entries": len(_cache), "max": _CACHE_MAX}
+        by_prov: Dict[str, int] = {}
+        for sched in _cache.values():
+            tag = getattr(sched, "provenance", "naive")
+            by_prov[tag] = by_prov.get(tag, 0) + 1
+        return {"entries": len(_cache), "max": _CACHE_MAX,
+                "by_provenance": by_prov}
 
 
 def cached_schedule_from_matrix(w: np.ndarray, build):

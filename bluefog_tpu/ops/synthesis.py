@@ -1,0 +1,653 @@
+"""Sketch-guided gossip schedule synthesis (TACCL / SCCL / GC3 line).
+
+``ops/schedule_opt.py`` only *rearranges* a given round decomposition:
+the König repack packs edges into the fewest rounds, the congestion
+repack splits edges off saturated links.  Neither optimizes what a round
+sequence actually costs on the interconnect — the modeled
+``serial_link_time`` of :mod:`ops/placement` (sum over rounds of the
+busiest link's weighted load, i.e. the execution time of the serialized
+round sequence).  Two structural facts make direct synthesis win:
+
+  * **Splitting never helps serial time.**  Per-link loads are additive
+    over rounds, so splitting a round's edges into two rounds satisfies
+    ``b1 + b2 >= b`` — the congestion repack's split moves (which chase
+    *per-round* max-link-load) can only grow, never shrink, the serial
+    sum.  The optimal schedule merges maximally, subject to the
+    partial-permutation constraint (each src/dst at most once per round).
+  * **Overlapping bottlenecks is free.**  A round bottlenecked on
+    x-dimension links carries y-routed (or other-slice) edges at zero
+    marginal cost.  The shift-distance decomposition and the König
+    coloring are both blind to this; a greedy insertion that prices every
+    candidate round by its *incremental* bottleneck finds it immediately
+    (the exp2-on-a-torus checkerboard mix that halves serial time).
+
+:func:`synthesize_schedule` therefore rebuilds the round assignment from
+the edge set: a communication **sketch** orders the edges and seeds the
+construction, then a deterministic local search (move edges between
+rounds, merge compatible rounds) refines against the exact
+``serial_link_time`` objective — greedy seeding plus ILP-style
+neighborhood refinement rather than an actual ILP, keeping
+``set_topology`` latency bounded.  Sketches:
+
+  ``ring-within-slice``  — first-fit-decreasing by routed path length,
+      intra-slice edges ordered by their placed shift distance: long
+      intra-slice paths (the ring-like wrap traffic) claim links first,
+      short hops fill the gaps.
+  ``hierarchical``       — DCN (inter-slice) edges first, grouped by
+      slice pair, then intra-slice edges by path length: the scarce
+      shared DCN links are spread across rounds before ICI traffic
+      overlays them (HiCCL's outer/inner decomposition).
+  ``chunked-pipelined``  — seed from the *baseline* round structure (the
+      congestion-packed schedule when supplied, else the input) and let
+      the merge/move refinement re-pipeline its chunks; guarantees the
+      synthesis never loses to the baseline it refines.
+  ``auto``               — run every sketch, keep the best
+      ``(serial_link_time, max_link_load, rounds)``; deterministic
+      tie-break on sketch order.
+
+Everything is output-equivalent by construction: edges and their weights
+are untouched (only the grouping changes), so the effective weight
+matrix is bit-identical and executed outputs shift only by fp summation
+order (≤1e-6 at fp32 — the same contract as the König repack).  The
+whole pipeline is deterministic — no RNG — so every SPMD process
+synthesizes the identical artifact.
+
+Results are memoized process-wide (FIFO-bounded) on the model geometry,
+placement permutation, schedule signature, sketch and budget — the same
+keying discipline as the placement search cache, so re-installing a seen
+topology never re-runs the search.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "SKETCHES",
+    "synthesize_schedule",
+    "select_schedule",
+    "serial_time",
+    "serial_lower_bound",
+    "clear_synth_cache",
+    "synth_cache_info",
+]
+
+SKETCHES = ("ring-within-slice", "hierarchical", "chunked-pipelined")
+
+# Dense per-edge link-contribution matrix cap (n_edges * n_links floats).
+# Above this the synthesis bows out (returns None) rather than risk a
+# multi-second default-on set_topology on pod-scale meshes — the caller
+# keeps the congestion-packed schedule, which is never wrong, just slower.
+_DENSE_LIMIT = 8_000_000
+# Local-search bounds: sweeps over the whole edge set, and a hard cap on
+# accepted moves (each move strictly improves the objective, so the search
+# terminates anyway; the cap bounds worst-case latency).
+_MAX_SWEEPS = 8
+_MAX_MOVES = 2048
+# How many of the (sketch x bottleneck-cap) seeds get the full move/swap
+# refinement — seeding is cheap, refinement is the expensive half.
+_REFINE_TOP = 4
+
+
+def serial_time(model, sched, perm=None) -> float:
+    """Modeled ``serial_link_time`` of a schedule under ``model``/``perm``
+    — the objective synthesis minimizes and selection compares on."""
+    from bluefog_tpu.ops import placement as PL
+    return PL.schedule_cost(model, sched, perm).serial_link_time
+
+
+def serial_lower_bound(model, sched, perm=None) -> float:
+    """Busiest-link total weighted load of ``sched``'s edge set — the
+    additive-loads lower bound on ``serial_link_time`` no round assignment
+    can beat (rounds serialize, per-link loads are additive).  The bound
+    the synthesis cap ladder aims at, and the oracle the bench/tests
+    compare ties against."""
+    node = np.asarray(model.device_node, np.int64)
+    if perm is None:
+        perm = np.arange(len(node), dtype=np.int64)
+    tot = np.zeros(model.n_links)
+    for rnd in sched.rounds:
+        for s, d in rnd.pairs:
+            route = model.route(int(node[perm[s]]), int(node[perm[d]]))
+            np.add.at(tot, route, 1.0)
+    return float((tot * model.link_weights).max())
+
+
+def _flatten_edges(sched) -> List[Tuple[int, int, float]]:
+    edges = []
+    for rnd in sched.rounds:
+        for s, d in rnd.pairs:
+            edges.append((s, d, float(rnd.send_scale[s])))
+    return edges
+
+
+class _State:
+    """Mutable round assignment with incremental serial-time accounting.
+
+    ``contrib`` is the dense (n_edges, n_links) per-edge weighted
+    link-load contribution (1 crossing x link weight along the edge's
+    route); round loads are sums of member rows, bottlenecks their max.
+    All candidate evaluations are O(n_links) numpy ops.
+    """
+
+    def __init__(self, edges, contrib, n, budget):
+        self.edges = edges
+        self.contrib = contrib
+        self.n = n
+        self.budget = budget
+        self.members: List[List[int]] = []
+        self.loads: List[np.ndarray] = []
+        self.botts: List[float] = []
+        self.srcs: List[set] = []
+        self.dsts: List[set] = []
+
+    def serial(self) -> float:
+        return float(sum(self.botts))
+
+    def key(self) -> Tuple[float, float, int]:
+        return (self.serial(), max(self.botts, default=0.0),
+                len(self.members))
+
+    def open_round(self, e: int) -> None:
+        s, d, _ = self.edges[e]
+        load = self.contrib[e].copy()
+        self.members.append([e])
+        self.loads.append(load)
+        self.botts.append(float(load.max()))
+        self.srcs.append({s})
+        self.dsts.append({d})
+
+    def add(self, e: int, r: int) -> None:
+        s, d, _ = self.edges[e]
+        self.members[r].append(e)
+        self.loads[r] += self.contrib[e]
+        self.botts[r] = float(self.loads[r].max())
+        self.srcs[r].add(s)
+        self.dsts[r].add(d)
+
+    def remove(self, e: int, r: int) -> None:
+        s, d, _ = self.edges[e]
+        self.members[r].remove(e)
+        self.loads[r] -= self.contrib[e]
+        self.botts[r] = float(self.loads[r].max()) if self.members[r] else 0.0
+        self.srcs[r].discard(s)
+        self.dsts[r].discard(d)
+
+    def drop_empty(self) -> None:
+        keep = [r for r in range(len(self.members)) if self.members[r]]
+        self.members = [self.members[r] for r in keep]
+        self.loads = [self.loads[r] for r in keep]
+        self.botts = [self.botts[r] for r in keep]
+        self.srcs = [self.srcs[r] for r in keep]
+        self.dsts = [self.dsts[r] for r in keep]
+
+    def clone_assignment(self) -> List[List[int]]:
+        return [list(m) for m in self.members]
+
+
+def _seed_greedy(state: _State, order: Sequence[int],
+                 cap: Optional[float] = None) -> bool:
+    """First-fit insertion in ``order``: each edge lands in the compatible
+    round with the smallest incremental bottleneck (ties: smaller
+    resulting bottleneck, then lower index); a new round opens only when
+    it is strictly cheaper (or nothing is compatible) and the budget
+    allows.  Returns False when the budget makes the order infeasible.
+
+    ``cap``: soft per-round bottleneck ceiling.  Rounds already at the
+    ceiling reject further load (the edge opens a new round instead while
+    the budget allows), which steers the construction toward the
+    ``serial ~= cap x rounds`` profile of the balanced optimum — the
+    structure the ILP relaxation exhibits — instead of piling everything
+    onto the earliest rounds.  A single edge heavier than the cap (a DCN
+    crossing under a small cap) still gets a round of its own; when the
+    budget runs out the cap degrades to plain min-delta placement rather
+    than failing."""
+    for e in order:
+        s, d, _ = state.edges[e]
+        ec = state.contrib[e]
+        best = None        # (delta, new_bott, r) among cap-respecting
+        best_over = None   # fallback ignoring the cap
+        for r in range(len(state.members)):
+            if s in state.srcs[r] or d in state.dsts[r]:
+                continue
+            nb = float((state.loads[r] + ec).max())
+            cand = (nb - state.botts[r], nb, r)
+            if cap is None or nb <= cap + 1e-12:
+                if best is None or cand < best:
+                    best = cand
+            if best_over is None or cand < best_over:
+                best_over = cand
+        new_delta = float(ec.max())
+        can_open = len(state.members) < state.budget
+        if can_open and (best is None
+                         or (new_delta, new_delta) < best[:2]):
+            state.open_round(e)
+            continue
+        if best is not None:
+            state.add(e, best[2])
+        elif best_over is not None:
+            state.add(e, best_over[2])  # cap degraded, never infeasible
+        else:
+            return False  # budget exhausted, no compatible round
+    return True
+
+
+def _seed_from_rounds(state: _State, rounds_members: List[List[int]]) -> bool:
+    for grp in rounds_members:
+        if not grp:
+            continue
+        first = True
+        for e in grp:
+            if first:
+                state.open_round(e)
+                first = False
+            else:
+                state.add(e, len(state.members) - 1)
+    return len(state.members) <= state.budget
+
+
+def _refine(state: _State) -> None:
+    """Deterministic local search: merge compatible rounds whenever the
+    merged bottleneck beats the pair's sum (splitting never helps serial
+    time — see module docstring — so merging is the workhorse), then move
+    individual bottleneck-carrying edges to rounds that absorb them more
+    cheaply.  Every accepted step strictly decreases
+    ``(serial, max_bottleneck, rounds)``; bounded by sweep/move caps."""
+    moves = 0
+    for _sweep in range(_MAX_SWEEPS):
+        improved = False
+        # ---- merge pass -------------------------------------------------
+        r1 = 0
+        while r1 < len(state.members):
+            r2 = r1 + 1
+            while r2 < len(state.members):
+                if (state.srcs[r1].isdisjoint(state.srcs[r2])
+                        and state.dsts[r1].isdisjoint(state.dsts[r2])):
+                    merged = state.loads[r1] + state.loads[r2]
+                    mb = float(merged.max())
+                    if mb < state.botts[r1] + state.botts[r2] - 1e-12:
+                        state.members[r1].extend(state.members[r2])
+                        state.loads[r1] = merged
+                        state.botts[r1] = mb
+                        state.srcs[r1] |= state.srcs[r2]
+                        state.dsts[r1] |= state.dsts[r2]
+                        del (state.members[r2], state.loads[r2],
+                             state.botts[r2], state.srcs[r2],
+                             state.dsts[r2])
+                        improved = True
+                        moves += 1
+                        continue  # retry same r2 slot (new occupant)
+                r2 += 1
+            r1 += 1
+        # ---- move pass --------------------------------------------------
+        order = sorted(range(len(state.members)),
+                       key=lambda r: (-state.botts[r], r))
+        for r in order:
+            if moves >= _MAX_MOVES:
+                break
+            b_r = state.botts[r]
+            if b_r <= 0:
+                continue
+            hot = state.loads[r] >= b_r - 1e-12
+            for e in sorted(state.members[r]):
+                ec = state.contrib[e]
+                if not ec[hot].any():
+                    continue  # not on this round's bottleneck link(s)
+                b_src_new = float((state.loads[r] - ec).max()) \
+                    if len(state.members[r]) > 1 else 0.0
+                gain = b_r - b_src_new
+                if gain <= 1e-12:
+                    continue
+                s, d, _ = state.edges[e]
+                best = None  # (delta, new_bott, r2)
+                for r2 in range(len(state.members)):
+                    if r2 == r or s in state.srcs[r2] or d in state.dsts[r2]:
+                        continue
+                    nb = float((state.loads[r2] + ec).max())
+                    cand = (nb - state.botts[r2], nb, r2)
+                    if best is None or cand < best:
+                        best = cand
+                if best is not None and best[0] < gain - 1e-12:
+                    state.remove(e, r)
+                    state.add(e, best[2])
+                    improved = True
+                    moves += 1
+                    # Round r's bottleneck changed: restart its edge scan.
+                    b_r = state.botts[r]
+                    if b_r <= 0:
+                        break
+                    hot = state.loads[r] >= b_r - 1e-12
+        # ---- swap pass --------------------------------------------------
+        # Full-permutation rounds (every src/dst taken everywhere — the
+        # shift-structured families) admit NO single-edge move; exchanging
+        # a bottleneck edge with a partner from another round is the only
+        # neighborhood that reaches them.
+        if moves < _MAX_MOVES:
+            improved |= _swap_pass(state)
+        state.drop_empty()
+        if not improved or moves >= _MAX_MOVES:
+            break
+
+
+def _swap_pass(state: _State) -> bool:
+    """Exchange one bottleneck-link edge with an edge of another round
+    when the pair of new bottlenecks strictly beats the old pair.
+    Candidates are restricted to edges crossing the argmax link(s) of the
+    highest-bottleneck rounds, so the pass is O(hot_edges x n_edges)."""
+    improved = False
+    order = sorted(range(len(state.members)),
+                   key=lambda r: (-state.botts[r], r))
+    for r in order[:4]:  # the few worst rounds drive the serial sum
+        if not state.members[r]:
+            continue
+        b_r = state.botts[r]
+        hot = state.loads[r] >= b_r - 1e-12
+        hot_edges = [e for e in sorted(state.members[r])
+                     if state.contrib[e][hot].any()]
+        for e in hot_edges:
+            se, de, _ = state.edges[e]
+            ec = state.contrib[e]
+            base_r = state.loads[r] - ec
+            best = None  # (delta, r2, f)
+            for r2 in range(len(state.members)):
+                if r2 == r:
+                    continue
+                b2 = state.botts[r2]
+                for f in state.members[r2]:
+                    sf, df, _ = state.edges[f]
+                    if (se != sf and se in state.srcs[r2]) or \
+                       (de != df and de in state.dsts[r2]):
+                        continue
+                    if (sf != se and sf in state.srcs[r]) or \
+                       (df != de and df in state.dsts[r]):
+                        continue
+                    fc = state.contrib[f]
+                    nb_r = float((base_r + fc).max())
+                    nb_2 = float((state.loads[r2] - fc + ec).max())
+                    delta = (nb_r + nb_2) - (b_r + b2)
+                    if delta < -1e-12 and (best is None or delta < best[0]):
+                        best = (delta, r2, f)
+            if best is not None:
+                _, r2, f = best
+                state.remove(e, r)
+                state.remove(f, r2)
+                state.add(f, r)
+                state.add(e, r2)
+                improved = True
+                b_r = state.botts[r]
+                hot = state.loads[r] >= b_r - 1e-12
+    return improved
+
+
+def _edge_contrib(model, edges, perm) -> Optional[np.ndarray]:
+    node = np.asarray(model.device_node, np.int64)
+    if perm is None:
+        perm = np.arange(len(node), dtype=np.int64)
+    n_links = model.n_links
+    if len(edges) * n_links > _DENSE_LIMIT:
+        return None
+    lw = model.link_weights
+    contrib = np.zeros((len(edges), n_links))
+    for i, (s, d, _w) in enumerate(edges):
+        route = model.route(int(node[perm[s]]), int(node[perm[d]]))
+        if route.size:
+            contrib[i, route] = lw[route]
+    return contrib
+
+
+def _sketch_order(sketch: str, edges, model, perm) -> List[int]:
+    """Deterministic edge insertion order for a sketch (see module doc)."""
+    node = np.asarray(model.device_node, np.int64)
+    if perm is None:
+        perm = np.arange(len(node), dtype=np.int64)
+
+    def meta(i):
+        s, d, _ = edges[i]
+        a, b = int(node[perm[s]]), int(node[perm[d]])
+        sl_a, sl_b = a // model.nodes_per_slice, b // model.nodes_per_slice
+        return a, b, sl_a, sl_b, int(model.route(a, b).size)
+
+    if sketch == "ring-within-slice":
+        # FFD by routed length; intra-slice before DCN, then placed shift.
+        def key_rws(i):
+            a, b, sl_a, sl_b, hops = meta(i)
+            return (sl_a != sl_b, -hops, b - a, i)
+        return sorted(range(len(edges)), key=key_rws)
+    if sketch == "hierarchical":
+        # DCN first, grouped per ordered slice pair, then ICI by length.
+        def key_hier(i):
+            a, b, sl_a, sl_b, hops = meta(i)
+            return (sl_a == sl_b, (sl_a, sl_b), -hops, i)
+        return sorted(range(len(edges)), key=key_hier)
+    raise ValueError(f"unknown sketch {sketch!r}")
+
+
+def _materialize(state: _State, sched, sketch: str, model, perm):
+    """Rounds -> CompiledSchedule artifact (weights preserved exactly)."""
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.ops.schedule import as_compiled
+    from bluefog_tpu.ops.schedule_opt import _rebuild_rounds
+    import dataclasses
+    groups = [[state.edges[e] for e in grp]
+              for grp in state.members if grp]
+    rounds = _rebuild_rounds(groups, sched.n)
+    out = as_compiled(dataclasses.replace(sched, rounds=rounds),
+                      provenance=f"synthesized:{sketch}", sketch=sketch)
+    cost = PL.schedule_cost(model, out, perm)
+    return dataclasses.replace(out, modeled_cost=cost)
+
+
+def synthesize_schedule(sched, model, perm=None, *, sketch: str = "auto",
+                        budget_factor: float = 2.0, baseline=None):
+    """Synthesize a round assignment for ``sched``'s edge set minimizing
+    modeled ``serial_link_time`` under ``model``/``perm``.
+
+    ``sched``  — compiled :class:`~bluefog_tpu.ops.schedule.StaticSchedule`
+        (the logical König-packed artifact is the natural input; only its
+        edge set, weights and degree metadata are read).
+    ``sketch`` — one of :data:`SKETCHES` or ``auto`` (try all, keep best).
+    ``budget_factor`` — round budget as a multiple of the König bound
+        (``max(len(sched.rounds), ceil(budget_factor * König))`` —
+        synthesis never emits more rounds than that; <= 0 disables).
+    ``baseline`` — optional already-packed schedule the
+        ``chunked-pipelined`` sketch seeds from (guaranteeing the refined
+        result never loses to it).
+
+    Returns a ``CompiledSchedule`` with provenance ``synthesized:<sketch>``
+    and ``modeled_cost`` set, or ``None`` when synthesis does not apply
+    (no model, rank-count mismatch, budget disabled, or a mesh too large
+    for the dense evaluator).  Deterministic: no RNG anywhere, so every
+    SPMD process materializes the identical artifact.
+    """
+    from bluefog_tpu.ops.schedule_opt import min_rounds
+
+    if model is None or budget_factor <= 0 or not sched.rounds:
+        return None
+    n = sched.n
+    if len(model.device_node) != n:
+        return None
+    # Identity permutations arrive as None from dispatch but as a concrete
+    # arange from the placement-search pricing; canonicalize so both key
+    # (and hit) the same memo entry instead of re-running the search.
+    if perm is not None and np.array_equal(perm, np.arange(len(perm))):
+        perm = None
+    hit = _cache_get(sched, model, perm, sketch, budget_factor)
+    if hit is not _CACHE_MISS:
+        return hit
+    edges = _flatten_edges(sched)
+    contrib = _edge_contrib(model, edges, perm)
+    if contrib is None:
+        _cache_put(sched, model, perm, sketch, budget_factor, None)
+        return None
+    konig = max(min_rounds(sched), 1)
+    budget = max(len(sched.rounds), int(math.ceil(konig * budget_factor)))
+    lower_bound = serial_lower_bound(model, sched, perm)
+
+    sketches = SKETCHES if sketch == "auto" else (sketch,)
+    seeds = []  # (key, state, sketch) — pre-refinement
+    for sk in sketches:
+        if sk == "chunked-pipelined":
+            state = _State(edges, contrib, n, budget)
+            base = baseline if baseline is not None else sched
+            if getattr(base, "n", None) != n:
+                base = sched
+            if sorted(_flatten_edges(base)) != sorted(edges):
+                base = sched  # different edge set: seed from the input
+            # Map baseline rounds onto OUR edge indexing.
+            index = {}
+            for i, e in enumerate(edges):
+                index.setdefault((e[0], e[1]), i)
+            groups = [[index[(s, d)] for s, d in rnd.pairs]
+                      for rnd in base.rounds]
+            if _seed_from_rounds(state, groups):
+                # Always refined: this candidate is the never-worse-than-
+                # baseline guarantee.
+                _refine(state)
+                seeds.append((state.key(), state, sk))
+            continue
+        order = _sketch_order(sk, edges, model, perm)
+        caps = [None] + [
+            float(c) for c in sorted({
+                int(math.ceil(lower_bound / r - 1e-9))
+                for r in range(konig, budget + 1)})]
+        for cap in caps:
+            state = _State(edges, contrib, n, budget)
+            if _seed_greedy(state, order, cap):
+                seeds.append((state.key(), state, sk))
+        # Deterministic stride reorderings under the tightest cap: the
+        # capped first-fit is order-sensitive (an interleaving of the
+        # sketch's class-major order often packs one round tighter), and
+        # a handful of fixed strides recovers most of what a randomized
+        # restart would — without an RNG, so every rank still builds the
+        # identical artifact.
+        tight = caps[1] if len(caps) > 1 else None
+        ne = len(order)
+        for base in (order, list(range(ne))):
+            for k in (3, 5, 7, 11, 13):
+                var = [base[j] for j in
+                       sorted(range(ne), key=lambda j: ((j * k) % ne, j))]
+                state = _State(edges, contrib, n, budget)
+                if _seed_greedy(state, var, tight):
+                    seeds.append((state.key(), state, sk))
+    if not seeds:
+        _cache_put(sched, model, perm, sketch, budget_factor, None)
+        return None
+    # Refinement (the expensive half) only on the most promising seeds.
+    seeds.sort(key=lambda c: c[0])
+    best = None  # (key, state, sketch)
+    for _key, state, sk in seeds[:_REFINE_TOP]:
+        _refine(state)
+        key = state.key()
+        if best is None or key < best[0]:
+            best = (key, state, sk)
+    out = _materialize(best[1], sched, best[2], model, perm)
+    _cache_put(sched, model, perm, sketch, budget_factor, out)
+    return out
+
+
+def select_schedule(sched, packed, model, perm=None, *,
+                    sketch: str = "auto", budget_factor: float = 2.0,
+                    record: bool = False):
+    """Dispatch-path selection: synthesized vs congestion-packed.
+
+    Synthesizes from the logical ``sched`` (with ``packed`` as the
+    pipelining baseline) and returns whichever of {synthesized, packed}
+    has strictly lower modeled ``serial_link_time`` — the PACKED schedule
+    is retained on ties and whenever synthesis bows out, so the
+    synthesis path is never worse than the PR-5 behavior anywhere.
+
+    Returns ``(chosen, improvement_ratio)``; ratio = packed serial /
+    chosen serial (>= 1.0, exactly 1.0 when packed is kept).  With
+    ``record=True`` the ratio and winning provenance are published as
+    telemetry (``bf_schedule_synth_improvement_ratio`` and the
+    ``bf_schedule_provenance`` info gauge)."""
+    from bluefog_tpu.ops.schedule import schedule_provenance
+    from bluefog_tpu.utils import telemetry
+
+    synth = synthesize_schedule(sched, model, perm, sketch=sketch,
+                                budget_factor=budget_factor,
+                                baseline=packed)
+    chosen, ratio = packed, 1.0
+    if synth is not None:
+        packed_serial = serial_time(model, packed, perm)
+        synth_serial = synth.modeled_cost.serial_link_time
+        if synth_serial < packed_serial - 1e-9:
+            chosen = synth
+            ratio = packed_serial / max(synth_serial, 1e-12)
+    if record:
+        telemetry.set_gauge("bf_schedule_synth_improvement_ratio", ratio)
+        _publish_provenance(schedule_provenance(chosen))
+    return chosen, ratio
+
+
+_PROVENANCE_VOCAB = ("naive", "konig", "congestion", "mixed") + tuple(
+    f"synthesized:{s}" for s in SKETCHES)
+
+
+def _publish_provenance(tag: Optional[str]) -> None:
+    """Info-style gauge: exactly one provenance series at 1 (``None``
+    clears them all).  The vocab is closed, so stale series from a
+    previous selection are cleared rather than left lying about what
+    dispatches."""
+    from bluefog_tpu.utils import telemetry
+    for t in _PROVENANCE_VOCAB:
+        if t != tag:
+            telemetry.clear_gauge("bf_schedule_provenance", provenance=t)
+    if tag is not None:
+        telemetry.set_gauge("bf_schedule_provenance", 1.0, provenance=tag)
+
+
+# ---------------------------------------------------------------------------
+# Process-level synthesis memo (placement-search-cache keying discipline)
+# ---------------------------------------------------------------------------
+
+_CACHE_MISS = object()
+_SYNTH_CACHE_MAX = 64
+_synth_cache: "OrderedDict[tuple, object]" = OrderedDict()
+_synth_lock = threading.Lock()
+
+
+def _cache_key(sched, model, perm, sketch, budget_factor):
+    sig = tuple(
+        (rnd.pairs, rnd.send_scale.tobytes()) for rnd in sched.rounds)
+    return (model.name, model.dims, model.wrap_dims, model.device_node,
+            model.n_slices, model.dcn_link_cost,
+            None if perm is None else np.asarray(perm, np.int64).tobytes(),
+            sig, sched.self_scale.tobytes(), sketch, float(budget_factor))
+
+
+def _cache_get(sched, model, perm, sketch, budget_factor):
+    key = _cache_key(sched, model, perm, sketch, budget_factor)
+    with _synth_lock:
+        if key in _synth_cache:
+            _synth_cache.move_to_end(key)
+            return _synth_cache[key]
+    return _CACHE_MISS
+
+
+def _cache_put(sched, model, perm, sketch, budget_factor, value) -> None:
+    key = _cache_key(sched, model, perm, sketch, budget_factor)
+    with _synth_lock:
+        _synth_cache[key] = value
+        if len(_synth_cache) > _SYNTH_CACHE_MAX:
+            _synth_cache.popitem(last=False)
+
+
+def clear_synth_cache() -> None:
+    with _synth_lock:
+        _synth_cache.clear()
+
+
+def synth_cache_info() -> dict:
+    with _synth_lock:
+        by_prov: Dict[str, int] = {}
+        for v in _synth_cache.values():
+            tag = getattr(v, "provenance", "none")
+            by_prov[tag] = by_prov.get(tag, 0) + 1
+        return {"entries": len(_synth_cache), "max": _SYNTH_CACHE_MAX,
+                "by_provenance": by_prov}

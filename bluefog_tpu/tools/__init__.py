@@ -19,6 +19,16 @@ step the reference never had:
   python -m bluefog_tpu.tools trace-summary <merged.json>
       Per-phase p50/p95/p99 duration table from a (merged or single-rank)
       trace's B/E span pairs.
+
+  python -m bluefog_tpu.tools schedule-dump --topology exp2 --n 64 \
+          --torus 8x8 [--slices 2] [--sketch auto] [--rounds]
+      Inspect the compiled-schedule pipeline for a topology on a
+      simulated torus: one row per pipeline stage (naive shift-distance,
+      König repack, congestion repack, sketch synthesis) with provenance,
+      round count and the modeled cost triple (max-link-load, hop-bytes,
+      serial-link-time), plus the artifact metadata of the schedule the
+      selection would dispatch.  Pure host math — no accelerator, no
+      mesh, no bf.init() required.
 """
 
 from __future__ import annotations
@@ -30,7 +40,7 @@ import re
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["load_trace_events", "rank_files", "trace_merge",
-           "phase_durations", "trace_summary", "main"]
+           "phase_durations", "trace_summary", "schedule_dump", "main"]
 
 _ANCHOR = "bf_clock_anchor"  # timeline.CLOCK_ANCHOR_NAME (no jax import here)
 
@@ -226,6 +236,101 @@ def trace_summary(path: str) -> str:
     return "\n".join(lines)
 
 
+def schedule_dump(topology: str, n: int, torus: str, *, slices: int = 1,
+                  degree: int = 4, seed: int = 0, sketch: str = "auto",
+                  budget: float = 2.0, optimize_placement: bool = False,
+                  show_rounds: bool = False) -> str:
+    """Text report of the schedule pipeline for one topology x torus.
+
+    The artifact refactor makes this nearly free: every stage returns a
+    ``CompiledSchedule`` carrying its own provenance, and the cost model
+    prices any of them — the dump just lines them up."""
+    import numpy as np
+
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu.ops import schedule_opt as SO
+    from bluefog_tpu.ops import synthesis as SY
+
+    makers = {
+        "ring": lambda: topo.RingGraph(n),
+        "exp2": lambda: topo.ExponentialTwoGraph(n),
+        "star": lambda: topo.StarGraph(n),
+        "random-regular": lambda: topo.RandomRegularGraph(n, degree,
+                                                          seed=seed),
+    }
+    if topology not in makers:
+        raise SystemExit(
+            f"schedule-dump: unknown topology {topology!r}; expected one "
+            f"of {', '.join(sorted(makers))}")
+    if sketch != "auto" and sketch not in SY.SKETCHES:
+        raise SystemExit(
+            f"schedule-dump: unknown sketch {sketch!r}; expected one of "
+            f"auto, {', '.join(SY.SKETCHES)}")
+    dims = PL.parse_torus_spec(torus)
+    model = PL.synthetic_torus(dims, n_slices=slices)
+    if len(model.device_node) != n:
+        raise SystemExit(
+            f"schedule-dump: torus {torus} x {slices} slice(s) has "
+            f"{len(model.device_node)} nodes but --n is {n}")
+    w = topo.weight_matrix(makers[topology]())
+    naive = S._build_schedule(w, optimize=False)
+    konig = SO.optimize_schedule(naive)
+    perm = None
+    placement_note = "identity"
+    if optimize_placement:
+        res = PL.optimize_placement(model, konig, n, seed=0)
+        perm = res.perm
+        placement_note = ("identity (optimal)" if res.is_identity
+                          else "optimized")
+    packed = SO.congestion_aware_repack(konig, model, perm,
+                                        budget_factor=budget, record=False)
+    chosen, ratio = SY.select_schedule(konig, packed, model, perm,
+                                       sketch=sketch, budget_factor=budget)
+    stages = [("naive", naive), ("konig", konig), ("congestion", packed)]
+    if chosen is not packed:
+        stages.append((S.schedule_provenance(chosen), chosen))
+    lines = [
+        f"schedule-dump: {topology} over {n} ranks on {model.name} "
+        f"({slices} slice(s)), placement={placement_note}, "
+        f"sketch={sketch}, round budget={budget}x Konig",
+        "",
+        f"{'stage':<28} {'rounds':>6} {'max_link_load':>13} "
+        f"{'hop_bytes':>10} {'serial_link_time':>16}",
+    ]
+    lines.append("-" * len(lines[-1]))
+    for name, sched in stages:
+        c = PL.schedule_cost(model, sched, perm)
+        lines.append(f"{name:<28} {len(sched.rounds):>6} "
+                     f"{c.max_link_load:>13.1f} {c.hop_bytes:>10.1f} "
+                     f"{c.serial_link_time:>16.1f}")
+    lines += [
+        "",
+        f"dispatched: provenance={S.schedule_provenance(chosen)} "
+        f"sketch={getattr(chosen, 'sketch', None)} "
+        f"lowering={getattr(chosen, 'lowering', 'ppermute')} "
+        f"synth improvement={ratio:.3f}x"
+        + ("" if ratio > 1.0 else " (packed retained — tie or no win)"),
+    ]
+    if show_rounds:
+        lines.append("")
+        node = np.asarray(model.device_node, np.int64)
+        p = np.arange(n) if perm is None else np.asarray(perm, np.int64)
+        for i, rnd in enumerate(chosen.rounds):
+            loads = np.zeros(model.n_links)
+            for s, d in rnd.pairs:
+                r = model.route(int(node[p[s]]), int(node[p[d]]))
+                np.add.at(loads, r, 1.0)
+            b = float((loads * model.link_weights).max()) if rnd.pairs \
+                else 0.0
+            lines.append(f"round {i:>3}: {len(rnd.pairs):>4} edges, "
+                         f"bottleneck {b:.1f}  "
+                         f"{list(rnd.pairs)[:8]}"
+                         + (" ..." if len(rnd.pairs) > 8 else ""))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m bluefog_tpu.tools", description=__doc__,
@@ -242,7 +347,40 @@ def main(argv=None) -> int:
         "trace-summary",
         help="per-phase p50/p95/p99 table from a (merged) trace")
     ps.add_argument("trace", help="trace JSON file (merged or single-rank)")
+    pd = sub.add_parser(
+        "schedule-dump",
+        help="compiled-schedule pipeline report (provenance, rounds, "
+             "modeled cost per stage) for a topology on a simulated torus")
+    pd.add_argument("--topology", default="exp2",
+                    help="ring / exp2 / star / random-regular (default exp2)")
+    pd.add_argument("--n", type=int, default=64,
+                    help="rank count (must equal torus nodes x slices)")
+    pd.add_argument("--torus", default="8x8",
+                    help="per-slice torus spec, e.g. 8x8 (default)")
+    pd.add_argument("--slices", type=int, default=1,
+                    help="DCN-connected slice count (default 1)")
+    pd.add_argument("--degree", type=int, default=4,
+                    help="random-regular degree (default 4)")
+    pd.add_argument("--seed", type=int, default=0,
+                    help="random-regular seed (default 0)")
+    pd.add_argument("--sketch", default="auto",
+                    help="synthesis sketch (default auto)")
+    pd.add_argument("--budget", type=float, default=2.0,
+                    help="round budget x Konig (default 2.0)")
+    pd.add_argument("--optimize-placement", action="store_true",
+                    help="price under the optimized placement permutation "
+                         "instead of identity")
+    pd.add_argument("--rounds", action="store_true",
+                    help="also list the dispatched artifact's rounds with "
+                         "per-round bottlenecks")
     args = parser.parse_args(argv)
+    if args.cmd == "schedule-dump":
+        print(schedule_dump(
+            args.topology, args.n, args.torus, slices=args.slices,
+            degree=args.degree, seed=args.seed, sketch=args.sketch,
+            budget=args.budget, optimize_placement=args.optimize_placement,
+            show_rounds=args.rounds))
+        return 0
     if args.cmd == "trace-merge":
         out = trace_merge(args.prefix, args.output)
         events, _ = load_trace_events(out)

@@ -26,6 +26,8 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_PROFILE           | 0     | 1: enable the step profiler's periodic sampling |
 | BLUEFOG_TPU_PROFILE_EVERY     | 50    | straggler-gather / synced-sample period (steps) |
 | BLUEFOG_TPU_SCHEDULE_OPT      | 1     | 0: skip the min-round schedule repack |
+| BLUEFOG_TPU_SCHEDULE_SYNTH    | 1     | 0: skip sketch-guided schedule synthesis (PR 5 congestion-repack path exactly) |
+| BLUEFOG_TPU_SCHEDULE_SYNTH_SKETCH | auto | synthesis sketch: auto / ring-within-slice / hierarchical / chunked-pipelined |
 | BLUEFOG_TPU_PLACEMENT         | 1     | 0: keep raw device-enumeration rank order |
 | BLUEFOG_TPU_PLACEMENT_ITERS   | 1000  | simulated-annealing refinement iterations |
 | BLUEFOG_TPU_PLACEMENT_ROUND_BUDGET | 2.0 | congestion-repack round budget (x König; 0=off) |
@@ -64,6 +66,19 @@ def _validated_compression(value: str) -> str:
     return value
 
 
+def _validated_sketch(value: str) -> str:
+    # Lazy import: synthesis owns the sketch vocabulary (a module-level
+    # import would cycle through bluefog_tpu/__init__ -> basics -> config).
+    from bluefog_tpu.ops.synthesis import SKETCHES
+    allowed = ("auto",) + SKETCHES
+    if value not in allowed:
+        raise ValueError(
+            f"BLUEFOG_TPU_SCHEDULE_SYNTH_SKETCH={value!r} is not a known "
+            f"sketch; expected one of {', '.join(allowed)} (a typo here "
+            "would otherwise silently fall back to some default sketch)")
+    return value
+
+
 def _flag(name: str, default: bool = False) -> bool:
     return os.environ.get(name, "1" if default else "0") in ("1", "true",
                                                              "True", "yes")
@@ -94,6 +109,16 @@ class Config:
     # on by default — off is the escape hatch for debugging a schedule by
     # its raw shift-distance decomposition.
     schedule_opt: bool
+    # Sketch-guided schedule synthesis (ops/synthesis.py); on by default
+    # but structurally inert without an interconnect model.  0 restores
+    # the PR 5 congestion-repack dispatch path exactly (the synthesized
+    # candidate is never computed, never compared, never cached under a
+    # live key).
+    schedule_synth: bool
+    # Which communication sketch the synthesis grows schedules from:
+    # "auto" tries every sketch and keeps the best modeled
+    # serial_link_time; a specific name pins it (debugging/benchmarks).
+    schedule_synth_sketch: str
     # Physical-topology-aware rank placement (ops/placement.py); on by
     # default but structurally inert without an interconnect model (real
     # TPU coords or BLUEFOG_TPU_FAKE_TORUS).  0 restores raw device-
@@ -160,6 +185,9 @@ class Config:
             telemetry_consensus_set=(
                 "BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY" in os.environ),
             schedule_opt=_flag("BLUEFOG_TPU_SCHEDULE_OPT", default=True),
+            schedule_synth=_flag("BLUEFOG_TPU_SCHEDULE_SYNTH", default=True),
+            schedule_synth_sketch=_validated_sketch(os.environ.get(
+                "BLUEFOG_TPU_SCHEDULE_SYNTH_SKETCH", "auto").lower()),
             placement=_flag("BLUEFOG_TPU_PLACEMENT", default=True),
             placement_iters=int(
                 os.environ.get("BLUEFOG_TPU_PLACEMENT_ITERS", "1000")),

@@ -611,8 +611,8 @@ def maybe_start_endpoint() -> Optional[int]:
 def record_comm_traffic(op: str, nbytes: float, *, size: int,
                         sched_stats=None, calls: float = 1.0) -> None:
     """The one accounting formula for collective traffic: calls, element
-    bytes, and — given ``sched_stats = (rounds, edges[, hops])`` from
-    ``collective.schedule_wire_stats`` — rounds/edges/estimated wire bytes
+    bytes, and — given ``sched_stats = (rounds, edges[, hops[, prov]])``
+    from ``collective.schedule_wire_stats`` — rounds/edges/estimated wire bytes
     (one ``nbytes / size`` per-rank row per directed edge).  When the
     stats carry a modeled hop count (a physical interconnect model is
     active — ``ops/placement``), ``bf_schedule_hop_bytes_total`` records
@@ -628,6 +628,7 @@ def record_comm_traffic(op: str, nbytes: float, *, size: int,
     if sched_stats is not None:
         rounds, edges = sched_stats[0], sched_stats[1]
         hops = sched_stats[2] if len(sched_stats) > 2 else None
+        prov = sched_stats[3] if len(sched_stats) > 3 else None
         inc("bf_comm_rounds_total", rounds * calls, op=op)
         inc("bf_comm_edges_total", edges * calls, op=op)
         set_gauge("bf_comm_peers", edges, op=op)
@@ -636,6 +637,13 @@ def record_comm_traffic(op: str, nbytes: float, *, size: int,
         if hops is not None:
             inc("bf_schedule_hop_bytes_total",
                 float(nbytes) / max(size, 1) * hops * calls, op=op)
+        if prov is not None:
+            # Which schedule-pipeline output served the call: counters
+            # never go stale across a provenance change the way a labeled
+            # gauge would, and the per-op split shows exactly which ops
+            # ride synthesized schedules.
+            inc("bf_comm_schedule_provenance_total", calls, op=op,
+                provenance=prov)
 
 
 # ---------------------------------------------------------------------------

@@ -378,14 +378,14 @@ def test_wire_stats_hops_third_element():
     perm = np.arange(8)
     PL.set_active(m, perm)
     try:
-        r, e, hops = C.schedule_wire_stats(sched)
+        r, e, hops, _prov = C.schedule_wire_stats(sched)
         assert hops is not None and hops > 0
         assert hops == PL.schedule_cost(m, sched, perm).hop_bytes
         # Cached per schedule object: second call returns the same value.
         assert C.schedule_wire_stats(sched)[2] == hops
         # Dynamic: per-call average over phases.
         dyn = S.compile_dynamic(topo.one_peer_exp2_phases(8), 8)
-        dr, de, dhops = C.schedule_wire_stats(dyn)
+        dr, de, dhops, _dprov = C.schedule_wire_stats(dyn)
         per = [PL.schedule_cost(m, ph, perm).hop_bytes for ph in dyn.phases]
         assert dhops == pytest.approx(sum(per) / len(per))
         # Mismatched rank count: no hops (machine-level schedules).

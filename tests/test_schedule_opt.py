@@ -179,10 +179,11 @@ def test_wire_stats_report_optimized_rounds():
     w = topo.weight_matrix(topo.RandomRegularGraph(32, 4, seed=0))
     naive = S._build_schedule(w, optimize=False)
     opt = S._build_schedule(w, optimize=True)
-    r0, e0, _ = C.schedule_wire_stats(naive)
-    r1, e1, _ = C.schedule_wire_stats(opt)
+    r0, e0, _, prov0 = C.schedule_wire_stats(naive)
+    r1, e1, _, prov1 = C.schedule_wire_stats(opt)
     assert r1 == 4 and r0 > r1
     assert e0 == e1 == 32 * 4
+    assert (prov0, prov1) == ("naive", "konig")
 
 
 def test_dispatch_counters_use_optimized_rounds():

@@ -80,15 +80,18 @@ def test_dynamic_schedule_counts_per_call_average():
 def test_schedule_wire_stats_shapes():
     g = topo.ExponentialTwoGraph(8)
     sched = S.compile_static(g)
-    rounds, edges, hops = C.schedule_wire_stats(sched)
+    rounds, edges, hops, prov = C.schedule_wire_stats(sched)
     assert rounds == 3 and edges == 24
     assert hops is None  # no physical interconnect model active
+    assert prov == "naive"  # shift-structured: already at the König bound
     dyn = S.compile_dynamic(topo.one_peer_exp2_phases(8), 8)
-    rounds, edges, hops = C.schedule_wire_stats(dyn)
+    rounds, edges, hops, prov = C.schedule_wire_stats(dyn)
     assert rounds == 1 and edges == 8 and hops is None
+    assert prov == "naive"
     pg = S.compile_pair_gossip([1, 0, 3, 2, 5, 4, 7, 6], 8)
-    rounds, edges, hops = C.schedule_wire_stats(pg)
+    rounds, edges, hops, prov = C.schedule_wire_stats(pg)
     assert rounds == 1 and edges == 8 and hops is None
+    assert prov == "naive"  # pre-artifact schedule types default to naive
 
 
 def test_pair_gossip_and_hierarchical_counters():
