@@ -7,7 +7,7 @@ PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
-        placement-smoke synth-smoke
+        placement-smoke synth-smoke chaos-smoke chaos
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -15,7 +15,7 @@ PYTEST = python -m pytest -q
 # output-equivalent and never worse than naive — a broken repack fails
 # here loudly, not as a silent slowdown).
 test: test-fast bench-comm-smoke prof-smoke transport-smoke placement-smoke \
-      synth-smoke
+      synth-smoke chaos-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -86,6 +86,21 @@ synth-smoke:
 # messages/s win (bench_comm.py --transport).
 transport-smoke:
 	python bench_comm.py --transport-smoke
+
+# Churn-controller CI gate: a real 4-process `bfrun --chaos` gang on the
+# CPU backend, one rank SIGKILLed mid-gossip — asserts the survivors reach
+# failure consensus (a committed membership epoch in /healthz), re-plan
+# onto a survivor topology without a global restart within a bounded
+# number of steps, converge to the survivor-consensus optimum, and keep
+# post-recovery step time within 1.5x the pre-failure median.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --smoke
+
+# Full interactive chaos demo (same harness, bigger run; see
+# `python -m bluefog_tpu.tools chaos --help` for kill/delay/partition
+# fault specs).
+chaos:
+	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos
 
 native:
 	$(MAKE) -C bluefog_tpu/native

@@ -160,6 +160,30 @@ def _placement_summary(devs, dyn) -> "dict | None":
     }
 
 
+def _churn_summary() -> "dict | None":
+    """Churn-controller evidence for BENCH json: the live membership view
+    (epoch, active ranks, change count, last change time) when
+    BLUEFOG_TPU_CHURN is on, or the enabled=False stub otherwise — so a
+    bench run under churn carries the gang state its numbers were measured
+    against.  The single-chip bench never churns; the block exists so the
+    JSON schema is stable across workloads (the chaos harness is where the
+    membership actually moves)."""
+    from bluefog_tpu.ops import membership
+    from bluefog_tpu.utils import config
+    if not config.get().churn:
+        return {"enabled": False}
+    m = membership.health_summary()
+    if m is None:
+        return {"enabled": True, "active": None}
+    return {
+        "enabled": True,
+        "epoch": m["epoch"],
+        "active_ranks": m["active_ranks"],
+        "changes_total": m["changes_total"],
+        "last_change_unix": m["last_change_unix"],
+    }
+
+
 def _synthesis_summary(devs) -> "dict | None":
     """Modeled schedule-synthesis evidence for BENCH json, matching the
     placement pattern: the flagship STATIC Exp2 gossip schedule priced on
@@ -396,6 +420,7 @@ def main():
             "phase_latency": phase_latency or None,
             "placement": _placement_summary(devs, dyn),
             "synthesis": _synthesis_summary(devs),
+            "churn": _churn_summary(),
             "telemetry": snap,
         },
     }))

@@ -44,6 +44,7 @@ from bluefog_tpu.basics import (  # noqa: F401
     set_machine_topology,
     placement_info,
     synthesis_info,
+    membership_info,
     load_topology,
     load_machine_topology,
     in_neighbor_ranks,

@@ -753,6 +753,15 @@ def synthesis_info() -> Optional[dict]:
     }
 
 
+def membership_info() -> Optional[dict]:
+    """Summary of the churn controller's committed membership view —
+    epoch, active ranks, live suspicion, eviction state (None when
+    ``BLUEFOG_TPU_CHURN`` is off or no supervisor is live).  Mirrors the
+    ``/healthz`` "membership" block; see ``docs/operations.md``."""
+    from bluefog_tpu.ops import membership
+    return membership.health_summary()
+
+
 def load_topology() -> nx.DiGraph:
     return _require_init().topology
 

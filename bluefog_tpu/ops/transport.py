@@ -60,6 +60,11 @@ OP_MUTEX_REL = 9
 # combined with OP_BF16_FLAG at the frame level — compression is a per-sub-
 # message property, carried on each sub-message's own op byte.
 OP_BATCH = 10
+# Membership control plane (ops/membership.py): heartbeat / proposal /
+# view JSON payloads of the churn controller.  Rides the same per-peer
+# FIFO streams as gossip, so a peer whose data path is wedged cannot look
+# healthy through a side channel the data never takes.
+OP_MEMBER = 11
 # Flag bit ORed into the op byte when the payload is bf16-compressed (an f32
 # window row shipped as bfloat16).  An explicit wire flag — never inferred
 # from payload size — so a future partial-row or batched payload can't be
@@ -68,21 +73,25 @@ OP_BF16_FLAG = 0x40
 
 __all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
            "OP_GET_REPLY", "OP_FENCE_REQ", "OP_FENCE_ACK", "OP_MUTEX_ACQ",
-           "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BATCH", "OP_BF16_FLAG"]
+           "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BATCH", "OP_MEMBER",
+           "OP_BF16_FLAG"]
 
 _OP_NAMES = {OP_PUT: "put", OP_ACCUMULATE: "accumulate",
              OP_GET_REQ: "get_req", OP_GET_REPLY: "get_reply",
              OP_FENCE_REQ: "fence_req", OP_FENCE_ACK: "fence_ack",
              OP_MUTEX_ACQ: "mutex_acq", OP_MUTEX_GRANT: "mutex_grant",
-             OP_MUTEX_REL: "mutex_rel", OP_BATCH: "batch"}
+             OP_MUTEX_REL: "mutex_rel", OP_BATCH: "batch",
+             OP_MEMBER: "member"}
 
 # Ops whose latency is on a waiter's critical path (fence acks, mutex
 # grants, get replies): they flush the peer's queue immediately instead of
 # waiting out the linger, and — being enqueued AFTER any pending data —
 # certify that data once answered (the FIFO property win_fence needs).
+# Membership messages are urgent too: a heartbeat sitting out a linger
+# behind a slow batch would read as churn where there is none.
 _URGENT_OPS = frozenset((OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
                          OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT,
-                         OP_MUTEX_REL))
+                         OP_MUTEX_REL, OP_MEMBER))
 
 
 def _op_label(op: int) -> str:
@@ -366,6 +375,11 @@ class WindowTransport:
         self._linger = max(0.0, cfg.win_coalesce_linger_ms) / 1e3
         self._flush_bytes = max(1, cfg.win_coalesce_bytes)
         self._tx_queue_max = max(1, cfg.win_tx_queue)
+        self._retries = max(0, cfg.win_retries)
+        self._retry_backoff = max(0.0, cfg.win_retry_backoff_ms) / 1e3
+        # Peers declared unreachable by chaos fault injection: sends fail
+        # immediately, nothing rides the wire (set_partition).
+        self._partitioned: frozenset = frozenset()
         self._senders: Dict[Tuple[str, int], _PeerSender] = {}
         self._senders_lock = threading.Lock()
         # Cumulative coalescing stats behind one lock: sender workers on
@@ -437,6 +451,51 @@ class WindowTransport:
                 if s.q:
                     s.flush_now = True
                     s.cond.notify_all()
+
+    def set_partition(self, addrs) -> None:
+        """Declare a set of ``(host, port)`` peers unreachable (chaos fault
+        injection): every subsequent send to them fails like a dead link —
+        immediately, with no native call and no retries.  ``None`` or an
+        empty set heals the partition.  The error-epoch tokens scope the
+        failures to ops that addressed the partitioned peers, exactly as
+        with a real outage."""
+        self._partitioned = frozenset(addrs or ())
+
+    def drop_peer(self, host: str, port: int) -> None:
+        """Retire a peer's sender queue cleanly (churn controller: the peer
+        is dead by consensus).  Queued messages to it are discarded — there
+        is no one left to receive them — and producers blocked in its
+        backpressure wait are released with a ConnectionError.  Idempotent;
+        a later send to the same address would lazily create a fresh
+        sender (peer restart)."""
+        with self._senders_lock:
+            s = self._senders.pop((host, port), None)
+        if s is None:
+            return
+        with s.cond:
+            dropped = len(s.q)
+            s.q.clear()
+            s.bytes_pending = 0
+            # Account the discarded messages as done-with-error so a
+            # producer already blocked in flush() fails IMMEDIATELY (error
+            # checked before seq_done) instead of waiting out the closing
+            # grace for messages that can never be handed to TCP.
+            s.seq_done = s.seq_enq
+            if dropped:
+                s.error = ConnectionError(
+                    f"win transport peer {s.peer} retired by the churn "
+                    f"controller with {dropped} queued message(s) "
+                    "discarded")
+                s.err_count += 1
+            s.closing = True
+            s.cond.notify_all()
+        # No join: a worker stuck in a connect to a blackholed host exits
+        # on its own when the native call returns (daemon thread, closing
+        # set) — recovery must not pay that timeout.
+        from bluefog_tpu.utils import telemetry
+        if dropped and telemetry.enabled():
+            telemetry.inc("bf_win_tx_dropped_msgs_total", float(dropped),
+                          peer=f"{host}:{port}")
 
     def error_token(self, addrs=None) -> int:
         """Snapshot for ``flush(since=...)``: take it BEFORE sending (for
@@ -528,10 +587,22 @@ class WindowTransport:
     def _native_send(self, host: str, port: int, op: int, name: str,
                      src: int, dst: int, weight: float, p_weight: float,
                      payload: np.ndarray) -> None:
-        """One native RPC, with a single short-backoff retry on transient
-        failure (a peer restarting between the pooled connection's own
-        stale-fd retry and now) before raising ConnectionError."""
+        """One native RPC, with up to ``BLUEFOG_TPU_WIN_RETRIES`` jittered
+        exponential-backoff retries on transient failure (a peer restarting
+        between the pooled connection's own stale-fd retry and now) before
+        raising ConnectionError.  Each retry attempt is counted in
+        ``bf_win_tx_retries_total``."""
         from bluefog_tpu.utils import telemetry
+        if (host, port) in self._partitioned:
+            # Chaos partition (utils/chaos.py): this link is declared down;
+            # fail exactly like an unreachable peer, with no native call and
+            # no retries (a partition does not heal on a 50 ms backoff).
+            if telemetry.enabled():
+                telemetry.inc("bf_win_tx_errors_total",
+                              peer=f"{host}:{port}")
+            raise ConnectionError(
+                f"win transport send to {host}:{port} dropped "
+                "(chaos partition)")
         args = (host.encode(), port, op, name.encode(), src, dst,
                 float(weight), float(p_weight),
                 payload.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -540,10 +611,17 @@ class WindowTransport:
         # Retry only transient failures (connect/write to a restarting
         # peer); -1 (address resolution, the directory carries numeric
         # IPs) and -4 (name too long) are deterministic.
-        if rc not in (0, -1, -4):
+        attempt = 0
+        while rc not in (0, -1, -4) and attempt < self._retries:
             telemetry.inc("bf_win_tx_retries_total",
                           peer=f"{host}:{port}")
-            time.sleep(0.05)
+            # Full jitter on an exponential ladder: a gang-wide blip must
+            # not make every peer's sender hammer the restarting host in
+            # lockstep at exactly base, 2*base, 4*base...
+            import random
+            time.sleep(self._retry_backoff * (2 ** attempt)
+                       * (0.5 + random.random()))
+            attempt += 1
             rc = self._lib.bf_winsvc_send(*args)
         if rc != 0:
             if telemetry.enabled():

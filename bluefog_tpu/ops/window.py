@@ -69,7 +69,8 @@ __all__ = [
 #   MUTEX_REL  src=requesting rank, dst=rank whose mutex
 from bluefog_tpu.ops.transport import (  # noqa: E402
     OP_PUT, OP_ACCUMULATE, OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
-    OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL, OP_BF16_FLAG)
+    OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL, OP_MEMBER,
+    OP_BF16_FLAG)
 
 # Hard cap on waiting for a peer's reply.  Env-overridable so fault-injection
 # tests (and impatient deployments) can bound partition detection; the
@@ -316,13 +317,63 @@ def _local_host_addr() -> str:
         return "127.0.0.1"
 
 
+# Monotonic namespace for the coordinator-KV endpoint exchange: KV keys
+# are write-once, and an SPMD re-init must not collide with the previous
+# incarnation's entries.  Every process calls init_transport the same
+# number of times (it is an SPMD call), so the counters agree.
+_kv_exchange_generation = 0
+
+
+def _exchange_endpoints(me: str, n_procs: int, my_proc: int) -> list:
+    """All processes' transport endpoints (``host:port`` strings, index =
+    process id).
+
+    Prefers the jax distributed coordinator's key-value store — pure gRPC,
+    so it works even where the backend cannot run multi-process XLA
+    computations (CPU gangs), and exactly when a churn/chaos gang must
+    bootstrap without a collective.  Falls back to the legacy
+    ``process_allgather`` path when no coordinator client is up or the KV
+    store misbehaves."""
+    global _kv_exchange_generation
+    client = None
+    try:
+        from jax._src import distributed as _dist
+        client = getattr(_dist.global_state, "client", None)
+    except Exception:  # noqa: BLE001 — private API; absence = fallback
+        client = None
+    if client is not None:
+        gen = _kv_exchange_generation
+        _kv_exchange_generation += 1
+        try:
+            client.key_value_set(f"bf/win_addr/{gen}/{my_proc}", me)
+            return [client.blocking_key_value_get(
+                f"bf/win_addr/{gen}/{p}", 120_000)
+                for p in range(n_procs)]
+        except Exception as e:  # noqa: BLE001 — degrade to the collective
+            from bluefog_tpu.utils.logging import get_logger
+            get_logger().warning(
+                "window transport: coordinator-KV endpoint exchange failed "
+                "(%s); falling back to the collective allgather", e)
+    raw = me.encode()
+    if len(raw) > 64:
+        raise ValueError(f"transport address too long: {raw!r}")
+    buf = np.zeros(64, np.uint8)
+    buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return [bytes(gathered[p]).rstrip(b"\0").decode()
+            for p in range(gathered.shape[0])]
+
+
 def init_transport() -> bool:
     """Start the DCN window transport and exchange the rank directory.
 
-    Called by ``basics.init_distributed()`` when the world spans processes.
-    The per-process (host, port) endpoint is allgathered over the coordinator
-    (``multihost_utils.process_allgather``), replacing the reference's MPI
-    control plane for window bootstrap (``nccl_controller.cc:1240-1286``)."""
+    Called by ``basics.init_distributed()`` when the world spans processes
+    (and directly by chaos-gang workers that skip the collective init).
+    The per-process (host, port) endpoint rides the coordinator's KV store
+    when available, else a ``process_allgather`` — replacing the
+    reference's MPI control plane for window bootstrap
+    (``nccl_controller.cc:1240-1286``)."""
     from bluefog_tpu import basics
     if _store.distrib is not None:
         return True
@@ -331,16 +382,11 @@ def init_transport() -> bool:
     from bluefog_tpu.ops.transport import WindowTransport
     transport = WindowTransport(_apply_inbound,
                                 apply_batch=_apply_inbound_batch)
-    me = f"{_local_host_addr()}:{transport.port}".encode()
-    if len(me) > 64:
-        raise ValueError(f"transport address too long: {me!r}")
-    buf = np.zeros(64, np.uint8)
-    buf[:len(me)] = np.frombuffer(me, np.uint8)
-    from jax.experimental import multihost_utils
-    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    me = f"{_local_host_addr()}:{transport.port}"
+    addrs = _exchange_endpoints(me, jax.process_count(),
+                                jax.process_index())
     proc_addr = {}
-    for p in range(gathered.shape[0]):
-        addr = bytes(gathered[p]).rstrip(b"\0").decode()
+    for p, addr in enumerate(addrs):
         host, _, port = addr.rpartition(":")
         proc_addr[p] = (host, int(port))
     rank_owner = {i: d.process_index
@@ -600,6 +646,14 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
     buffer (valid only for this call): every retaining path (parking)
     snapshots it to bytes; every applying path folds it into a fresh
     array before returning."""
+    if (op & ~OP_BF16_FLAG) == OP_MEMBER:
+        # Churn-controller control plane (ops/membership.py): decoded and
+        # consumed immediately, never parked — a pre-init or post-shutdown
+        # heartbeat is simply dropped (the sender re-heartbeats on its own
+        # cadence, so nothing is lost).
+        from bluefog_tpu.ops import membership
+        membership.handle_wire(payload)
+        return
     orig_op = op  # parked/replayed messages must keep the wire flag bits
     compressed = bool(op & OP_BF16_FLAG)
     op &= ~OP_BF16_FLAG

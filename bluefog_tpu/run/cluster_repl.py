@@ -77,6 +77,19 @@ def bfstat_text() -> str:
            if health.get("unreachable_peer_ranks") else "")
         + (f"; windows: {', '.join(windows)}" if windows else "")
         + (f"; /metrics on :{port}" if port else ""))
+    member = health.get("membership")
+    if member:
+        import datetime
+        when = member.get("last_change_unix")
+        lines.append(
+            f"[bfstat] membership: epoch {member['epoch']}, "
+            f"{len(member['active_ranks'])}/{member['world_ranks']} ranks "
+            f"active {member['active_ranks']}"
+            + (f"; suspects {member['suspect_ranks']}"
+               if member.get("suspect_ranks") else "")
+            + (" (EVICTED)" if member.get("evicted") else "")
+            + (f"; last change {datetime.datetime.fromtimestamp(when):%H:%M:%S}"
+               if when else ""))
     straggler = health.get("straggler")
     if straggler:
         slow = straggler["slowest_rank"]

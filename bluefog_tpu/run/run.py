@@ -267,6 +267,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "steps — pair with --timeline and `python -m "
                         "bluefog_tpu.tools trace-merge` for a merged "
                         "per-rank trace")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="fault-injection spec for the gang (utils/chaos.py "
+                        "grammar): comma-separated kill:rank=K:step=N / "
+                        "delay:rank=K:step=N[:steps=M][:ms=D] / "
+                        "partition:rank=K:step=N[:steps=M].  Exported to "
+                        "every rank as BLUEFOG_TPU_CHAOS (ranks self-inject "
+                        "at the named steps) and implies BLUEFOG_TPU_CHURN=1 "
+                        "so the survivors re-form; a chaos-killed rank's "
+                        "death does NOT trigger the normal "
+                        "any-failure-kills-the-gang policy")
     p.add_argument("--tag-output", action="store_true",
                    help="prefix every output line with [rank] (mpirun "
                         "--tag-output parity); also prevents ranks' lines "
@@ -297,6 +307,12 @@ def _child_env(args, coord: str, rank: int, local_rank: int = 0,
         # port is logged by the endpoint at init).
         env["BLUEFOG_TPU_TELEMETRY_PORT"] = str(
             args.telemetry_port + rank if args.telemetry_port else 0)
+    if args.chaos:
+        # Ranks self-inject (the launcher cannot know when "step N"
+        # happens); chaos without the churn controller would just be a
+        # crashed gang, so --chaos implies churn unless explicitly pinned.
+        env["BLUEFOG_TPU_CHAOS"] = args.chaos
+        env.setdefault("BLUEFOG_TPU_CHURN", "1")
     return env
 
 
@@ -320,6 +336,22 @@ def main(argv=None) -> int:
             return 2
     else:
         placement = [("127.0.0.1", i) for i in range(args.num_proc)]
+
+    tolerate = frozenset()
+    if args.chaos:
+        from bluefog_tpu.utils.chaos import killed_ranks, parse_chaos
+        try:
+            faults = parse_chaos(args.chaos)
+        except ValueError as e:
+            print(f"bfrun: {e}", file=sys.stderr)
+            return 2
+        bad_targets = [f.rank for f in faults if f.rank >= args.num_proc]
+        if bad_targets:
+            print(f"bfrun: --chaos targets rank(s) {sorted(bad_targets)} "
+                  f"outside the {args.num_proc}-process gang",
+                  file=sys.stderr)
+            return 2
+        tolerate = frozenset(killed_ranks(faults))
 
     # The remote transport: one argv prefix for launch AND signalling.
     rsh = rsh_argv(args.rsh, args.ssh_port)
@@ -356,7 +388,7 @@ def main(argv=None) -> int:
                             if args.tag_output
                             else subprocess.Popen(rsh_cmd))
                     entries.append((proc, host, True))
-            rc = _wait_gang(entries, rsh, tag)
+            rc = _wait_gang(entries, rsh, tag, tolerate=tolerate)
         except KeyboardInterrupt:
             print("bfrun: interrupted; stopping the gang", file=sys.stderr)
             _kill_gang(entries, rsh, tag)
@@ -405,12 +437,29 @@ def _remote_signal(host: str, rsh: list, tag: str, sig: str) -> None:
         check=False)
 
 
+def _exit_reason(rc) -> str:
+    """Human-readable exit reason for one gang process."""
+    if rc is None:
+        return "UNRESPONSIVE (still running after SIGKILL)"
+    if rc < 0:
+        import signal as _signal
+        try:
+            name = _signal.Signals(-rc).name
+        except ValueError:
+            name = f"signal {-rc}"
+        return f"killed by {name}"
+    return f"exit {rc}"
+
+
 def _kill_gang(entries, rsh: list, tag: str,
                kill_grace: float = 10.0) -> None:
     """TERM the whole gang (local + remote), escalate to KILL after
     ``kill_grace`` — a peer blocked in a collective against a dead rank
     with ``run_elastic``'s SIGTERM handler installed can never reach a step
-    boundary to honor TERM."""
+    boundary to honor TERM — and print a per-rank exit-reason summary, so
+    a hung remote shell (whose local rsh client we can only disconnect)
+    can never leave the gang half-dead SILENTLY: any rank the escalation
+    could not reap is called out as UNRESPONSIVE."""
     remote_hosts = sorted({h for _, h, r in entries if r})
     for p, _, _ in entries:
         if p.poll() is None:
@@ -418,28 +467,43 @@ def _kill_gang(entries, rsh: list, tag: str,
     for h in remote_hosts:
         _remote_signal(h, rsh, tag, "TERM")
     deadline = time.monotonic() + kill_grace
-    pending = [p for p, _, _ in entries]
-    for p in pending:
+    escalated = set()
+    for rank, (p, _, _) in enumerate(entries):
         try:
             p.wait(timeout=max(0.1, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
+            escalated.add(rank)
             p.kill()
     for h in remote_hosts:
         _remote_signal(h, rsh, tag, "KILL")
-    for p in pending:
+    for rank, (p, _, _) in enumerate(entries):
         try:
             p.wait(timeout=30)
         except subprocess.TimeoutExpired:
             pass
-    return
+    parts = []
+    for rank, (p, host, is_remote) in enumerate(entries):
+        reason = _exit_reason(p.poll())
+        if rank in escalated:
+            reason += " after SIGTERM timeout"
+        if is_remote:
+            reason += f" [{host}]"
+        parts.append(f"rank {rank}: {reason}")
+    print("bfrun: gang exit summary — " + "; ".join(parts),
+          file=sys.stderr)
 
 
-def _wait_gang(entries, rsh: list, tag: str) -> int:
-    """Wait for all processes; any nonzero exit kills the survivors."""
+def _wait_gang(entries, rsh: list, tag: str,
+               tolerate=frozenset()) -> int:
+    """Wait for all processes; any nonzero exit kills the survivors —
+    except ranks in ``tolerate`` (chaos-injected deaths), whose exits are
+    expected and must leave the survivors running so recovery can be
+    observed.  The gang still waits for EVERY process to finish."""
     procs = [p for p, _, _ in entries]
     while True:
         rcs = [p.poll() for p in procs]
-        bad = next((r for r in rcs if r not in (None, 0)), None)
+        bad = next((r for i, r in enumerate(rcs)
+                    if r not in (None, 0) and i not in tolerate), None)
         if bad is None:
             if all(r is not None for r in rcs):
                 _join_tag_pumps(entries)

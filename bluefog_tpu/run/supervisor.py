@@ -1,0 +1,240 @@
+"""Churn supervisor: elastic gossip as a service.
+
+The control loop that fuses the pieces PRs 1-6 built separately — failure
+detection (transport reachability probes, heartbeat staleness, straggler
+step-lag), gossip-consistent membership consensus (``ops/membership.py``),
+survivor re-planning (``bf.set_topology`` over a doubly-stochastic survivor
+topology, which re-enters the PR 5/6 placement + schedule-synthesis
+pipeline automatically), and restart-free recovery (window state is carried
+across the re-plan by each process's OWNED rows — the same authority
+contract ``utils/elastic.py`` uses for its checkpoint stitching, applied
+live instead of through disk).
+
+Usage (the training loop drives it at step boundaries)::
+
+    sup = ChurnSupervisor()            # requires BLUEFOG_TPU_CHURN=1 and
+    ...                                # a live multi-process transport
+    for step in range(num_steps):
+        change = sup.step(step)        # heartbeats ride a background thread
+        if change is not None and change.evicted:
+            break                      # this rank was voted out: exit
+        train_step(...)                # windows/topology already re-planned
+    sup.stop()
+
+``step()`` returns ``None`` while the membership is stable.  When the gang
+commits a new membership, the supervisor — before returning — retires the
+dead peers' transport queues, frees and recreates every window under the
+survivor topology (owned rows preserved, push-sum mass preserved, staging
+from the dead peer dropped), and hands back the committed view so the loop
+can adjust anything of its own (telemetry already records
+``bf_churn_recovery_seconds``).  No rank ever restarts; no global barrier
+is involved beyond the consensus itself.
+
+Everything is inert unless ``BLUEFOG_TPU_CHURN=1``; constructing a
+supervisor without it raises, and with ``=0`` no module state changes
+anywhere — the legacy path is bit-identical.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from bluefog_tpu.utils import config
+
+__all__ = ["ChurnSupervisor", "maybe_supervisor"]
+
+
+class ChurnSupervisor:
+    """One per-process churn control loop over the live window transport."""
+
+    def __init__(self, *, topology_builder=None,
+                 on_change: Optional[Callable] = None,
+                 heartbeat_sec: Optional[float] = None,
+                 probe_timeout: float = 0.75):
+        cfg = config.get()
+        if not cfg.churn:
+            raise RuntimeError(
+                "ChurnSupervisor requires BLUEFOG_TPU_CHURN=1 (default off: "
+                "the churn controller must be an explicit operational "
+                "decision, never ambient)")
+        from bluefog_tpu import basics
+        from bluefog_tpu.ops import membership
+        from bluefog_tpu.ops import window as W
+        from bluefog_tpu.ops.transport import OP_MEMBER
+        d = W._store.distrib
+        if d is None:
+            raise RuntimeError(
+                "ChurnSupervisor needs the multi-process DCN window "
+                "transport (bf.init_distributed(), or init_transport() in "
+                "a chaos gang) — single-process runs have no gang to "
+                "supervise")
+        self._d = d
+        self._W = W
+        self._OP_MEMBER = OP_MEMBER
+        self._n = basics.size()
+        self._basics = basics
+        self._membership = membership
+        self._topology_builder = topology_builder
+        self._on_change = on_change
+        self._probe_timeout = probe_timeout
+        self._hb_sec = (max(0.01, cfg.churn_heartbeat_ms / 1e3)
+                        if heartbeat_sec is None else heartbeat_sec)
+        self.ctrl = membership.MembershipController(
+            n_procs=len(d.proc_addr), my_proc=d.my_proc,
+            rank_owner=dict(d.rank_owner),
+            send_fn=self._send, probe_fn=self._probe)
+        membership.install(self.ctrl)
+        from bluefog_tpu.utils import chaos, telemetry
+        self.chaos = chaos.ChaosInjector(
+            my_ranks=[r for r, p in d.rank_owner.items() if p == d.my_proc],
+            transport=d.transport,
+            peer_addrs=[a for p, a in d.proc_addr.items() if p != d.my_proc])
+        telemetry.set_gauge("bf_active_ranks", self._n)
+        telemetry.set_gauge("bf_membership_epoch", 0)
+        self._stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, daemon=True, name="bf-churn-hb")
+        self._hb_thread.start()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _send(self, proc: int, payload: bytes) -> None:
+        host, port = self._d.proc_addr[proc]
+        self._d.transport.send(host, port, self._OP_MEMBER, "",
+                               self._d.my_rank, -1, 0.0,
+                               np.frombuffer(payload, np.uint8))
+
+    def _probe(self, proc: int) -> bool:
+        try:
+            socket.create_connection(self._d.proc_addr[proc],
+                                     timeout=self._probe_timeout).close()
+            return True
+        except OSError:
+            return False
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self._hb_sec):
+            try:
+                self.ctrl.tick()
+            except Exception:  # noqa: BLE001 — the heartbeat must survive
+                from bluefog_tpu.utils.logging import get_logger
+                get_logger().exception("churn supervisor heartbeat failed")
+            if self.ctrl.evicted:
+                return
+
+    # -- the step-boundary API --------------------------------------------
+
+    def step(self, step: int):
+        """Advance the supervisor at a training-step boundary.  Applies any
+        chaos fault scheduled for this step, feeds the step counter into
+        the heartbeats (straggler detection), and — when the gang has
+        committed a membership change — performs the full recovery before
+        returning the committed :class:`~bluefog_tpu.ops.membership.
+        MembershipView` (``None`` when stable).  Recovery runs on the
+        CALLER's thread: the re-plan swaps topology and windows, which
+        must not race the training loop's own window ops."""
+        self.ctrl.note_step(step)
+        self.chaos.apply(step)
+        view = self.ctrl.poll_change()
+        if view is None:
+            return None
+        if view.evicted:
+            self._stop.set()
+            return view
+        self._recover(view)
+        if self._on_change is not None:
+            self._on_change(view)
+        return view
+
+    def _recover(self, view) -> None:
+        """Survivor-only re-plan + restart-free resume, timed into
+        ``bf_churn_recovery_seconds``.
+
+        1. Retire the dead peers' transport sender queues (their in-flight
+           gossip has nowhere to go; the per-peer error-epoch tokens
+           already scoped any overlapped op failures to exactly them).
+        2. Snapshot every window's OWNED rows + push-sum mass — each
+           process is authoritative for its own ranks, the same ownership
+           contract ``elastic.py`` stitches checkpoints by.
+        3. Re-enter ``bf.set_topology`` with the survivor topology
+           (doubly-stochastic by construction; the placement search and
+           schedule synthesis re-run for the new edge set exactly as for
+           any operator-initiated topology change).
+        4. Recreate the windows under the new topology from the owned
+           rows (staging from dead peers is dropped — zero-init — and
+           fresh in-edges start clean) and restore the push-sum scalars,
+           so a push-sum run keeps its conservation invariant across the
+           membership change."""
+        from bluefog_tpu.utils import telemetry
+        t0 = time.perf_counter()
+        for proc in view.removed_procs:
+            addr = self._d.proc_addr.get(proc)
+            if addr is not None:
+                self._d.transport.drop_peer(*addr)
+        W = self._W
+        snaps: Dict[str, dict] = {}
+        for name in W.get_current_created_window_names():
+            win = W._store.get(name)
+            with win.update_lock, win.lock:
+                snaps[name] = {
+                    "rows": np.stack([win.main[r] for r in win.owned])
+                    if win.owned else
+                    np.zeros((0,) + win.shape, win.dtype),
+                    "p_main": dict(win.p_main),
+                }
+        W.win_free()
+        topo = self._membership.survivor_topology(
+            self._n, view.active_ranks, builder=self._topology_builder)
+        self._basics.set_topology(topo, is_weighted=True)
+        for name, snap in snaps.items():
+            W.win_create(snap["rows"], name, zero_init=True)
+            win = W._store.get(name)
+            with win.lock:
+                for r, p in snap["p_main"].items():
+                    if r in win.p_main:
+                        win.p_main[r] = p
+        dt = time.perf_counter() - t0
+        telemetry.observe("bf_churn_recovery_seconds", dt)
+        from bluefog_tpu.utils.logging import get_logger
+        get_logger().warning(
+            "churn: recovered in %.3fs — epoch %d, %d/%d ranks active, "
+            "%d window(s) re-planned", dt, view.epoch,
+            len(view.active_ranks), self._n, len(snaps))
+
+    # -- lifecycle / introspection ----------------------------------------
+
+    def info(self) -> dict:
+        return self.ctrl.summary()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._hb_thread.join(timeout=5)
+        if self._membership.current() is self.ctrl:
+            self._membership.install(None)
+
+
+_singleton: Optional[ChurnSupervisor] = None
+_singleton_lock = threading.Lock()
+
+
+def maybe_supervisor() -> Optional[ChurnSupervisor]:
+    """The process-wide supervisor iff churn is enabled AND a multi-process
+    transport is live; None otherwise (never raises).  Lazily constructed
+    once — training loops and optimizers can call this every step."""
+    global _singleton
+    if not config.get().churn:
+        return None
+    from bluefog_tpu.ops import window as W
+    if W._store.distrib is None:
+        return None
+    with _singleton_lock:
+        if _singleton is None or _singleton._d is not W._store.distrib:
+            if _singleton is not None:
+                _singleton.stop()
+            _singleton = ChurnSupervisor()
+        return _singleton

@@ -29,6 +29,14 @@ step the reference never had:
       serial-link-time), plus the artifact metadata of the schedule the
       selection would dispatch.  Pure host math — no accelerator, no
       mesh, no bf.init() required.
+
+  python -m bluefog_tpu.tools chaos [--np 4] [--kill-rank K] [--smoke]
+      Chaos harness for the churn controller (``tools/chaos.py``): launch
+      a CPU multi-process gang under ``bfrun --chaos``, SIGKILL one rank
+      mid-gossip, and assert the survivors reach failure consensus,
+      re-plan onto a survivor topology without a global restart, converge
+      to the survivor optimum, and keep post-recovery step time within
+      1.5x the pre-failure median.  ``make chaos-smoke`` runs it in CI.
 """
 
 from __future__ import annotations
@@ -332,6 +340,13 @@ def schedule_dump(topology: str, n: int, torus: str, *, slices: int = 1,
 
 
 def main(argv=None) -> int:
+    import sys
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "chaos":
+        # The chaos harness owns a rich flag surface (and a --worker mode
+        # bfrun re-enters); delegate before the subparser dispatch.
+        from bluefog_tpu.tools.chaos import main as chaos_main
+        return chaos_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m bluefog_tpu.tools", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -347,6 +362,13 @@ def main(argv=None) -> int:
         "trace-summary",
         help="per-phase p50/p95/p99 table from a (merged) trace")
     ps.add_argument("trace", help="trace JSON file (merged or single-rank)")
+    # Listed for --help only; the real dispatch happens above (the chaos
+    # harness owns its own flag surface, including the bfrun-launched
+    # --worker mode).
+    sub.add_parser(
+        "chaos", add_help=False,
+        help="churn-controller chaos harness: kill a gang rank mid-gossip "
+             "under bfrun --chaos and assert survivor-only recovery")
     pd = sub.add_parser(
         "schedule-dump",
         help="compiled-schedule pipeline report (provenance, rounds, "

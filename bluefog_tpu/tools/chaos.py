@@ -1,0 +1,407 @@
+"""Chaos harness: kill a rank mid-gossip, watch the survivors re-form.
+
+    python -m bluefog_tpu.tools chaos [--np 4] [--steps 360] \
+        [--kill-rank 3] [--kill-step 40] [--smoke]
+
+Launches a CPU multi-process gang under ``bfrun --chaos`` running a small
+decentralized-optimization workload over the one-sided window path (each
+rank descends toward its own target and neighbor-averages through
+``win_put`` / ``win_update``), SIGKILLs one rank mid-run, and asserts the
+churn controller's whole promise end to end:
+
+  * the survivors reach failure consensus and commit a new membership
+    epoch WITHOUT a global restart (``bf_membership_changes_total``,
+    ``/healthz`` "membership" block);
+  * gossip re-plans onto a survivor-only topology (``set_topology``
+    re-entered live; windows rebuilt from owned rows) within a bounded
+    number of steps of the kill;
+  * the run converges to the survivor-consensus optimum (the mean of the
+    surviving ranks' targets — the same fixed point an uninterrupted
+    survivor-only run reaches);
+  * post-recovery step time stays within 1.5x the pre-failure median.
+
+Why this workload shape: the gang rides ONLY the DCN window transport
+(TCP) for gossip and membership — the exact paths that keep working when
+the gang is broken.  No jax collective is ever issued across processes,
+so the harness runs on stock CPU containers where multi-process XLA
+computations are unavailable, and the jax coordinator is used purely for
+rendezvous (with wide heartbeat windows, so the coordination service
+never pre-empts the churn controller's own failure handling).
+
+``--worker`` is the internal per-rank entry point ``bfrun`` launches; the
+driver is what operators (and ``make chaos-smoke``) run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+__all__ = ["main"]
+
+_RESULT_TAG = "CHAOS_RESULT "
+
+
+# ---------------------------------------------------------------------------
+# Worker (one gang rank)
+# ---------------------------------------------------------------------------
+
+def _init_rendezvous() -> None:
+    """jax.distributed init with very wide heartbeat windows: the churn
+    controller owns failure handling; the coordination service must not
+    terminate survivors just because a peer died (its default does)."""
+    coord = os.environ.get("BFTPU_COORDINATOR")
+    if coord is None:
+        raise SystemExit("chaos --worker must be launched under bfrun")
+    kwargs = dict(
+        coordinator_address=coord,
+        num_processes=int(os.environ["BFTPU_NUM_PROCESSES"]),
+        process_id=int(os.environ["BFTPU_PROCESS_ID"]))
+    try:
+        from jax._src import distributed as _dist
+        _dist.global_state.initialize(
+            service_heartbeat_interval_seconds=10,
+            service_max_missing_heartbeats=100000,
+            client_heartbeat_interval_seconds=10,
+            client_max_missing_heartbeats=100000, **kwargs)
+    except TypeError:
+        # Heartbeat kwargs moved/renamed on this jax: plain init still
+        # works as long as the run outlives the default windows.
+        import jax
+        jax.distributed.initialize(**kwargs)
+
+
+def _median_ms(samples) -> float:
+    return float(statistics.median(samples)) * 1e3 if samples else 0.0
+
+
+def _done_barrier(active_procs, my_proc: int, grace: float) -> None:
+    """Two-phase exit ordering over the coordinator's KV store (pure gRPC
+    — no collective).  Load-bearing for the gang's shutdown order: the
+    jax coordinator lives inside proc 0, and ANY survivor still holding a
+    live coordination client when proc 0 exits gets hard-aborted through
+    the coordination service's error poll — a fake casualty the harness
+    would misread as churn.  Phase 1: everyone announces its loop is done
+    and waits for the other ACTIVE survivors (dead procs are exactly the
+    ones that cannot answer, so they are never waited on).  Phase 2:
+    non-coordinator procs announce exit and leave immediately; proc 0
+    waits for those announcements and leaves LAST."""
+    try:
+        from jax._src import distributed as _dist
+        client = _dist.global_state.client
+        others = [p for p in sorted(active_procs) if p != my_proc]
+        client.key_value_set(f"bf/chaos_done/{my_proc}", "1")
+        for p in others:
+            client.blocking_key_value_get(f"bf/chaos_done/{p}", 60_000)
+        if my_proc != 0:
+            client.key_value_set(f"bf/chaos_exit/{my_proc}", "1")
+            return
+        for p in others:
+            client.blocking_key_value_get(f"bf/chaos_exit/{p}", 30_000)
+    except Exception as e:  # noqa: BLE001 — degrade to a plain grace sleep
+        print(f"chaos worker: done-barrier degraded to sleep ({e})",
+              file=sys.stderr, flush=True)
+        time.sleep(grace)
+
+
+def worker_main(args) -> int:
+    os.environ.setdefault("BLUEFOG_TPU_TELEMETRY", "1")
+    _init_rendezvous()
+    import jax
+    import numpy as np
+
+    import bluefog_tpu as bf
+    from bluefog_tpu.ops import window as W
+    from bluefog_tpu.run.supervisor import ChurnSupervisor
+    from bluefog_tpu.utils import config, telemetry
+    config.reload()
+    bf.init()
+    W.init_transport()
+    me = bf.rank()
+    target = float(me)
+    x = np.full(args.dim, target, np.float32)
+    name = "chaos_x"
+    W.win_create(x[None].copy(), name, zero_init=True)
+    sup = ChurnSupervisor()
+    port = telemetry.start_http_server(0)
+
+    times = []
+    recovery_step = None
+    view = None
+    put_errors = 0
+    seen_srcs = set()  # in-neighbors that have ever contributed gossip
+    for step in range(args.steps):
+        t0 = time.perf_counter()
+        change = sup.step(step)
+        if change is not None:
+            view = change
+            if change.evicted:
+                break
+            recovery_step = step
+            seen_srcs.clear()  # fresh window, fresh staging
+        # Local descent toward this rank's own target...
+        x = x - args.lr * (x - target)
+        # ...then asynchronous neighbor averaging: push my iterate to the
+        # out-neighbors, combine whatever my in-neighbors have delivered so
+        # far (combine-what-you-have: a neighbor whose put has not landed
+        # yet simply sits this round out — no waiting, no barrier).
+        try:
+            W.win_put(x[None], name)
+        except ConnectionError:
+            put_errors += 1  # a dead peer not yet voted out
+        seen_srcs.update(
+            s for s, v in W.get_win_version(name, me).items() if v > 0)
+        if seen_srcs:
+            w = 1.0 / (len(seen_srcs) + 1)
+            out = W.win_update(name, self_weight=w,
+                               neighbor_weights={s: w for s in seen_srcs})
+            x = np.asarray(out)[0].astype(np.float32)
+        times.append(time.perf_counter() - t0)
+        if args.pace_ms:
+            time.sleep(args.pace_ms / 1e3)
+
+    info = sup.info()
+    # Scrape our own /healthz over HTTP — the operator-facing surface the
+    # smoke must prove, not just the in-process dict.
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            hz = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:  # 503 when degraded — still JSON
+        hz = json.loads(e.read().decode())
+    snap = telemetry.snapshot()
+    # Pre-failure baseline: the steady window right BEFORE the kill, not
+    # the whole prefix — the first dozens of steps are warm-up (drain
+    # threads idle, heartbeats not yet flowing) and would understate the
+    # baseline the 1.5x regression bound is judged against.
+    pre = times[max(2, args.kill_step - 60):args.kill_step] \
+        if args.kill_step < len(times) else times[2:]
+    post = (times[recovery_step + 2:]
+            if recovery_step is not None else [])
+    print(_RESULT_TAG + json.dumps({
+        "rank": me,
+        "proc": jax.process_index(),
+        "epoch": info["epoch"],
+        "active_ranks": info["active_ranks"],
+        "changes_total": info["changes_total"],
+        "evicted": bool(view.evicted if view is not None else False),
+        "steps": len(times),
+        "recovery_step": recovery_step,
+        "x_mean": float(x.mean()),
+        "put_errors": put_errors,
+        "pre_median_ms": round(_median_ms(pre), 3),
+        "post_median_ms": round(_median_ms(post), 3),
+        # Per-50-step medians: the raw trend, so a failed regression bound
+        # can be told apart from ambient host-load noise at a glance.
+        "seg_ms": [round(_median_ms(times[i:i + 50]), 2)
+                   for i in range(0, len(times), 50)],
+        "recovery_observed":
+            snap.get("bf_churn_recovery_seconds_count", 0) >= 1,
+        "healthz_membership": hz.get("membership"),
+    }), flush=True)
+    # Exit in lockstep: heartbeats keep running while slower survivors
+    # finish (finish-time skew must not read as churn), and proc 0 — the
+    # jax coordinator's host — must leave LAST.
+    evicted = bool(view is not None and view.evicted)
+    active_procs = set() if evicted else {
+        W._store.distrib.rank_owner[r] for r in info["active_ranks"]}
+    sys.stdout.flush()
+    sys.stderr.flush()
+    _done_barrier(active_procs, jax.process_index(), args.grace)
+    # os._exit, not sys.exit: the jax distributed client's exit-time
+    # shutdown barrier would block on the chaos-killed task forever, and a
+    # non-coordinator survivor must leave with NOTHING between its exit
+    # announcement and the exit itself (see _done_barrier).
+    os._exit(0)
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+def _fail(msgs, what):
+    msgs.append(what)
+
+
+def run_demo(args) -> int:
+    n = args.np
+    if args.spec:
+        # The assertions below are kill-shaped (survivor set, recovery
+        # bound anchored on the kill step): a --spec override must carry
+        # exactly one kill so the harness judges against the right gang.
+        # Other fault mixes run under `bfrun --chaos` directly.
+        from bluefog_tpu.utils.chaos import killed_ranks, parse_chaos
+        kills = killed_ranks(parse_chaos(args.spec))
+        if len(kills) != 1:
+            raise SystemExit(
+                "chaos: --spec must contain exactly one kill fault "
+                f"(got {kills}); drive delay/partition-only mixes with "
+                "`bfrun --chaos` directly")
+        kill_rank = kills[0]
+        args.kill_step = next(f.step for f in parse_chaos(args.spec)
+                              if f.kind == "kill")
+        spec = args.spec
+    else:
+        kill_rank = (n - 1) if args.kill_rank is None else args.kill_rank
+        spec = f"kill:rank={kill_rank}:step={args.kill_step}"
+    if kill_rank == 0:
+        # The jax rendezvous coordinator lives inside rank 0: its death is
+        # a whole-gang loss (every coordination client hard-aborts), not a
+        # gossip-churn event.  Production deployments pin the coordinator
+        # outside the gang; this harness just refuses the footgun.
+        raise SystemExit("chaos: rank 0 hosts the rendezvous coordinator "
+                         "and cannot be the kill target — pick any other "
+                         "rank")
+    survivors = sorted(set(range(n)) - {kill_rank})
+    cmd = [sys.executable, "-m", "bluefog_tpu.run", "-np", str(n),
+           "--devices-per-proc", "1", "--chaos", spec, "--",
+           sys.executable, "-m", "bluefog_tpu.tools", "chaos", "--worker",
+           "--steps", str(args.steps), "--dim", str(args.dim),
+           "--lr", str(args.lr), "--pace-ms", str(args.pace_ms),
+           "--grace", str(args.grace), "--kill-step", str(args.kill_step)]
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BLUEFOG_TPU_CHURN": "1",
+        "BLUEFOG_TPU_CHURN_HEARTBEAT_MS": "80",
+        "BLUEFOG_TPU_CHURN_SUSPECT_MS": "500",
+        "BLUEFOG_TPU_WIN_RETRIES": "1",
+        "BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS": "25",
+        "BLUEFOG_TPU_TELEMETRY": "1",
+    })
+    print(f"chaos: launching {n}-process gang, {spec} "
+          f"({args.steps} steps)...", flush=True)
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=args.timeout)
+    wall = time.perf_counter() - t0
+    results = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith(_RESULT_TAG):
+            rec = json.loads(line[len(_RESULT_TAG):])
+            results[rec["rank"]] = rec
+
+    failures = []
+    if proc.returncode != 0:
+        _fail(failures, f"bfrun exited {proc.returncode} (the chaos kill "
+                        "must be tolerated, any other failure is real)")
+    if sorted(results) != survivors:
+        _fail(failures, f"expected reports from survivors {survivors}, "
+                        f"got {sorted(results)}")
+    target_mean = sum(float(r) for r in survivors) / len(survivors)
+    for rank in sorted(results):
+        r = results[rank]
+        line = (f"  rank {rank}: epoch {r['epoch']}, active "
+                f"{r['active_ranks']}, x_mean {r['x_mean']:.4f} "
+                f"(target {target_mean:.4f}), recovery@{r['recovery_step']}"
+                f", step ms pre/post {r['pre_median_ms']:.2f}/"
+                f"{r['post_median_ms']:.2f}, put_errors {r['put_errors']}")
+        print(line, flush=True)
+        if r["epoch"] < 1:
+            _fail(failures, f"rank {rank}: no membership epoch committed")
+        if list(r["active_ranks"]) != survivors:
+            _fail(failures, f"rank {rank}: active ranks {r['active_ranks']}"
+                            f" != survivors {survivors}")
+        if r["recovery_step"] is None:
+            _fail(failures, f"rank {rank}: never recovered")
+        elif r["recovery_step"] - args.kill_step > args.recovery_bound:
+            _fail(failures,
+                  f"rank {rank}: recovery took "
+                  f"{r['recovery_step'] - args.kill_step} steps "
+                  f"(bound {args.recovery_bound})")
+        if not r["recovery_observed"]:
+            _fail(failures, f"rank {rank}: bf_churn_recovery_seconds "
+                            "histogram never observed")
+        m = r.get("healthz_membership")
+        if not m or m.get("epoch", 0) < 1:
+            _fail(failures, f"rank {rank}: /healthz carries no committed "
+                            f"membership block ({m})")
+        if abs(r["x_mean"] - target_mean) > args.loss_tol:
+            _fail(failures,
+                  f"rank {rank}: consensus value {r['x_mean']:.4f} is "
+                  f"{abs(r['x_mean'] - target_mean):.4f} from the "
+                  f"survivor optimum {target_mean:.4f} "
+                  f"(tol {args.loss_tol})")
+        # Step-time regression: medians floored at pace + 5 ms — on a
+        # small shared CI box the op time is a few ms and ambient load
+        # swings it by more than that, so an anomalously QUIET pre-window
+        # must not fabricate a regression a genuinely slow post-recovery
+        # path (tens of ms: leftover retries, a peer not dropped) would
+        # still trip.
+        floor = args.pace_ms + 5.0
+        pre = max(r["pre_median_ms"], floor)
+        post = max(r["post_median_ms"], floor)
+        if post / pre > args.step_ratio:
+            _fail(failures, f"rank {rank}: post-recovery step time "
+                            f"{post:.2f}ms > {args.step_ratio}x "
+                            f"pre-failure {pre:.2f}ms")
+    if failures:
+        print("\nchaos FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        tail = "\n".join(proc.stderr.splitlines()[-40:])
+        print(f"\ngang stderr tail:\n{tail}", file=sys.stderr)
+        return 1
+    print(f"chaos OK: rank {kill_rank} killed at step {args.kill_step}, "
+          f"{len(survivors)} survivors re-formed and converged to "
+          f"{target_mean:.3f} (wall {wall:.1f}s)", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m bluefog_tpu.tools chaos", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--worker", action="store_true",
+                   help="internal: run as one gang rank (launched by the "
+                        "driver through bfrun)")
+    p.add_argument("--np", type=int, default=4,
+                   help="gang size (default 4)")
+    p.add_argument("--steps", type=int, default=360,
+                   help="training steps per rank (default 360)")
+    p.add_argument("--dim", type=int, default=128,
+                   help="parameter-vector length (default 128)")
+    p.add_argument("--lr", type=float, default=0.2)
+    p.add_argument("--pace-ms", type=float, default=5.0,
+                   help="per-step pacing sleep (stabilizes step-time "
+                        "medians on loaded hosts)")
+    p.add_argument("--grace", type=float, default=3.0,
+                   help="post-loop heartbeat grace before exiting, so "
+                        "finish-time skew never reads as churn")
+    p.add_argument("--kill-rank", type=int, default=None,
+                   help="rank to SIGKILL (default: the last one)")
+    p.add_argument("--kill-step", type=int, default=120,
+                   help="step at which the kill fires (late enough that "
+                        "the pre-failure baseline is measured in steady "
+                        "state, past the warm-up)")
+    p.add_argument("--spec", default=None,
+                   help="full chaos spec override (bfrun --chaos grammar); "
+                        "default kill:rank=<kill-rank>:step=<kill-step>")
+    p.add_argument("--recovery-bound", type=int, default=250,
+                   help="max steps between the kill and the survivors' "
+                        "re-plan (default 250)")
+    p.add_argument("--loss-tol", type=float, default=0.15,
+                   help="|consensus - survivor target mean| bound")
+    p.add_argument("--step-ratio", type=float, default=1.5,
+                   help="post/pre step-time median bound")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI smoke profile (same assertions, smaller run)")
+    args = p.parse_args(argv)
+    if args.worker:
+        return worker_main(args)
+    if args.smoke:
+        args.steps = min(args.steps, 300)
+        args.dim = min(args.dim, 64)
+    return run_demo(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,13 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_COALESCE_LINGER_MS | 1.0 | sender-worker linger before flushing a partial batch |
 | BLUEFOG_TPU_WIN_COALESCE_BYTES | 1 MiB | queued bytes that force an immediate batch flush |
 | BLUEFOG_TPU_WIN_TX_QUEUE      | 1024  | per-peer outbound queue bound (messages); full blocks the producer |
+| BLUEFOG_TPU_WIN_RETRIES       | 1     | transient-send retries before ConnectionError (0=none) |
+| BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS | 50 | base of the jittered exponential retry backoff |
+| BLUEFOG_TPU_CHURN             | 0     | 1: enable the elastic-gossip churn controller |
+| BLUEFOG_TPU_CHURN_HEARTBEAT_MS | 250  | membership heartbeat period |
+| BLUEFOG_TPU_CHURN_SUSPECT_MS  | 1500  | heartbeat silence before a peer is suspected |
+| BLUEFOG_TPU_CHURN_STRAGGLER_STEPS | 0 | step lag that marks a live peer a straggler suspect (0=off) |
+| BLUEFOG_TPU_CHAOS             | unset | fault-injection spec (set by bfrun --chaos) |
 | BLUEFOG_TPU_TELEMETRY         | 1     | 0: disable the metric registry entirely |
 | BLUEFOG_TPU_TELEMETRY_PORT    | unset | serve /metrics + /healthz (0=ephemeral) |
 | BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY | 10 | consensus-distance sample period (0=off) |
@@ -102,6 +109,28 @@ class Config:
     win_coalesce_linger_ms: float
     win_coalesce_bytes: int
     win_tx_queue: int
+    # Transient-send retry policy of the DCN transport (ops/transport.py):
+    # how many times a failed native send is retried with jittered
+    # exponential backoff (base win_retry_backoff_ms, doubling per
+    # attempt) before raising ConnectionError.  Each attempt is counted in
+    # bf_win_tx_retries_total.  0 disables retries (fail fast — what the
+    # churn controller's failure detector wants).
+    win_retries: int
+    win_retry_backoff_ms: float
+    # Elastic-gossip churn controller (ops/membership.py +
+    # run/supervisor.py); OFF by default — with churn=0 no membership
+    # state exists, no heartbeat is ever sent and every code path is
+    # bit-identical to the pre-churn tree.
+    churn: bool
+    churn_heartbeat_ms: float
+    churn_suspect_ms: float
+    # Step lag (in heartbeat-reported steps) beyond which a LIVE peer is
+    # proposed for eviction as a persistent straggler.  0 (default)
+    # disables straggler eviction — dead/unreachable peers only.
+    churn_straggler_steps: int
+    # Fault-injection spec (utils/chaos.py grammar), normally set for a
+    # gang by `bfrun --chaos`; unset = no injection.
+    chaos: Optional[str]
     telemetry: bool
     telemetry_port: Optional[int]
     telemetry_consensus_every: int
@@ -176,6 +205,18 @@ class Config:
                 "BLUEFOG_TPU_WIN_COALESCE_BYTES", str(1 << 20))),
             win_tx_queue=int(os.environ.get(
                 "BLUEFOG_TPU_WIN_TX_QUEUE", "1024")),
+            win_retries=int(os.environ.get(
+                "BLUEFOG_TPU_WIN_RETRIES", "1")),
+            win_retry_backoff_ms=float(os.environ.get(
+                "BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS", "50")),
+            churn=_flag("BLUEFOG_TPU_CHURN"),
+            churn_heartbeat_ms=float(os.environ.get(
+                "BLUEFOG_TPU_CHURN_HEARTBEAT_MS", "250")),
+            churn_suspect_ms=float(os.environ.get(
+                "BLUEFOG_TPU_CHURN_SUSPECT_MS", "1500")),
+            churn_straggler_steps=int(os.environ.get(
+                "BLUEFOG_TPU_CHURN_STRAGGLER_STEPS", "0")),
+            chaos=os.environ.get("BLUEFOG_TPU_CHAOS"),
             telemetry=_flag("BLUEFOG_TPU_TELEMETRY", default=True),
             telemetry_port=(
                 None if os.environ.get("BLUEFOG_TPU_TELEMETRY_PORT") is None

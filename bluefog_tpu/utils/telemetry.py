@@ -460,6 +460,18 @@ def health() -> dict:
     if depths:
         peer, depth = max(depths, key=lambda kv: kv[1])
         body["win_tx_deepest_queue"] = {"peer": peer, "depth": depth}
+    # Churn-controller membership (ops/membership.py): which ranks are in
+    # the gang, the committed epoch, and any live suspicion.  Absent
+    # entirely when BLUEFOG_TPU_CHURN is off — no block, no key, nothing.
+    try:
+        from bluefog_tpu.ops import membership
+        member = membership.health_summary()
+    except Exception:  # noqa: BLE001 — health must render regardless
+        member = None
+    if member is not None:
+        body["membership"] = member
+        if member.get("suspect_ranks") or member.get("evicted"):
+            body["status"] = "degraded"
     probe = stall._peer_probe
     if probe is not None:
         try:

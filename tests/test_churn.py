@@ -1,0 +1,499 @@
+"""Churn controller: membership consensus, survivor re-planning, chaos
+fault injection, launcher toleration, telemetry surfaces.
+
+The consensus protocol is exercised hermetically — controllers wired
+through an in-memory router with a fake clock and injectable probe, no
+sockets — and the survivor topology re-plan end-to-end on the 8-device
+virtual CPU mesh.  The full multi-process kill-a-rank-mid-gossip path runs
+as `make chaos-smoke` (and the slow-marked wrapper at the bottom)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as topo
+from bluefog_tpu.ops import membership as M
+from bluefog_tpu.utils import chaos as CH
+from bluefog_tpu.utils import config, telemetry
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_membership():
+    yield
+    M.install(None)
+    telemetry.reset()
+    config.reload()
+
+
+# ---------------------------------------------------------------------------
+# Chaos spec parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_chaos_specs():
+    faults = CH.parse_chaos(
+        "kill:rank=3:step=40, delay:rank=1:step=10:steps=5:ms=50,"
+        "partition:rank=2:step=20")
+    assert faults[0] == CH.Fault("kill", 3, 40)
+    assert faults[1] == CH.Fault("delay", 1, 10, steps=5, ms=50.0)
+    assert faults[2] == CH.Fault("partition", 2, 20, steps=20)
+    assert faults[1].active_at(10) and faults[1].active_at(14)
+    assert not faults[1].active_at(15)
+    assert CH.killed_ranks(faults) == [3]
+    assert CH.parse_chaos(None) == [] and CH.parse_chaos("") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "explode:rank=0:step=1",          # unknown kind
+    "kill:rank=0",                    # missing step
+    "kill:step=4",                    # missing rank
+    "kill:rank=0:step=4:bogus=1",     # unknown field
+    "kill:rank=-1:step=4",            # negative rank
+])
+def test_parse_chaos_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        CH.parse_chaos(bad)
+
+
+def test_chaos_injector_partition_toggles_transport():
+    class FakeTransport:
+        def __init__(self):
+            self.partitions = []
+
+        def set_partition(self, addrs):
+            self.partitions.append(set(addrs) if addrs else set())
+
+    t = FakeTransport()
+    inj = CH.ChaosInjector(
+        my_ranks=[2],
+        faults=CH.parse_chaos("partition:rank=2:step=5:steps=3"),
+        transport=t, peer_addrs=[("h", 1), ("h", 2)])
+    for step in range(12):
+        inj.apply(step)
+    # Engaged once at step 5, healed once at step 8 — no flapping.
+    assert t.partitions == [{("h", 1), ("h", 2)}, set()]
+
+
+def test_chaos_injector_ignores_other_ranks():
+    inj = CH.ChaosInjector(
+        my_ranks=[0], faults=CH.parse_chaos("kill:rank=3:step=1"))
+    inj.apply(1)  # rank 3's kill must not fire on rank 0
+
+
+# ---------------------------------------------------------------------------
+# Consensus state machine (hermetic: in-memory router, fake clock)
+# ---------------------------------------------------------------------------
+
+class _Gang:
+    """In-memory membership gang: n controllers, fake clock, losable
+    links, scriptable probe."""
+
+    def __init__(self, n, suspect_sec=1.0, straggler_steps=0):
+        self.clock = 0.0
+        self.dead = set()
+        self.ctrls = {}
+        for p in range(n):
+            self.ctrls[p] = M.MembershipController(
+                n, p, {r: r for r in range(n)},
+                send_fn=self._send_from(p),
+                probe_fn=lambda q: q not in self.dead,
+                now_fn=lambda: self.clock,
+                suspect_sec=suspect_sec,
+                straggler_steps=straggler_steps)
+
+    def _send_from(self, p):
+        def send(q, payload):
+            if q not in self.dead and q in self.ctrls:
+                self.ctrls[q].on_message(json.loads(payload.decode()))
+        return send
+
+    def run(self, seconds, dt=0.25):
+        t = 0.0
+        while t < seconds:
+            self.clock += dt
+            t += dt
+            for p, c in self.ctrls.items():
+                if p not in self.dead:
+                    c.tick()
+
+    def alive(self):
+        return [c for p, c in self.ctrls.items() if p not in self.dead]
+
+
+def test_consensus_commits_identical_view_on_all_survivors():
+    g = _Gang(4)
+    g.run(2.0)
+    assert all(c.epoch == 0 for c in g.alive())  # stable gang: no churn
+    g.dead.add(3)
+    g.run(5.0)
+    for c in g.alive():
+        v = c.view()
+        assert v.epoch == 1
+        assert v.active_ranks == (0, 1, 2)
+        ch = c.poll_change()
+        assert ch is not None and ch.removed_ranks == (3,)
+        assert c.poll_change() is None  # one commit, one change
+
+
+def test_consensus_survives_two_sequential_failures():
+    g = _Gang(5)
+    g.dead.add(4)
+    g.run(5.0)
+    g.dead.add(3)
+    g.run(5.0)
+    for c in g.alive():
+        assert c.epoch == 2
+        assert c.view().active_ranks == (0, 1, 2)
+
+
+def test_reachable_but_silent_peer_needs_hard_timeout():
+    """A peer whose listener still answers TCP (probe green) but whose
+    heartbeats stopped (partition, wedged process) is evicted only after
+    the 3x hard-silence window — never on the soft threshold alone."""
+    g = _Gang(3, suspect_sec=1.0)
+    g.run(1.0)
+
+    # Proc 2 goes silent but stays probe-reachable: drop its sends without
+    # marking it dead.
+    g.ctrls[2].send_fn = lambda q, payload: None
+    silent_since = g.clock
+    while g.clock < silent_since + 2.0:
+        g.run(0.25)
+    assert all(c.epoch == 0 for p, c in g.ctrls.items() if p != 2)
+    while g.clock < silent_since + 5.0:
+        g.run(0.25)
+    for p in (0, 1):
+        assert g.ctrls[p].epoch == 1
+        assert g.ctrls[p].view().active_ranks == (0, 1)
+
+
+def test_straggler_eviction_requires_opt_in():
+    g_off = _Gang(3, straggler_steps=0)
+    g_on = _Gang(3, straggler_steps=10)
+    for g in (g_off, g_on):
+        for step in range(40):
+            g.clock += 0.25
+            for p, c in g.ctrls.items():
+                # Rank 2 is alive and heartbeating but stuck at step 3.
+                c.note_step(3 if p == 2 else step)
+                c.tick()
+    assert all(c.epoch == 0 for c in g_off.ctrls.values())
+    assert g_on.ctrls[0].epoch == 1
+    assert g_on.ctrls[0].view().active_ranks == (0, 1)
+    # The straggler itself learns it was voted out.
+    assert g_on.ctrls[2].evicted
+    ev = g_on.ctrls[2].poll_change()
+    assert ev is not None and ev.evicted
+
+
+def test_withdrawn_proposal_cannot_back_a_commit():
+    """A peer's prop=None heartbeat WITHDRAWS its proposal: a commit must
+    never be evaluated against votes already retracted (a transiently
+    suspected rank that refuted the suspicion would otherwise be evicted
+    on stale agreements)."""
+    clock = [0.0]
+    ctrl = M.MembershipController(
+        4, 0, {r: r for r in range(4)}, send_fn=lambda q, p: None,
+        probe_fn=lambda q: q != 3, now_fn=lambda: clock[0],
+        suspect_sec=1.0)
+
+    def hb(proc, prop):
+        ctrl.on_message({"k": "hb", "proc": proc, "epoch": 0, "step": 0,
+                         "active": [0, 1, 2, 3], "prop": prop})
+
+    hb(1, [0, 1, 2])
+    hb(2, [0, 1, 2])
+    hb(1, None)   # both withdraw: proc 3 refuted their suspicion
+    hb(2, None)
+    clock[0] += 2.0   # now proc 3 goes stale for US too
+    hb(1, None)
+    hb(2, None)
+    ctrl.tick()       # we propose {0,1,2} — but 1 and 2 no longer do
+    assert ctrl.epoch == 0
+    hb(1, [0, 1, 2])  # fresh agreement: NOW the commit is legitimate
+    hb(2, [0, 1, 2])
+    ctrl.tick()
+    assert ctrl.epoch == 1
+    assert ctrl.view().active_ranks == (0, 1, 2)
+
+
+def test_same_epoch_divergent_views_reconcile_by_intersection():
+    """Two processes that raced their commits from different proposal
+    snapshots can land on the same epoch with different survivor sets;
+    the views must reconcile (monotone intersection), not coexist."""
+    def mk(my):
+        c = M.MembershipController(
+            4, my, {r: r for r in range(4)}, send_fn=lambda q, p: None,
+            probe_fn=lambda q: True, now_fn=lambda: 0.0)
+        c.epoch = 1
+        c.active = frozenset({0, 1, 2})
+        return c
+
+    c0 = mk(0)
+    c0.on_message({"k": "hb", "proc": 1, "epoch": 1, "step": 0,
+                   "active": [0, 1], "prop": None})
+    assert c0.epoch == 1
+    assert c0.view().active_ranks == (0, 1)
+    ch = c0.poll_change()
+    assert ch is not None and ch.removed_ranks == (2,)
+    # The rank outside the intersection receives the verdict.
+    c2 = mk(2)
+    c2.on_message({"k": "hb", "proc": 1, "epoch": 1, "step": 0,
+                   "active": [0, 1], "prop": None})
+    assert c2.evicted
+
+
+def test_summary_does_no_probing_and_reports_hard_silence_only():
+    """/healthz must never block on a dead host's connect timeout: the
+    summary path takes no probe verdicts, so suspicion shows up there on
+    the hard-silence window only."""
+    probes = []
+    clock = [0.0]
+    ctrl = M.MembershipController(
+        3, 0, {r: r for r in range(3)}, send_fn=lambda q, p: None,
+        probe_fn=lambda q: probes.append(q) or False,
+        now_fn=lambda: clock[0], suspect_sec=1.0)
+    clock[0] = 2.0  # peers soft-stale
+    assert ctrl.summary()["suspect_ranks"] == []
+    assert probes == []  # summary never probed
+    clock[0] = 4.0  # past the 3x hard-silence window
+    assert ctrl.summary()["suspect_ranks"] == [1, 2]
+    assert probes == []
+
+
+def test_epoch_ahead_heartbeat_adopts_or_evicts():
+    g = _Gang(4)
+    # A peer that committed ahead and still includes us: adopt.
+    g.ctrls[1].on_message({"k": "hb", "proc": 0, "epoch": 3, "step": 0,
+                           "active": [0, 1], "prop": None})
+    assert g.ctrls[1].epoch == 3
+    assert g.ctrls[1].view().active_ranks == (0, 1)
+    assert not g.ctrls[1].evicted
+    # A committed view that excludes us: eviction verdict.
+    g.ctrls[2].on_message({"k": "hb", "proc": 0, "epoch": 2, "step": 0,
+                           "active": [0, 1], "prop": None})
+    assert g.ctrls[2].evicted
+
+
+def test_commit_publishes_telemetry_and_health_block():
+    telemetry.reset()
+    g = _Gang(4)
+    M.install(g.ctrls[0])
+    assert telemetry.health().get("membership", {}).get("epoch") == 0
+    g.dead.add(2)
+    g.run(5.0)
+    snap = telemetry.snapshot()
+    assert snap.get("bf_membership_changes_total") == 1.0
+    assert snap.get("bf_active_ranks") == 3.0
+    assert snap.get("bf_membership_epoch") == 1.0
+    assert snap.get("bf_churn_last_change_timestamp", 0) > 0
+    hz = telemetry.health()
+    m = hz["membership"]
+    assert m["epoch"] == 1 and m["active_ranks"] == [0, 1, 3]
+    assert m["changes_total"] == 1 and not m["evicted"]
+
+
+def test_health_has_no_membership_block_when_churn_off():
+    assert "membership" not in telemetry.health()
+
+
+def test_handle_wire_drops_garbage_and_without_controller():
+    M.handle_wire(b"not json")        # no controller: dropped
+    g = _Gang(2)
+    M.install(g.ctrls[0])
+    M.handle_wire(b"\xff\xfe not json")  # undecodable: logged, dropped
+    M.handle_wire(json.dumps(
+        {"k": "hb", "proc": 1, "epoch": 0, "step": 7,
+         "active": [0, 1], "prop": None}).encode())
+    assert g.ctrls[0].peer_step[1] == 7
+
+
+# ---------------------------------------------------------------------------
+# Survivor topology + live re-plan through set_topology
+# ---------------------------------------------------------------------------
+
+def test_survivor_topology_is_doubly_stochastic_with_isolated_dead():
+    t = M.survivor_topology(8, [0, 2, 3, 5, 6])
+    w = topo.weight_matrix(t)
+    assert w.shape == (8, 8)
+    np.testing.assert_allclose(w.sum(axis=0), 1.0)
+    np.testing.assert_allclose(w.sum(axis=1), 1.0)
+    for dead in (1, 4, 7):
+        assert w[dead, dead] == 1.0
+        assert np.count_nonzero(w[dead]) == 1
+        assert np.count_nonzero(w[:, dead]) == 1
+    # Survivors form one connected gossip component.
+    import networkx as nx
+    sub = t.subgraph([0, 2, 3, 5, 6])
+    assert nx.is_strongly_connected(sub)
+
+
+def test_survivor_topology_validates_input():
+    with pytest.raises(ValueError):
+        M.survivor_topology(4, [])
+    with pytest.raises(ValueError):
+        M.survivor_topology(4, [0, 0, 1])
+    with pytest.raises(ValueError):
+        M.survivor_topology(4, [0, 9])
+
+
+def test_set_topology_replan_over_survivors():
+    """The recovery re-plan end to end on the virtual mesh: installing the
+    survivor topology re-enters the ordinary set_topology pipeline and
+    gossip averages over survivors only — dead ranks' rows ride their
+    identity self-loop, untouched."""
+    bf.init()
+    try:
+        survivors = [0, 1, 2, 4, 6, 7]
+        t = M.survivor_topology(N, survivors)
+        bf.set_topology(t, is_weighted=True)
+        x = np.stack([np.full(3, i, np.float32) for i in range(N)])
+        out = np.asarray(bf.neighbor_allreduce(x))
+        w = topo.weight_matrix(t)
+        expected = np.einsum("sd,s...->d...", w, x)
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+        for dead in (3, 5):
+            np.testing.assert_allclose(out[dead], x[dead], rtol=1e-6)
+    finally:
+        bf.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Launcher: --chaos toleration + kill-gang exit summary
+# ---------------------------------------------------------------------------
+
+def test_bfrun_parser_accepts_chaos_spec():
+    from bluefog_tpu.run.run import build_parser
+    args = build_parser().parse_args(
+        ["-np", "4", "--chaos", "kill:rank=3:step=40", "python", "x.py"])
+    assert args.chaos == "kill:rank=3:step=40"
+
+
+def test_bfrun_rejects_bad_chaos_spec_and_out_of_range_rank(capsys):
+    from bluefog_tpu.run import run as R
+    assert R.main(["-np", "2", "--chaos", "explode:rank=0:step=1",
+                   "python", "x.py"]) == 2
+    assert "unknown fault kind" in capsys.readouterr().err
+    assert R.main(["-np", "2", "--chaos", "kill:rank=5:step=1",
+                   "python", "x.py"]) == 2
+    assert "outside the 2-process gang" in capsys.readouterr().err
+
+
+class _FakeProc:
+    def __init__(self, rc=None):
+        self.rc = rc
+        self.terminated = self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        if self.rc is None:
+            import subprocess
+            raise subprocess.TimeoutExpired("fake", timeout)
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        self.killed = True
+
+
+def test_wait_gang_tolerates_chaos_killed_rank():
+    from bluefog_tpu.run import run as R
+    # Rank 1 dies by SIGKILL (rc -9, tolerated); ranks 0/2 finish clean.
+    procs = [_FakeProc(0), _FakeProc(-9), _FakeProc(0)]
+    entries = [(p, "127.0.0.1", False) for p in procs]
+    assert R._wait_gang(entries, ["ssh"], "tag", tolerate={1}) == 0
+    assert not any(p.terminated or p.killed for p in procs)
+
+
+def test_wait_gang_still_kills_on_untolerated_failure(capsys):
+    from bluefog_tpu.run import run as R
+    procs = [_FakeProc(0), _FakeProc(3), _FakeProc(0)]
+    entries = [(p, "127.0.0.1", False) for p in procs]
+    assert R._wait_gang(entries, ["ssh"], "tag", tolerate={0}) == 3
+    err = capsys.readouterr().err
+    assert "gang exit summary" in err
+    assert "rank 1: exit 3" in err
+
+
+def test_exit_reason_spellings():
+    from bluefog_tpu.run.run import _exit_reason
+    assert _exit_reason(0) == "exit 0"
+    assert _exit_reason(2) == "exit 2"
+    assert _exit_reason(-9) == "killed by SIGKILL"
+    assert "UNRESPONSIVE" in _exit_reason(None)
+
+
+def test_kill_gang_prints_summary_with_escalation(capsys):
+    from bluefog_tpu.run import run as R
+
+    class _Hung(_FakeProc):
+        def kill(self):
+            self.killed = True
+            self.rc = -9  # SIGKILL finally lands
+
+        def wait(self, timeout=None):
+            if self.killed:
+                return self.rc
+            import subprocess
+            raise subprocess.TimeoutExpired("fake", timeout)
+
+    procs = [_FakeProc(0), _Hung()]
+    entries = [(p, "127.0.0.1", False) for p in procs]
+    R._kill_gang(entries, ["ssh"], "tag", kill_grace=0.2)
+    err = capsys.readouterr().err
+    assert "rank 0: exit 0" in err
+    assert "rank 1: killed by SIGKILL after SIGTERM timeout" in err
+
+
+# ---------------------------------------------------------------------------
+# Config knobs
+# ---------------------------------------------------------------------------
+
+def test_churn_config_defaults(monkeypatch):
+    cfg = config.reload()
+    assert cfg.churn is False
+    assert cfg.win_retries == 1
+    assert cfg.win_retry_backoff_ms == 50.0
+    assert cfg.chaos is None
+    monkeypatch.setenv("BLUEFOG_TPU_CHURN", "1")
+    monkeypatch.setenv("BLUEFOG_TPU_CHAOS", "kill:rank=1:step=2")
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_RETRIES", "4")
+    cfg = config.reload()
+    assert cfg.churn and cfg.win_retries == 4
+    assert cfg.chaos == "kill:rank=1:step=2"
+
+
+def test_supervisor_refuses_without_churn_or_gang(monkeypatch):
+    from bluefog_tpu.run.supervisor import ChurnSupervisor, maybe_supervisor
+    config.reload()
+    with pytest.raises(RuntimeError, match="BLUEFOG_TPU_CHURN"):
+        ChurnSupervisor()
+    assert maybe_supervisor() is None  # churn off: structurally inert
+    monkeypatch.setenv("BLUEFOG_TPU_CHURN", "1")
+    config.reload()
+    with pytest.raises(RuntimeError, match="multi-process"):
+        ChurnSupervisor()  # churn on, but no gang transport
+    assert maybe_supervisor() is None  # no transport: still None
+
+
+# ---------------------------------------------------------------------------
+# Full gang (slow tier; `make chaos-smoke` runs the same harness in CI)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_smoke_end_to_end():
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.tools", "chaos", "--smoke"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "chaos OK" in r.stdout

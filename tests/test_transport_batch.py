@@ -446,3 +446,207 @@ def test_batch_frame_through_store_fence_like_sequence(coalesce_env):
         client.stop()
         server.stop()
         bf.win_free("e2e")
+
+
+# ---------------------------------------------------------------------------
+# Retry policy knobs + peer restart recovery (churn PR satellites)
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_retry_knobs_env(coalesce_env):
+    """BLUEFOG_TPU_WIN_RETRIES / BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS: 0 fails
+    fast with no retry counted; 3 counts exactly three attempts in
+    bf_win_tx_retries_total."""
+    import socket
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))  # bound, never listening: connect refused
+    port = dead.getsockname()[1]
+    key = f'bf_win_tx_retries_total{{peer="127.0.0.1:{port}"}}'
+    telemetry.reset()
+    try:
+        coalesce_env(BLUEFOG_TPU_WIN_COALESCE=0, BLUEFOG_TPU_WIN_RETRIES=0)
+        t = T.WindowTransport(lambda *a: None)
+        try:
+            with pytest.raises(ConnectionError):
+                t.send("127.0.0.1", port, T.OP_PUT, "w", 0, 1, 1.0,
+                       np.zeros(4, np.float32))
+        finally:
+            t.stop()
+        assert key not in telemetry.snapshot()
+
+        coalesce_env(BLUEFOG_TPU_WIN_COALESCE=0, BLUEFOG_TPU_WIN_RETRIES=3,
+                     BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS=1)
+        t = T.WindowTransport(lambda *a: None)
+        try:
+            with pytest.raises(ConnectionError):
+                t.send("127.0.0.1", port, T.OP_PUT, "w", 0, 1, 1.0,
+                       np.zeros(4, np.float32))
+        finally:
+            t.stop()
+        assert telemetry.snapshot().get(key) == 3.0
+    finally:
+        dead.close()
+
+
+@needs_native
+def test_peer_restart_scoped_failure_then_fresh_traffic(coalesce_env):
+    """The churn recovery contract at the transport layer: a dead peer
+    fails ONLY the overlapped ops that addressed it (the per-peer
+    error-epoch token never fails a healthy peer's flush), and once the
+    peer (re)starts ON THE SAME PORT the same client transport serves
+    fresh traffic to it — no client-side rebuild.
+
+    The dead peer is a bound-but-never-listening socket (deterministic
+    connect-refused); a peer that dies with an ESTABLISHED connection can
+    absorb one in-flight write into the kernel buffer before the RST
+    surfaces — that loss window is exactly why the churn controller
+    detects death by heartbeat + probe, never by send errors alone."""
+    import socket
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1, BLUEFOG_TPU_WIN_RETRIES=1,
+                 BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS=5,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=1)
+    rec_a = _Recorder()
+    srv_a = T.WindowTransport(rec_a.apply, apply_batch=rec_a.apply_batch)
+    dead = socket.socket()
+    dead.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    dead.bind(("127.0.0.1", 0))  # bound, never listening: connect refused
+    port_b = dead.getsockname()[1]
+    client = T.WindowTransport(lambda *a: None)
+    addr_a = ("127.0.0.1", srv_a.port)
+    addr_b = ("127.0.0.1", port_b)
+    srv_b = None
+    try:
+        row = np.arange(4, dtype=np.float32)
+        tok_a = client.error_token({addr_a})
+        tok_b = client.error_token({addr_b})
+        client.send(*addr_b, T.OP_PUT, "w", 0, 2, 1.0, row)
+        client.send(*addr_a, T.OP_PUT, "w", 0, 1, 1.0, row)
+        # The op that addressed the dead peer fails...
+        with pytest.raises(ConnectionError):
+            client.flush(timeout=30, addrs={addr_b}, since=tok_b)
+        # ...while the op that addressed the healthy peer is untouched,
+        # even though the failure happened inside its overlap window.
+        client.flush(timeout=30, addrs={addr_a}, since=tok_a)
+        rec_a.wait_for(1)
+
+        # The peer comes up on the SAME port (restart): fresh traffic
+        # must flow through the surviving client transport immediately.
+        dead.close()
+        rec_b = _Recorder()
+        srv_b = T.WindowTransport(rec_b.apply,
+                                  apply_batch=rec_b.apply_batch,
+                                  port=port_b)
+        client.send(*addr_b, T.OP_PUT, "w", 0, 2, 7.0, row)
+        client.flush(timeout=30, addrs={addr_b},
+                     since=client.error_token({addr_b}))
+        rec_b.wait_for(1)
+        assert rec_b.msgs[0][4] == 7.0  # the post-restart message, intact
+    finally:
+        client.stop()
+        srv_a.stop()
+        if srv_b is not None:
+            srv_b.stop()
+        try:
+            dead.close()
+        except OSError:
+            pass
+
+
+@needs_native
+def test_drop_peer_discards_queue_and_allows_lazy_recreate(coalesce_env):
+    """drop_peer (churn: the peer is dead by consensus) retires the sender
+    without stalling: queued messages are discarded and counted, flush no
+    longer waits on the dead peer, and a LATER send to the same address
+    lazily builds a fresh sender (peer restart)."""
+    import socket
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1, BLUEFOG_TPU_WIN_RETRIES=0,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=500)
+    t = T.WindowTransport(lambda *a: None)
+    try:
+        # Long linger: the message sits queued when drop_peer fires.
+        t.send("127.0.0.1", port, T.OP_PUT, "w", 0, 1, 1.0,
+               np.zeros(4, np.float32))
+        t.drop_peer("127.0.0.1", port)
+        t.flush(timeout=5)  # dead peer's queue is gone: nothing to wait on
+        # A fresh send lazily recreates the sender (restart path).
+        t.send("127.0.0.1", port, T.OP_PUT, "w", 0, 1, 1.0,
+               np.zeros(4, np.float32))
+        with t._senders_lock:
+            assert ("127.0.0.1", port) in t._senders
+    finally:
+        t.stop()
+        dead.close()
+
+
+@needs_native
+def test_set_partition_drops_sends_and_heals(coalesce_env):
+    """Chaos partition: sends to partitioned peers fail like a dead link
+    (no wire traffic, no retries); healing restores delivery."""
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1, BLUEFOG_TPU_WIN_RETRIES=2,
+                 BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS=50)
+    rec = _Recorder()
+    server = T.WindowTransport(rec.apply, apply_batch=rec.apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    addr = ("127.0.0.1", server.port)
+    key = f'bf_win_tx_retries_total{{peer="127.0.0.1:{server.port}"}}'
+    telemetry.reset()
+    try:
+        client.set_partition({addr})
+        client.send(*addr, T.OP_PUT, "w", 0, 1, 1.0,
+                    np.zeros(4, np.float32))
+        with pytest.raises(ConnectionError):
+            client.flush(timeout=30)
+        assert key not in telemetry.snapshot()  # partition never retries
+        client.set_partition(None)
+        client.send(*addr, T.OP_PUT, "w", 0, 1, 1.0,
+                    np.zeros(4, np.float32))
+        client.flush(timeout=30)
+        rec.wait_for(1)
+    finally:
+        client.stop()
+        server.stop()
+
+
+@needs_native
+def test_drop_peer_fails_blocked_flusher_immediately(coalesce_env):
+    """A producer already blocked in flush() on the dead peer must fail
+    the moment drop_peer retires it — not wait out the closing grace for
+    messages that can never be handed to TCP (the churn supervisor's
+    recovery latency depends on this)."""
+    import socket
+    import time as _time
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1, BLUEFOG_TPU_WIN_RETRIES=0,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=50)
+    t = T.WindowTransport(lambda *a: None)
+    outcome = []
+
+    def flusher():
+        t0 = _time.perf_counter()
+        try:
+            t.flush(timeout=30)
+            outcome.append(("ok", _time.perf_counter() - t0))
+        except ConnectionError:
+            outcome.append(("err", _time.perf_counter() - t0))
+
+    try:
+        t.send("127.0.0.1", port, T.OP_PUT, "w", 0, 1, 1.0,
+               np.zeros(4, np.float32))
+        th = threading.Thread(target=flusher)
+        th.start()
+        _time.sleep(0.2)
+        t.drop_peer("127.0.0.1", port)
+        th.join(timeout=10)
+        assert not th.is_alive()
+        # Raised (either from the worker's own fast connect failure or
+        # from the drop itself) well inside the 5 s closing grace.
+        assert outcome and outcome[0][0] == "err"
+        assert outcome[0][1] < 3.0, outcome
+    finally:
+        t.stop()
+        dead.close()
