@@ -7,7 +7,7 @@ PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
-        placement-smoke synth-smoke chaos-smoke chaos
+        placement-smoke synth-smoke hier-smoke chaos-smoke chaos
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -15,7 +15,7 @@ PYTEST = python -m pytest -q
 # output-equivalent and never worse than naive — a broken repack fails
 # here loudly, not as a silent slowdown).
 test: test-fast bench-comm-smoke prof-smoke transport-smoke placement-smoke \
-      synth-smoke chaos-smoke
+      synth-smoke hier-smoke chaos-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -78,6 +78,16 @@ placement-smoke:
 # BLUEFOG_TPU_SCHEDULE_SYNTH=0 restores the PR-5 dispatch path.
 synth-smoke:
 	env JAX_PLATFORMS=cpu python bench_comm.py --synth-smoke
+
+# Hierarchical-gossip CI gate: on simulated 2x(4x8) and 4x(4x4) multi-
+# slice tori the two-level mode (dense ICI inner exp2, sparse one-peer
+# DCN outer at cadence 2 with sparse:0.5 compression) must cut per-step
+# DCN wire rows AND modeled inter-slice serial link time >= 4x vs flat
+# exp2 at equal-or-better simulated consensus distance; plus the e2e
+# product-topology equivalence (<= 1e-6), the BLUEFOG_TPU_HIER=0
+# bit-identity check, and the sparse:<frac> OP_BATCH round-trip.
+hier-smoke:
+	env JAX_PLATFORMS=cpu python bench_comm.py --hier-smoke
 
 # CPU-runnable loopback two-transport exchange over the coalesced DCN
 # path: asserts batched delivery actually happened (OP_BATCH frames on

@@ -160,6 +160,56 @@ def _placement_summary(devs, dyn) -> "dict | None":
     }
 
 
+def _hierarchy_summary(devs, tree_bytes: float) -> "dict | None":
+    """Hierarchical-gossip evidence for BENCH json: the two-level policy
+    (levels, outer cadence, per-level compression) and the modeled
+    per-step wire bytes of each level for THIS run's parameter tree.
+    ``enabled`` mirrors ``BLUEFOG_TPU_HIER`` so the schema is stable; on
+    hosts whose devices expose no slice structure a synthetic 2-slice
+    split is priced and labeled (code-path evidence, never a hardware
+    claim — same convention as detail.placement)."""
+    from bluefog_tpu import topology
+    from bluefog_tpu.utils import config
+    cfg = config.get()
+    n = len(devs)
+    out = {"enabled": bool(cfg.hier)}
+    if n < 2:
+        return out
+    slices = {int(getattr(d, "slice_index", 0) or 0) for d in devs}
+    n_slices, synthetic = len(slices), False
+    if n_slices < 2 or n % n_slices:
+        if n % 2:
+            return out
+        n_slices, synthetic = 2, True
+    try:
+        ht = topology.hierarchical_two_level(
+            n, n_slices, inner=cfg.hier_inner, outer=cfg.hier_outer,
+            outer_every=cfg.hier_outer_every,
+            outer_self_weight=cfg.hier_outer_self_weight)
+    except ValueError:
+        return out
+    comp = cfg.hier_outer_compression
+    factor = config.compression_byte_factor(comp)
+    inner_edges = ht.ici_edges_per_step()
+    row_bytes = float(tree_bytes) / n
+    out.update({
+        "levels": 2,
+        "n_slices": n_slices,
+        "slice_size": ht.slice_size,
+        "synthetic_slices": synthetic,
+        "inner": ht.inner_kind,
+        "outer": ht.outer_kind,
+        "outer_every": ht.outer_every,
+        "outer_compression": comp,
+        "outer_self_weight": ht.outer_self_weight,
+        "ici_bytes_per_step": round(row_bytes * inner_edges, 1),
+        "dcn_bytes_per_step": round(
+            row_bytes * ht.dcn_edges_per_outer_step() * factor
+            / max(ht.outer_every, 1), 1),
+    })
+    return out
+
+
 def _churn_summary() -> "dict | None":
     """Churn-controller evidence for BENCH json: the live membership view
     (epoch, active ranks, change count, last change time) when
@@ -420,6 +470,7 @@ def main():
             "phase_latency": phase_latency or None,
             "placement": _placement_summary(devs, dyn),
             "synthesis": _synthesis_summary(devs),
+            "hierarchy": _hierarchy_summary(devs, tree_bytes),
             "churn": _churn_summary(),
             "telemetry": snap,
         },

@@ -19,6 +19,15 @@ delivery actually happened and the batch metrics exist, no timing
 assertion (shared CI boxes jitter); the full variant asserts the >= 2x
 messages/s win for 4 KB rows that motivated the tentpole.
 
+``--hier`` / ``--hier-smoke`` run the hierarchical-gossip report
+(``make hier-smoke``): flat static Exp2 vs the two-level mode (dense ICI
+inner, sparse one-peer DCN outer with cadence + compression) on
+simulated 2x(4x8) and 4x(4x4) multi-slice tori — per-step DCN wire
+rows, modeled inter-slice serial link time and simulated consensus
+distance, asserting >= 4x DCN reduction at equal-or-better consensus,
+plus the end-to-end product-topology equivalence and the sparse codec
+OP_BATCH round-trip.
+
 CPU-runnable by design: ppermute schedules compile and execute on the
 virtual host-platform mesh, so schedule regressions are caught by
 ``make bench-comm-smoke`` with no accelerator attached.  On CPU the script
@@ -82,6 +91,19 @@ def _parse_args():
     p.add_argument("--placement-iters", type=int, default=1000,
                    help="simulated-annealing refinement iterations for "
                         "the placement search (default 1000)")
+    p.add_argument("--hier", action="store_true",
+                   help="run the hierarchical-gossip report: per-step DCN "
+                        "wire rows, modeled inter-slice serial link time "
+                        "and simulated consensus distance of flat exp2 vs "
+                        "the two-level mode on simulated 2x(4x8) and "
+                        "4x(4x4) multi-slice tori, plus an end-to-end "
+                        "product-topology equivalence check on the "
+                        "virtual CPU mesh; asserts >= 4x DCN reduction "
+                        "at equal-or-better consensus")
+    p.add_argument("--hier-smoke", action="store_true",
+                   help="CI variant of --hier (same assertions — the "
+                        "cost model and consensus simulation are pure "
+                        "host math)")
     p.add_argument("--synth", action="store_true",
                    help="run the schedule-synthesis report: modeled "
                         "serial_link_time naive / congestion-packed / "
@@ -369,6 +391,249 @@ def placement_main(args) -> int:
     return 0
 
 
+def _dcn_serial_time(model, sched) -> float:
+    """Modeled inter-slice serial link time of one application of
+    ``sched``: sum over rounds of the busiest DCN link's weighted load —
+    the ICI portion deliberately excluded (the DCN links are the scarce
+    pod-scale resource this report isolates)."""
+    import numpy as np
+    node = np.asarray(model.device_node, np.int64)
+    first_dcn = model.first_dcn_link
+    total = 0.0
+    for rnd in sched.rounds:
+        loads = np.zeros(model.n_links)
+        for s, d in rnd.pairs:
+            r = model.route(int(node[s]), int(node[d]))
+            np.add.at(loads, r, 1.0)
+        dcn = loads[first_dcn:] * model.dcn_link_cost
+        if dcn.size:
+            total += float(dcn.max())
+    return total
+
+
+def _dcn_rows(w, n_slices) -> int:
+    """Directed inter-slice edges of one application of a flat weight
+    matrix over slice-contiguous rank blocks."""
+    import numpy as np
+    n = w.shape[0]
+    slice_of = np.arange(n) // (n // n_slices)
+    srcs, dsts = np.nonzero(w)
+    return int(sum(1 for s, d in zip(srcs, dsts)
+                   if s != d and slice_of[s] != slice_of[d]))
+
+
+def _simulate_hier_consensus(ht, w_flat, steps, frac, seed, dim=8):
+    """Consensus distance (mean per-rank L2 to the global mean) of flat
+    gossip vs the two-level mode after ``steps`` applications, simulated
+    exactly on the per-step effective operators (the sparse outer level
+    applies the block-restricted exchange per coordinate, matching the
+    compiled executor)."""
+    import math
+
+    import numpy as np
+    n = ht.n
+    rng = np.random.default_rng(seed)
+    x0 = rng.standard_normal((n, dim))
+    kk = max(1, int(math.ceil(frac * dim)))
+    nblocks = max(1, -(-dim // kk))
+    w_in_full = ht.inner_full_matrix()
+
+    def dist(x):
+        return float(np.linalg.norm(x - x.mean(axis=0, keepdims=True),
+                                    axis=1).mean())
+
+    xf = x0.copy()
+    xh = x0.copy()
+    for step in range(steps):
+        xf = w_flat.T @ xf
+        xh = w_in_full.T @ xh
+        if ht.is_outer_step(step):
+            outer_step = step // ht.outer_every
+            rot = (np.arange(kk) + (outer_step % nblocks) * kk) % dim
+            p = ht.outer_phase_index(step, sweep_len=nblocks)
+            wo = ht.outer_full_matrix(p)
+            xh[:, rot] = wo.T @ xh[:, rot]
+    return dist(xf), dist(xh)
+
+
+def hier_main(args) -> int:
+    """Hierarchical-gossip report (and the `make hier-smoke` CI gate).
+
+    Part 1 is pure host math: on simulated multi-slice tori (2 slices of
+    4x8, 4 slices of 4x4 — 64 ranks each) compare flat static Exp2
+    against the two-level mode (dense inner exp2 over ICI, one-peer exp2
+    outer over DCN at cadence 2 with sparse:0.5 outer compression and the
+    cadence-corrected self weight sqrt(1/2) -> 1/2 per exchange — exact
+    pairwise averaging, so a full outer phase sweep annihilates every
+    inter-slice mode).  Asserts, per torus: per-step DCN wire rows AND
+    modeled inter-slice serial link time both drop >= 4x, at
+    equal-or-better simulated consensus distance after a fixed step
+    budget.
+
+    Part 2 drives the real executor on the 8-device virtual CPU mesh:
+    dense/uncompressed/cadence-1 hierarchical_gossip must match flat
+    neighbor_allreduce over the product topology <= 1e-6, the
+    BLUEFOG_TPU_HIER=0 flat path must be BIT-identical to the unset-knob
+    tree, and the sparse:<frac> wire codec must round-trip bit-exact
+    through the OP_BATCH framing."""
+    import math
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+
+    import numpy as np
+
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.ops import schedule as S
+
+    smoke = args.hier_smoke
+    outer_every = 2
+    frac = 0.5
+    # Cadence-corrected: theta**outer_every == 0.5 per exchange — exact
+    # pairwise averaging, the weight under which a full one-peer exp2
+    # sweep is an exact inter-slice average.
+    theta = math.sqrt(0.5)
+    budget_steps = 24
+    tori = {
+        "2x(4x8)": ((4, 8), 2),
+        "4x(4x4)": ((4, 4), 4),
+    }
+    detail = {}
+    worst_bytes_ratio = None
+    for tname, (dims, n_slices) in tori.items():
+        model = PL.synthetic_torus(dims, n_slices=n_slices)
+        n = len(model.device_node)
+        ht = topo.hierarchical_two_level(
+            n, n_slices, outer_every=outer_every, outer_self_weight=theta)
+        w_flat = topo.weight_matrix(topo.ExponentialTwoGraph(n))
+        flat_sched = S._build_schedule(w_flat, optimize=True)
+
+        # -- per-step DCN wire rows (row-bytes at unit payload) ------------
+        flat_rows = _dcn_rows(w_flat, n_slices)
+        hier_rows = ht.dcn_edges_per_outer_step() * frac / outer_every
+        bytes_ratio = flat_rows / max(hier_rows, 1e-12)
+
+        # -- modeled inter-slice serial link time per step -----------------
+        flat_dcn_serial = _dcn_serial_time(model, flat_sched)
+        outer_scheds = [
+            S._build_schedule(ht.outer_full_matrix(p), optimize=True)
+            for p in range(len(ht.outer_phases))]
+        hier_dcn_serial = (sum(_dcn_serial_time(model, s)
+                               for s in outer_scheds)
+                           / max(len(outer_scheds), 1)
+                           * frac / outer_every)
+        serial_ratio = flat_dcn_serial / max(hier_dcn_serial, 1e-12)
+
+        # -- consensus distance after the fixed step budget ----------------
+        flat_dist, hier_dist = _simulate_hier_consensus(
+            ht, w_flat, budget_steps, frac, args.seed)
+
+        assert bytes_ratio >= 4.0, (
+            f"{tname}: hierarchical DCN wire rows must drop >= 4x vs "
+            f"flat exp2, got {bytes_ratio:.2f}x")
+        assert serial_ratio >= 4.0, (
+            f"{tname}: modeled inter-slice serial time must drop >= 4x, "
+            f"got {serial_ratio:.2f}x")
+        assert hier_dist <= flat_dist + 1e-12, (
+            f"{tname}: hierarchical consensus distance {hier_dist:.3e} "
+            f"worse than flat {flat_dist:.3e} after {budget_steps} steps")
+        worst_bytes_ratio = (bytes_ratio if worst_bytes_ratio is None
+                             else min(worst_bytes_ratio, bytes_ratio))
+        detail[tname] = {
+            "n": n, "n_slices": n_slices,
+            "dcn_rows_flat_per_step": flat_rows,
+            "dcn_rows_hier_per_step": hier_rows,
+            "dcn_rows_reduction": round(bytes_ratio, 3),
+            "dcn_serial_flat": flat_dcn_serial,
+            "dcn_serial_hier": round(hier_dcn_serial, 4),
+            "dcn_serial_reduction": round(serial_ratio, 3),
+            "consensus_flat": flat_dist,
+            "consensus_hier": hier_dist,
+            "steps": budget_steps,
+            "policy": {"inner": "exp2", "outer": "exp2 one-peer",
+                       "outer_every": outer_every,
+                       "outer_compression": f"sparse:{frac}",
+                       "outer_self_weight_per_exchange": 0.5},
+        }
+
+    # ---- Part 2a: sparse wire codec through the OP_BATCH framing --------
+    from bluefog_tpu.ops import transport as T
+    rng = np.random.default_rng(args.seed)
+    row = rng.standard_normal(64).astype(np.float32)
+    idx = np.argsort(-np.abs(row))[:16].astype(np.int32)
+    idx.sort()
+    payload = T.sparse_encode(row[idx], idx)
+    msgs = [(T.OP_ACCUMULATE | T.OP_SPARSE_FLAG, "w", 0, 1, 1.0, 0.0,
+             payload.tobytes())]
+    decoded = T._decode_batch(T._encode_batch(msgs))
+    d_idx, d_val = T.sparse_decode(decoded[0][6])
+    assert np.array_equal(d_idx, idx) and np.array_equal(
+        d_val.view(np.int32), row[idx].view(np.int32)), \
+        "sparse payload must round-trip BIT-exact through OP_BATCH framing"
+
+    # ---- Part 2b: end-to-end executor equivalence on the CPU mesh -------
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import bluefog_tpu as bf
+    from bluefog_tpu.utils import config
+    knobs = ("BLUEFOG_TPU_HIER", "BLUEFOG_TPU_HIER_OUTER_EVERY",
+             "BLUEFOG_TPU_HIER_OUTER_COMPRESSION")
+    saved = {k: os.environ.get(k) for k in knobs}
+    x8 = np.random.default_rng(args.seed).standard_normal(
+        (8, 16)).astype(np.float32)
+    e2e = {}
+    try:
+        for k in knobs:
+            os.environ.pop(k, None)
+        config.reload()
+        bf.init(lambda: topo.ExponentialGraph(8), local_size=4)
+        out_unset = np.asarray(bf.neighbor_allreduce(x8))
+        bf.shutdown()
+
+        os.environ["BLUEFOG_TPU_HIER"] = "1"
+        config.reload()
+        bf.init(lambda: topo.ExponentialGraph(8), local_size=4)
+        out_flat = np.asarray(bf.neighbor_allreduce(x8))
+        assert np.array_equal(out_unset, out_flat), (
+            "flat neighbor_allreduce must be BIT-identical with "
+            "BLUEFOG_TPU_HIER on vs unset (the knob gates only the "
+            "hierarchical path)")
+        ht8 = topo.hierarchical_two_level(8, 2)
+        max_diff = 0.0
+        for step in range(4):
+            out_h = np.asarray(bf.hierarchical_gossip(x8, step))
+            expect = np.asarray(bf.neighbor_allreduce(
+                x8, src_weights=ht8.effective_weight_matrix(step)))
+            max_diff = max(max_diff,
+                           float(np.abs(out_h - expect).max()))
+        assert max_diff <= 1e-6, (
+            f"dense cadence-1 hierarchical gossip drifted {max_diff} "
+            "(> 1e-6) from the flat product topology")
+        e2e = {"mesh": "8-device CPU, 2 slices of 4",
+               "product_equivalence_max_diff": max_diff,
+               "hier_info": bf.hierarchical_gossip_info()}
+        bf.shutdown()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        config.reload()
+
+    print(json.dumps({
+        "metric": "hier_gossip_dcn_wire_reduction_worst_torus",
+        "value": round(worst_bytes_ratio, 3),
+        "unit": "x",
+        "detail": {"smoke": smoke, "tori": detail, "e2e": e2e},
+    }))
+    return 0
+
+
 def _topo_families(topo, n, seed, degree=4):
     """The four benchmark topology families every report sweeps."""
     return (
@@ -582,6 +847,8 @@ def main():
         return placement_main(args)
     if args.synth or args.synth_smoke:
         return synth_main(args)
+    if args.hier or args.hier_smoke:
+        return hier_main(args)
     if args.smoke:
         args.n = args.n or 8
         args.payload = min(args.payload, 1024)

@@ -79,6 +79,9 @@ from bluefog_tpu.basics import (  # noqa: F401
     hierarchical_neighbor_allreduce_nonblocking,
     dynamic_hierarchical_neighbor_allreduce,
     dynamic_hierarchical_neighbor_allreduce_nonblocking,
+    hierarchical_gossip,
+    hierarchical_gossip_nonblocking,
+    hierarchical_gossip_info,
     local_allreduce,
     local_allreduce_nonblocking,
     pair_gossip,

@@ -82,6 +82,11 @@ class _Context:
         self.placement_generation: int = 0
         self.topology: Optional[nx.DiGraph] = None
         self.machine_topology: Optional[nx.DiGraph] = None
+        # Two-level hierarchical gossip (BLUEFOG_TPU_HIER): the cached
+        # HierarchicalTopology artifact + the config knobs it was built
+        # from (rebuilt when the knobs change via config.reload()).
+        self.hier_topology = None
+        self._hier_key: Optional[tuple] = None
         self.is_topo_weighted: bool = False
         self.is_machine_topo_weighted: bool = False
         # Monotonic generations: cache keys use these, never id(graph) —
@@ -600,6 +605,20 @@ def _refresh_placement(ctx) -> None:
             scheds.append(S.compile_dynamic(phases, n))
         except ValueError:
             pass  # period too long: the static edge set covers the union
+        if cfg.hier and 0 < ctx.local_size < n and n % ctx.local_size == 0:
+            # Two-level gossip (BLUEFOG_TPU_HIER): price each level
+            # against its actual links — the dense inner level (block-
+            # diagonal over slices, pure ICI) and every sparse outer
+            # one-peer phase (pure DCN) join the joint placement search,
+            # so the installed permutation serves the hierarchical
+            # traffic alongside the flat schedules.
+            ht = _hier_topology(ctx, cfg)
+            if ht.n_slices > 1:
+                scheds.append(
+                    S._schedule_from_matrix(ht.inner_full_matrix()))
+                scheds.extend(
+                    S._schedule_from_matrix(ht.outer_full_matrix(p))
+                    for p in range(len(ht.outer_phases)))
         block = ctx.local_size if 0 < ctx.local_size < n else None
         result, packed_mll, synth_ratio, dispatch_prov = _placement_search(
             model, scheds, n, iters=cfg.placement_iters, block=block,
@@ -1404,6 +1423,159 @@ def dynamic_hierarchical_neighbor_allreduce(x, step: int, *,
                                             phases=None) -> jnp.ndarray:
     return synchronize(dynamic_hierarchical_neighbor_allreduce_nonblocking(
         x, step, phases=phases))
+
+
+# ---------------------------------------------------------------------------
+# Two-level hierarchical gossip (BLUEFOG_TPU_HIER: dense ICI x sparse DCN)
+# ---------------------------------------------------------------------------
+
+def _hier_topology(ctx, cfg=None):
+    """The process's :class:`topology.HierarchicalTopology`, built from the
+    ``BLUEFOG_TPU_HIER_*`` knobs over the (machine, local) mesh structure
+    (slices = machines) and cached until the knobs or the mesh change."""
+    from bluefog_tpu.utils import config
+    if cfg is None:
+        cfg = config.get()
+    n = len(ctx.devices)
+    n_slices = n // ctx.local_size if ctx.local_size else 1
+    key = (n, n_slices, cfg.hier_inner, cfg.hier_outer,
+           cfg.hier_outer_every, cfg.hier_outer_self_weight)
+    if ctx._hier_key != key:
+        ctx.hier_topology = topology_util.hierarchical_two_level(
+            n, n_slices, inner=cfg.hier_inner, outer=cfg.hier_outer,
+            outer_every=cfg.hier_outer_every,
+            outer_self_weight=cfg.hier_outer_self_weight)
+        ctx._hier_key = key
+    return ctx.hier_topology
+
+
+def _hier_bundle(ctx, ht, cfg):
+    """Compiled executables of one hierarchical topology: the dense inner
+    schedule (slice-local ranks), the per-phase outer schedules (slice
+    ranks) and the inner's directed edge count (wire accounting) — cached
+    in the context schedule cache on the full policy signature."""
+    sig = ("hier_gossip", ht.n, ht.n_slices, ht.inner_kind, ht.outer_kind,
+           ht.outer_every, ht.outer_self_weight,
+           cfg.hier_outer_compression)
+
+    def build():
+        inner_sched = S.compile_static(ht.inner, use_topo_weights=True)
+        outer_scheds = tuple(
+            S._schedule_from_matrix(ht.outer_slice_matrix(p))
+            for p in range(len(ht.outer_phases)))
+        return inner_sched, outer_scheds, ht.ici_edges_per_step()
+    return ctx.static_schedule(sig, build), sig
+
+
+def _record_hier_levels(ht, step: int, nbytes: float, inner_edges: int,
+                        compression: str) -> None:
+    """Per-level wire accounting of one hierarchical gossip step: ICI
+    bytes (dense inner edges, every step), DCN bytes (one peer per rank
+    on outer steps, scaled by the outer codec's
+    ``config.compression_byte_factor``) and the outer-step counter.
+    Lands in ``bf_comm_level_bytes_total{level=ici|dcn}`` and
+    ``bf_hier_outer_steps_total`` on /metrics and in
+    ``bf.telemetry_snapshot()``; shared by the eager dispatch and the
+    optimizer families (whose fused step programs never cross Python
+    per level)."""
+    from bluefog_tpu.utils import config, telemetry
+    if not telemetry.enabled():
+        return
+    row_bytes = float(nbytes) / max(ht.n, 1)
+    telemetry.inc("bf_comm_level_bytes_total",
+                  row_bytes * inner_edges, level="ici")
+    if ht.n_slices > 1 and ht.is_outer_step(int(step)):
+        telemetry.inc("bf_comm_level_bytes_total",
+                      row_bytes * ht.dcn_edges_per_outer_step()
+                      * config.compression_byte_factor(compression),
+                      level="dcn")
+        telemetry.inc("bf_hier_outer_steps_total")
+
+
+def hierarchical_gossip_nonblocking(x, step: int, *, ht=None) -> Handle:
+    """Two-level gossip step: dense intra-slice neighbor averaging over the
+    ICI (LOCAL) mesh axis every step, sparse one-peer inter-slice exchange
+    over the DCN (MACHINE) axis every ``BLUEFOG_TPU_HIER_OUTER_EVERY``
+    steps with per-level compression
+    (``BLUEFOG_TPU_HIER_OUTER_COMPRESSION``) — the pod-scale restatement
+    of neighbor averaging for interconnects where DCN is ~4x ICI
+    (HiCCL-style composition; see docs/performance.md "Hierarchical
+    gossip").
+
+    Requires ``BLUEFOG_TPU_HIER=1`` (default off — every flat path is
+    bit-identical with the knob unset) and a multi-slice mesh
+    (``bf.init(local_size=...)`` with more than one machine/slice).
+    ``ht`` overrides the config-built
+    :class:`~bluefog_tpu.topology.HierarchicalTopology`.
+    """
+    from bluefog_tpu.utils import config, telemetry
+    ctx = _require_active()
+    cfg = config.get()
+    if not cfg.hier:
+        raise RuntimeError(
+            "hierarchical_gossip requires BLUEFOG_TPU_HIER=1 (default off: "
+            "the two-level mode must be an explicit operational decision; "
+            "the flat path stays bit-identical without it)")
+    if ctx.local_size >= len(ctx.devices):
+        raise RuntimeError(
+            "hierarchical_gossip needs a multi-slice mesh: call "
+            "bf.init(local_size=<ranks per slice>) so machine_size() > 1")
+    if ht is None:
+        ht = _hier_topology(ctx, cfg)
+    (inner_sched, outer_scheds, inner_edges), sig = _hier_bundle(
+        ctx, ht, cfg)
+    compression = cfg.hier_outer_compression
+    frac = (config.parse_sparse_frac(compression)
+            if compression.startswith("sparse") else None)
+    fn = partial(C.hierarchical_gossip, inner_sched=inner_sched,
+                 outer_scheds=outer_scheds, local_axis=LOCAL_AXIS,
+                 machine_axis=MACHINE_AXIS, outer_every=ht.outer_every,
+                 outer_compression=compression, outer_frac=frac)
+    if telemetry.enabled():
+        # calls/bytes land via _dispatch_hier's _record_dispatch; only the
+        # per-LEVEL split is recorded here (the dispatch layer has no
+        # notion of levels).
+        nbytes = getattr(x, "nbytes", None)
+        if nbytes is None:
+            nbytes = np.asarray(x).nbytes
+        _record_hier_levels(ht, int(step), float(nbytes), inner_edges,
+                            compression)
+    step_arr = jnp.asarray(step, dtype=jnp.int32)
+    return _dispatch_hier(("hierarchical_gossip", sig), fn, x, step_arr)
+
+
+def hierarchical_gossip(x, step: int, *, ht=None) -> jnp.ndarray:
+    return synchronize(hierarchical_gossip_nonblocking(x, step, ht=ht))
+
+
+def hierarchical_gossip_info() -> Optional[dict]:
+    """Summary of the active two-level gossip policy (None when
+    ``BLUEFOG_TPU_HIER`` is off or the mesh has a single slice): per-level
+    topologies, outer cadence/self-weight, outer codec, and the modeled
+    per-step wire bytes of each level at unit row bytes."""
+    from bluefog_tpu.utils import config
+    ctx = _require_init()
+    cfg = config.get()
+    n = len(ctx.devices)
+    if not cfg.hier or not ctx.local_size or ctx.local_size >= n:
+        return None
+    ht = _hier_topology(ctx, cfg)
+    comp = cfg.hier_outer_compression
+    outer_rows = (ht.dcn_edges_per_outer_step()
+                  * config.compression_byte_factor(comp)
+                  / max(ht.outer_every, 1))
+    return {
+        "levels": 2,
+        "n_slices": ht.n_slices,
+        "slice_size": ht.slice_size,
+        "inner": ht.inner_kind,
+        "outer": ht.outer_kind,
+        "outer_every": ht.outer_every,
+        "outer_self_weight": ht.outer_self_weight,
+        "outer_compression": comp,
+        "ici_rows_per_step": ht.ici_edges_per_step(),
+        "dcn_rows_per_step": round(outer_rows, 3),
+    }
 
 
 def pair_gossip_nonblocking(x, target_ranks: Union[Dict[int, int], List[int]],

@@ -44,6 +44,7 @@ __all__ = [
     "pair_gossip",
     "hierarchical_neighbor_allreduce",
     "dynamic_hierarchical_neighbor_allreduce",
+    "hierarchical_gossip",
     "schedule_wire_stats",
 ]
 
@@ -438,3 +439,103 @@ def dynamic_hierarchical_neighbor_allreduce(
                             idx=idx) for ph in sched.phases]
         return lax.switch(step % sched.period, branches, s)
     return _hierarchical(x, combine, local_axis)
+
+
+# ---------------------------------------------------------------------------
+# Two-level hierarchical gossip (dense ICI inner x sparse DCN outer)
+# ---------------------------------------------------------------------------
+
+def hierarchical_gossip(x: jnp.ndarray, step: jnp.ndarray,
+                        inner_sched: StaticSchedule,
+                        outer_scheds, *, local_axis: str,
+                        machine_axis: str, outer_every: int = 1,
+                        outer_compression: str = "none",
+                        outer_frac: float = None) -> jnp.ndarray:
+    """Two-level gossip step (``topology.HierarchicalTopology`` executor).
+
+    Every step runs the DENSE intra-slice neighbor combine over the local
+    (ICI) mesh axis; every ``outer_every``-th step additionally runs the
+    SPARSE one-peer exchange over the machine (DCN) axis — phase selected
+    by ``lax.switch``, so the whole period compiles into one program.
+
+    Per-level compression applies to the OUTER level only (the inner level
+    always ships dense over ICI):
+
+      ``bf16``          — the exchanged payload crosses DCN as bfloat16;
+          the local quantization residual ``y - q(y)`` is re-added after
+          the mix (difference compression — a rank's own f32 values are
+          never truncated by its own round trip).
+      ``sparse:<frac>`` — only a step-ROTATING aligned index block of
+          ``ceil(frac * size)`` coordinates crosses DCN; within the block
+          the exchange is exact dense gossip, off-block coordinates keep
+          their local values untouched, and the rotation sweeps every
+          coordinate each ``ceil(1/frac)`` outer steps (the block-
+          coordinate-gossip scheme of ``sparse_neighbor_allreduce`` —
+          aligned blocks, not per-rank magnitude picks, because the
+          latter provably stall).  The outer PHASE is held for a full
+          block sweep so every coordinate sees every shift distance
+          (``HierarchicalTopology.outer_phase_index``).
+
+    Cadence is a ``lax.cond`` on the traced step — one compiled program
+    serves outer and inner-only steps alike.
+    """
+    idx_l = _axis_index(local_axis)
+    y = _apply_rounds(x, inner_sched, local_axis, idx_l)
+    if not outer_scheds:
+        return y
+    step = jnp.asarray(step, jnp.int32)
+    dt = x.dtype
+    idx_m = _axis_index(machine_axis)
+    k = max(1, int(outer_every))
+    outer_step = step // k
+    nphases = len(outer_scheds)
+    sparse = isinstance(outer_compression, str) and \
+        outer_compression.startswith("sparse")
+
+    if sparse:
+        if outer_frac is None:
+            raise ValueError("sparse outer compression needs outer_frac")
+        size = int(np.prod(x.shape))
+        kk = max(1, int(np.ceil(outer_frac * size)))
+        nblocks = max(1, -(-size // kk))  # ceil(size / kk)
+        rot = (jnp.arange(kk, dtype=jnp.int32)
+               + (outer_step % nblocks) * kk) % size
+        phase_idx = (outer_step // nblocks) % nphases
+
+        def make_branch(ph: StaticSchedule):
+            if len(ph.rounds) != 1:
+                raise ValueError(
+                    "sparse outer compression expects one-round outer "
+                    f"phases (a pure slice shift), got {len(ph.rounds)}")
+            rnd = ph.rounds[0]
+
+            def br(y):
+                flat = y.reshape(-1)
+                vals = flat[rot]
+                sv = vals * _const(rnd.send_scale, dt)[idx_m]
+                rv = lax.ppermute(sv, machine_axis, rnd.pairs)
+                self_sc = _const(ph.self_scale, dt)[idx_m]
+                # On the block: theta*vals + recv; off-block: untouched.
+                return flat.at[rot].add(
+                    (self_sc - 1.0) * vals + rv).reshape(y.shape)
+            return br
+    else:
+        phase_idx = outer_step % nphases
+
+        def make_branch(ph: StaticSchedule):
+            def br(y):
+                if outer_compression == "bf16":
+                    q = y.astype(jnp.bfloat16)
+                    mixed = _apply_rounds(q, ph, machine_axis,
+                                          idx_m).astype(dt)
+                    return mixed + (y - q.astype(dt))
+                return _apply_rounds(y, ph, machine_axis, idx_m)
+            return br
+
+    branches = [make_branch(ph) for ph in outer_scheds]
+
+    def with_outer(y):
+        return lax.switch(phase_idx, branches, y)
+    if k == 1:
+        return with_outer(y)
+    return lax.cond(step % k == 0, with_outer, lambda y: y, y)

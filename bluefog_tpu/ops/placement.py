@@ -124,15 +124,22 @@ class TorusModel:
         return self.nodes_per_slice * self.n_slices
 
     @property
+    def first_dcn_link(self) -> int:
+        """First DCN link id — the ICI/DCN boundary of the link id space
+        (ids below are intra-torus, ids from here on are the per-slice-
+        pair DCN links).  The single source of truth consumers splitting
+        per-level costs must use, never a re-derived layout formula."""
+        return self.n_nodes * 2 * len(self.dims)
+
+    @property
     def n_links(self) -> int:
-        return (self.n_nodes * 2 * len(self.dims)
-                + self.n_slices * self.n_slices)
+        return self.first_dcn_link + self.n_slices * self.n_slices
 
     @cached_property
     def link_weights(self) -> np.ndarray:
         """(n_links,) per-crossing weight: 1.0 ICI, ``dcn_link_cost`` DCN."""
         w = np.ones(self.n_links)
-        w[self.n_nodes * 2 * len(self.dims):] = self.dcn_link_cost
+        w[self.first_dcn_link:] = self.dcn_link_cost
         return w
 
     # -- routing ------------------------------------------------------------
@@ -172,7 +179,7 @@ class TorusModel:
         sa, ca = self._coords(a)
         sb, cb = self._coords(b)
         if sa != sb:
-            ids = np.asarray([self.n_nodes * 2 * len(self.dims)
+            ids = np.asarray([self.first_dcn_link
                               + sa * self.n_slices + sb], np.int64)
             cache[(a, b)] = ids
             return ids

@@ -70,11 +70,21 @@ OP_MEMBER = 11
 # from payload size — so a future partial-row or batched payload can't be
 # silently misdecoded as compressed data.
 OP_BF16_FLAG = 0x40
+# Flag bit ORed into the op byte when the payload is a top-|magnitude|
+# sparse row (``win_compression=sparse:<frac>``): a self-describing
+# ``u32 k | i32 idx[k] | f32 val[k]`` stream (see sparse_encode) the
+# receiver scatters back into a zero row.  Explicit on the wire for the
+# same reason as OP_BF16_FLAG — never inferred from payload size.
+OP_SPARSE_FLAG = 0x20
+# Every wire-flag bit the base op code must be masked with before
+# comparing against the OP_* constants.
+OP_FLAG_MASK = OP_BF16_FLAG | OP_SPARSE_FLAG
 
 __all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
            "OP_GET_REPLY", "OP_FENCE_REQ", "OP_FENCE_ACK", "OP_MUTEX_ACQ",
            "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BATCH", "OP_MEMBER",
-           "OP_BF16_FLAG"]
+           "OP_BF16_FLAG", "OP_SPARSE_FLAG", "OP_FLAG_MASK",
+           "sparse_encode", "sparse_decode"]
 
 _OP_NAMES = {OP_PUT: "put", OP_ACCUMULATE: "accumulate",
              OP_GET_REQ: "get_req", OP_GET_REPLY: "get_reply",
@@ -95,8 +105,51 @@ _URGENT_OPS = frozenset((OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
 
 
 def _op_label(op: int) -> str:
-    """Telemetry label for a wire op code (compression flag stripped)."""
-    return _OP_NAMES.get(op & ~OP_BF16_FLAG, str(op))
+    """Telemetry label for a wire op code (compression flags stripped)."""
+    return _OP_NAMES.get(op & ~OP_FLAG_MASK, str(op))
+
+
+# ---------------------------------------------------------------------------
+# sparse:<frac> payload codec (OP_SPARSE_FLAG)
+# ---------------------------------------------------------------------------
+# Layout (little-endian): u32 k | k x i32 flat-index | k x f32 value.
+# Self-describing (k on the wire), so the decoder validates the byte count
+# exactly and a truncated or mis-flagged payload is an explicit error,
+# never a silently mis-scattered row.  Values ride as raw f32 bits — the
+# codec is bit-exact on what it sends; the loss lives entirely in the
+# sender's top-|magnitude| selection (whose complement the sender keeps as
+# an error-feedback residual, see ops/window.py).
+
+_SPARSE_HDR = struct.Struct("<I")
+
+
+def sparse_encode(values: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """Encode selected entries of a flat f32 row as one sparse payload."""
+    idx = np.ascontiguousarray(indices, dtype=np.int32)
+    val = np.ascontiguousarray(values, dtype=np.float32)
+    if idx.shape != val.shape or idx.ndim != 1:
+        raise ValueError("sparse_encode expects matching 1-D index/value "
+                         f"arrays, got {idx.shape} / {val.shape}")
+    blob = (_SPARSE_HDR.pack(len(idx)) + idx.tobytes() + val.tobytes())
+    return np.frombuffer(blob, np.uint8)
+
+
+def sparse_decode(payload) -> Tuple[np.ndarray, np.ndarray]:
+    """Decode one sparse payload back to ``(indices, values)`` — bit-exact
+    (the f32 bits round-trip untouched through any framing, OP_BATCH
+    included)."""
+    buf = payload if isinstance(payload, (bytes, bytearray, memoryview)) \
+        else memoryview(np.ascontiguousarray(payload, np.uint8)).cast("B")
+    (k,) = _SPARSE_HDR.unpack_from(buf, 0)
+    want = _SPARSE_HDR.size + k * 8
+    if len(buf) != want:
+        raise ValueError(
+            f"sparse payload of {len(buf)} bytes does not match header "
+            f"k={k} (expected {want})")
+    off = _SPARSE_HDR.size
+    idx = np.frombuffer(buf, np.int32, count=k, offset=off)
+    val = np.frombuffer(buf, np.float32, count=k, offset=off + k * 4)
+    return idx, val
 
 
 # ---------------------------------------------------------------------------
@@ -437,7 +490,7 @@ class WindowTransport:
         msg: Msg = (op, name, src, dst, float(weight), float(p_weight),
                     payload.tobytes())
         self._sender(host, port).enqueue(
-            msg, urgent=(op & ~OP_BF16_FLAG) in _URGENT_OPS)
+            msg, urgent=(op & ~OP_FLAG_MASK) in _URGENT_OPS)
 
     def kick(self) -> None:
         """Non-blocking flush request: wake every per-peer sender with a

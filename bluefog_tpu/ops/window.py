@@ -70,7 +70,8 @@ __all__ = [
 from bluefog_tpu.ops.transport import (  # noqa: E402
     OP_PUT, OP_ACCUMULATE, OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
     OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL, OP_MEMBER,
-    OP_BF16_FLAG)
+    OP_BF16_FLAG, OP_SPARSE_FLAG, OP_FLAG_MASK, sparse_encode,
+    sparse_decode)
 
 # Hard cap on waiting for a peer's reply.  Env-overridable so fault-injection
 # tests (and impatient deployments) can bound partition detection; the
@@ -253,6 +254,7 @@ def _free_all_windows() -> None:
             f.cancel()
         _store.handles.clear()
         _store.windows.clear()
+    _drop_ef_residuals()
 
 
 def _shutdown_transport() -> None:
@@ -443,16 +445,79 @@ def _probe_missing_ranks(timeout: float = 1.0) -> List[int]:
 
 _BF16 = np.dtype(jnp.bfloat16)
 
+# Sender-side error-feedback residuals of the sparse:<frac> codec, keyed by
+# (window name, src, dst) edge: the un-sent complement of every
+# sparsified row accumulates here and is folded into the NEXT send on the
+# same edge, so the time-summed wire traffic carries the full mass and
+# sparsification bias can never break consensus.  Guarded by its own lock
+# (window ops run on a worker pool).
+_ef_residuals: Dict[tuple, np.ndarray] = {}
+_ef_lock = threading.Lock()
+
+
+def _drop_ef_residuals(name: Optional[str] = None) -> None:
+    """Forget sender residuals (all windows, or one freed window's)."""
+    with _ef_lock:
+        if name is None:
+            _ef_residuals.clear()
+        else:
+            for k in [k for k in _ef_residuals if k[0] == name]:
+                _ef_residuals.pop(k, None)
+
+
+def _sparse_payload(name: str, src: int, dst: int,
+                    payload: np.ndarray, frac: float) -> np.ndarray:
+    """Top-|magnitude| sparsification with error feedback for one edge.
+
+    The residual from the previous send on this (name, src, dst) edge is
+    added before selection, the top ``ceil(frac * size)`` entries of the
+    corrected row ship (bit-exact f32 values), and the complement becomes
+    the new residual — classic EF-SGD compression applied at the wire."""
+    flat = payload.reshape(-1)
+    key = (name, src, dst)
+    with _ef_lock:
+        res = _ef_residuals.get(key)
+        v = flat + res if res is not None and res.shape == flat.shape \
+            else flat.copy()
+        k = max(1, int(np.ceil(frac * v.size)))
+        if k >= v.size:
+            idx = np.arange(v.size, dtype=np.int64)
+        else:
+            idx = np.argpartition(np.abs(v), v.size - k)[-k:]
+            idx.sort()
+        vals = v[idx]
+        residual = v
+        residual[idx] = 0.0  # in place: v is our copy
+        _ef_residuals[key] = residual
+    return sparse_encode(vals, idx)
+
 
 def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
                   weight: float, p_weight: float = 0.0,
                   payload: Optional[np.ndarray] = None) -> None:
     d = _store.distrib
     host, port = d.proc_addr[proc]
+    comp = config.get().win_compression
     if payload is None:
         payload = np.empty(0, np.uint8)
     elif (payload.size and payload.dtype == np.float32
-          and config.get().win_compression == "bf16"):
+          and comp.startswith("sparse")
+          and (op & ~OP_FLAG_MASK) == OP_ACCUMULATE):
+        # Ship only the top-|magnitude| fraction of the row; the un-sent
+        # complement stays in the sender's error-feedback residual and
+        # rides the next send on this edge.  ACCUMULATE edges only (the
+        # push-sum family): the receiver folds sparse contributions with
+        # ``+=``, so the time-summed staging mass equals the exact input
+        # mass.  PUT overwrites its staging slot — a scattered-into-zeros
+        # row would zero every unsent coordinate at the receiver and the
+        # residual would re-ship stale sums as a "current value", so puts
+        # (like GET replies and control ops) keep exact payloads.
+        payload = _sparse_payload(
+            name, src, dst, payload,
+            config.parse_sparse_frac(comp))
+        op |= OP_SPARSE_FLAG
+    elif (payload.size and payload.dtype == np.float32
+          and comp == "bf16"):
         # Halve the DCN bytes per gossip edge; the op byte carries an
         # explicit flag so the receiver never has to infer compression
         # from the payload size.
@@ -462,6 +527,10 @@ def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
     if telemetry.enabled():
         telemetry.inc("bf_win_proc_tx_bytes_total", float(payload.nbytes),
                       proc=proc)
+        # Cross-process window traffic IS the DCN level of the two-level
+        # wire accounting (intra-process gossip never leaves the host).
+        telemetry.inc("bf_comm_level_bytes_total", float(payload.nbytes),
+                      level="dcn")
     d.transport.send(host, port, op, name, src, dst, weight, payload,
                      p_weight)
 
@@ -517,13 +586,27 @@ def win_flush(wait: bool = True, timeout: Optional[float] = None) -> None:
 
 
 def _payload_row(win: _Window, payload, compressed: bool = False,
-                 copy: bool = True) -> np.ndarray:
+                 copy: bool = True, sparse: bool = False) -> np.ndarray:
     """Decode one wire payload (bytes or a zero-copy memoryview into the
     transport's recv buffer) to a window-shaped row.  ``copy=False`` skips
     the defensive copy — for callers that immediately fold the row into a
     fresh array (scale/accumulate) and never retain the view past the
     apply call."""
     expected = int(np.prod(win.shape)) * win.dtype.itemsize
+    if sparse:
+        # sparse:<frac> edge (OP_SPARSE_FLAG): scatter the shipped
+        # (index, value) pairs into a zero row — always a fresh array,
+        # never a view into the recv buffer.
+        idx, vals = sparse_decode(payload)
+        row = np.zeros(int(np.prod(win.shape)), dtype=win.dtype)
+        if idx.size:
+            if int(idx.max(initial=0)) >= row.size or \
+                    int(idx.min(initial=0)) < 0:
+                raise ValueError(
+                    f"window {win.name!r}: sparse payload indexes outside "
+                    f"the {row.size}-element row")
+            row[idx] = vals.astype(win.dtype)
+        return row.reshape(win.shape)
     if compressed:
         # bf16-compressed edge (sender had BLUEFOG_TPU_WIN_COMPRESSION=bf16),
         # declared by the OP_BF16_FLAG wire bit.
@@ -646,7 +729,7 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
     buffer (valid only for this call): every retaining path (parking)
     snapshots it to bytes; every applying path folds it into a fresh
     array before returning."""
-    if (op & ~OP_BF16_FLAG) == OP_MEMBER:
+    if (op & ~OP_FLAG_MASK) == OP_MEMBER:
         # Churn-controller control plane (ops/membership.py): decoded and
         # consumed immediately, never parked — a pre-init or post-shutdown
         # heartbeat is simply dropped (the sender re-heartbeats on its own
@@ -656,7 +739,8 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         return
     orig_op = op  # parked/replayed messages must keep the wire flag bits
     compressed = bool(op & OP_BF16_FLAG)
-    op &= ~OP_BF16_FLAG
+    sparse = bool(op & OP_SPARSE_FLAG)
+    op &= ~OP_FLAG_MASK
     d = _store.distrib
     if d is None:
         with _store.lock:
@@ -716,7 +800,8 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         with op_span(f"win_apply.{name}.{src}->{dst}", "COMMUNICATE"):
             # copy=False: the scale below materializes a fresh array; the
             # transient view is never retained.
-            row = _payload_row(win, payload, compressed, copy=False)
+            row = _payload_row(win, payload, compressed, copy=False,
+                               sparse=sparse)
             with win.lock:
                 if (dst, src) not in win.staging:
                     return
@@ -737,7 +822,8 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         with op_span(f"win_apply.{name}.{src}->{dst}", "COMMUNICATE"):
             # copy=False: the scale below materializes a fresh array; the
             # transient view is never retained.
-            row = _payload_row(win, payload, compressed, copy=False)
+            row = _payload_row(win, payload, compressed, copy=False,
+                               sparse=sparse)
             with win.lock:
                 if (dst, src) in win.staging:
                     win.staging[(dst, src)] = row * win.dtype.type(weight)
@@ -774,7 +860,7 @@ def _apply_inbound_batch(msgs) -> None:
     import logging
     i, n = 0, len(msgs)
     while i < n:
-        base_op = msgs[i][0] & ~OP_BF16_FLAG
+        base_op = msgs[i][0] & ~OP_FLAG_MASK
         if base_op not in (OP_PUT, OP_ACCUMULATE):
             try:
                 _apply_inbound(*msgs[i])
@@ -786,7 +872,7 @@ def _apply_inbound_batch(msgs) -> None:
         name = msgs[i][1]
         j = i + 1
         while (j < n and msgs[j][1] == name
-               and (msgs[j][0] & ~OP_BF16_FLAG) in (OP_PUT, OP_ACCUMULATE)):
+               and (msgs[j][0] & ~OP_FLAG_MASK) in (OP_PUT, OP_ACCUMULATE)):
             j += 1
         try:
             _apply_data_run(name, msgs[i:j])
@@ -821,9 +907,11 @@ def _apply_data_run(name: str, group) -> None:
     entries = []
     for (op, _n, src, dst, weight, p_weight, payload) in group:
         compressed = bool(op & OP_BF16_FLAG)
-        accumulate = (op & ~OP_BF16_FLAG) == OP_ACCUMULATE
+        sparse = bool(op & OP_SPARSE_FLAG)
+        accumulate = (op & ~OP_FLAG_MASK) == OP_ACCUMULATE
         try:
-            row = _payload_row(win, payload, compressed, copy=False)
+            row = _payload_row(win, payload, compressed, copy=False,
+                               sparse=sparse)
         except ValueError:
             # One malformed payload (shape/flag skew) loses only itself —
             # per-message isolation, as on the legacy drain path.
@@ -955,14 +1043,21 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
 
 
 def win_free(name: Optional[str] = None) -> bool:
-    with _store.lock:
-        if name is None:
-            _store.windows.clear()
-        elif name in _store.windows:
-            del _store.windows[name]
-        else:
-            return False
-    return True
+    try:
+        with _store.lock:
+            if name is None:
+                _store.windows.clear()
+            elif name in _store.windows:
+                del _store.windows[name]
+            else:
+                return False
+        return True
+    finally:
+        # A freed window's sender residuals must not leak into a later
+        # window recreated under the same name (possibly with a different
+        # shape) — purged even on the not-found path, so a residual can
+        # never outlive its name.
+        _drop_ef_residuals(name)
 
 
 def get_current_created_window_names() -> List[str]:

@@ -25,6 +25,7 @@ from bluefog_tpu.optim.optimizers import (  # noqa: F401
     DistributedAllreduceOptimizer,
     DistributedNeighborAllreduceOptimizer,
     DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedHierarchicalGossipOptimizer,
     DistributedAdaptWithCombineOptimizer,
     DistributedAdaptThenCombineOptimizer,
 )

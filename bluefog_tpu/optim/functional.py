@@ -50,10 +50,12 @@ __all__ = [
 
 
 class CommunicationType(enum.Enum):
-    """Parity: reference ``torch/optimizers.py:28-34``."""
+    """Parity: reference ``torch/optimizers.py:28-34`` (plus the TPU-only
+    two-level gossip of ``BLUEFOG_TPU_HIER``)."""
     allreduce = "allreduce"
     neighbor_allreduce = "neighbor.allreduce"
     hierarchical_neighbor_allreduce = "hierarchical.neighbor.allreduce"
+    hierarchical_gossip = "hierarchical.gossip"
     empty = "empty"
 
 
@@ -74,6 +76,7 @@ def make_combiner(
         dyn_sched: Optional[DynamicSchedule] = None,
         local_axis: Optional[str] = None,
         machine_axis: Optional[str] = None,
+        hier: Optional[dict] = None,
 ) -> Combiner:
     """Build the per-leaf ``combine`` function for a communication type.
 
@@ -129,6 +132,21 @@ def make_combiner(
         # same compiled edge schedule (compression="sparse:<frac>").
         _nbr._sparse_args = (sched, axis_name)
         return _nbr
+    if comm == CommunicationType.hierarchical_gossip:
+        assert local_axis and machine_axis, \
+            "hierarchical gossip needs local/machine axis names"
+        assert hier is not None, \
+            "hierarchical gossip needs the compiled level bundle (hier=)"
+
+        def _hgossip(x, step, weights=None):
+            _no_weights(weights, "hierarchical_gossip")
+            return C.hierarchical_gossip(
+                x, step, hier["inner_sched"], hier["outer_scheds"],
+                local_axis=local_axis, machine_axis=machine_axis,
+                outer_every=hier.get("outer_every", 1),
+                outer_compression=hier.get("outer_compression", "none"),
+                outer_frac=hier.get("outer_frac"))
+        return _hgossip
     if comm == CommunicationType.hierarchical_neighbor_allreduce:
         assert local_axis and machine_axis, \
             "hierarchical combine needs local/machine axis names"

@@ -55,6 +55,7 @@ __all__ = [
     "DistributedAllreduceOptimizer",
     "DistributedNeighborAllreduceOptimizer",
     "DistributedHierarchicalNeighborAllreduceOptimizer",
+    "DistributedHierarchicalGossipOptimizer",
     "DistributedAdaptWithCombineOptimizer",
     "DistributedAdaptThenCombineOptimizer",
 ]
@@ -141,6 +142,8 @@ class DistributedOptimizer:
                               else int(profile_every))
         self._jitted = {}
         self._steps_seen = 0  # host-side counter for telemetry sampling
+        self._hier_meta = None   # set by _hier_gossip_bundle
+        self._hier_step0 = None  # state.step of the first hier step seen
 
     # -- schedule resolution ------------------------------------------------
     def _schedules(self):
@@ -170,19 +173,24 @@ class DistributedOptimizer:
 
     def _build_step(self, with_weights: bool):
         ctx = basics._require_init()
-        hier = (self.communication_type ==
-                CommunicationType.hierarchical_neighbor_allreduce)
+        hier = (self.communication_type in (
+                CommunicationType.hierarchical_neighbor_allreduce,
+                CommunicationType.hierarchical_gossip))
         sched, dyn = (None, None)
         if self.communication_type in (
                 CommunicationType.neighbor_allreduce,
                 CommunicationType.hierarchical_neighbor_allreduce):
             sched, dyn = self._schedules()
+        hier_bundle = None
+        if self.communication_type == CommunicationType.hierarchical_gossip:
+            hier_bundle = self._hier_gossip_bundle(ctx)
         combine = F.make_combiner(
             self.communication_type,
             axis_name=RANK_AXIS if not hier else MACHINE_AXIS,
             sched=sched, dyn_sched=dyn,
             local_axis=LOCAL_AXIS if hier else None,
-            machine_axis=MACHINE_AXIS if hier else None)
+            machine_axis=MACHINE_AXIS if hier else None,
+            hier=hier_bundle)
         inner = F.step_fn(
             self.order, self.base, combine,
             axis_name=RANK_AXIS,
@@ -214,6 +222,34 @@ class DistributedOptimizer:
             out_specs=(spec, spec)),
             donate_argnums=(1, 2) if self.donate else ())
 
+    def _hier_gossip_bundle(self, ctx) -> dict:
+        """Compiled two-level bundle for the ``hierarchical_gossip``
+        communication type (BLUEFOG_TPU_HIER) — also stashes the modeled
+        per-level wire metadata ``step()`` feeds into
+        ``bf_comm_level_bytes_total``."""
+        from bluefog_tpu.utils import config
+        cfg = config.get()
+        if not cfg.hier:
+            raise RuntimeError(
+                "CommunicationType.hierarchical_gossip requires "
+                "BLUEFOG_TPU_HIER=1 (default off — the flat path stays "
+                "bit-identical without it)")
+        if ctx.local_size >= len(ctx.devices):
+            raise RuntimeError(
+                "hierarchical_gossip needs a multi-slice mesh: call "
+                "bf.init(local_size=<ranks per slice>) so "
+                "machine_size() > 1")
+        ht = basics._hier_topology(ctx, cfg)
+        (inner_sched, outer_scheds, inner_edges), _sig = \
+            basics._hier_bundle(ctx, ht, cfg)
+        comp = cfg.hier_outer_compression
+        frac = (config.parse_sparse_frac(comp)
+                if comp.startswith("sparse") else None)
+        self._hier_meta = (ht, inner_edges, comp, frac)
+        return {"inner_sched": inner_sched, "outer_scheds": outer_scheds,
+                "outer_every": ht.outer_every, "outer_compression": comp,
+                "outer_frac": frac}
+
     def _step_callable(self, with_weights: bool):
         ctx = basics._require_init()
         key = (ctx.topology_version, ctx.machine_topology_version,
@@ -226,8 +262,9 @@ class DistributedOptimizer:
     def init(self, params) -> DistOptState:
         """Build rank-major optimizer state for rank-major ``params``."""
         ctx = basics._require_init()
-        hier = (self.communication_type ==
-                CommunicationType.hierarchical_neighbor_allreduce)
+        hier = (self.communication_type in (
+                CommunicationType.hierarchical_neighbor_allreduce,
+                CommunicationType.hierarchical_gossip))
         mesh = ctx.hier_mesh if hier else ctx.mesh
         spec = P((MACHINE_AXIS, LOCAL_AXIS)) if hier else P(RANK_AXIS)
 
@@ -260,6 +297,27 @@ class DistributedOptimizer:
         else:
             out = basics._throttle(
                 fn(params, grads, state, jnp.asarray(w, jnp.float32)))
+        hier_meta = getattr(self, "_hier_meta", None)
+        if hier_meta is not None:
+            # Per-level wire accounting of the fused two-level step (the
+            # compiled program never crosses Python per level).  The step
+            # index must mirror the traced state.step the combiner's
+            # cadence cond reads — on a checkpoint resume that does NOT
+            # start at zero, so the base is read off the first step's
+            # state once (one host sync, first call only) and advanced
+            # host-side from there.
+            if self._hier_step0 is None:
+                # state.step is rank-major (one identical counter per
+                # rank row); any row is the value.
+                self._hier_step0 = int(
+                    np.asarray(state.step).reshape(-1)[0])
+            t = self._hier_step0 + self._steps_seen
+            if t % self.num_steps_per_communication == 0:
+                ht, inner_edges, comp, _frac = hier_meta
+                tree_bytes = float(sum(
+                    x.nbytes for x in jax.tree_util.tree_leaves(params)))
+                basics._record_hier_levels(ht, t, tree_bytes,
+                                           inner_edges, comp)
         self._steps_seen += 1
         # DISPATCH wall time (async — device work keeps running); the
         # synced profile below measures true step latency.
@@ -363,6 +421,20 @@ def DistributedHierarchicalNeighborAllreduceOptimizer(
         base, CommunicationType.hierarchical_neighbor_allreduce, order="awc",
         num_steps_per_communication=num_steps_per_communication,
         use_dynamic_topology=use_dynamic_topology, phases=phases, **kw)
+
+
+def DistributedHierarchicalGossipOptimizer(
+        base, *, num_steps_per_communication: int = 1,
+        order: str = "awc", **kw) -> DistributedOptimizer:
+    """Two-level hierarchical gossip (``BLUEFOG_TPU_HIER``): dense
+    intra-slice neighbor averaging over ICI every step, sparse one-peer
+    inter-slice exchange over DCN on its own cadence with its own
+    compression (``BLUEFOG_TPU_HIER_OUTER_*``) — the pod-scale
+    composition of ROADMAP item 2 (HiCCL line), fused into the jitted
+    step like every collective-family order."""
+    return DistributedOptimizer(
+        base, CommunicationType.hierarchical_gossip, order=order,
+        num_steps_per_communication=num_steps_per_communication, **kw)
 
 
 def DistributedAdaptWithCombineOptimizer(

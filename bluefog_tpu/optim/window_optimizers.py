@@ -26,6 +26,14 @@ instead of one per (leaf, edge).  This mirrors the collective family's
 buffer (``tensor_queue.h:70-92``); ``fuse=False`` keeps per-leaf windows (the
 reference's per-parameter layout, ``torch/optimizers.py:933-944``).
 
+Churn: with ``BLUEFOG_TPU_CHURN=1`` and a live gang transport, every
+``step()`` drives the churn supervisor (``run/supervisor.maybe_supervisor``)
+at the step boundary — failure detection, survivor re-planning and
+restart-free window rebuild happen before the step's own window ops; a
+committed membership change lands on ``opt.membership_change`` and an
+eviction of THIS rank raises so the training loop exits cleanly.  Off
+(default): one config check, the legacy path untouched.
+
 Multi-process semantics: each process is authoritative for the ranks of its
 local devices only.  ``step`` returns rank-major trees whose NON-owned rows
 are frozen at their value from the previous step's input — they are never
@@ -236,6 +244,52 @@ class _WindowOptimizerBase:
         new_params = jax.tree.map(lambda p, u: p + u, params, updates)
         return new_params, base_state
 
+    # Latest committed membership change observed by _maybe_churn_step
+    # (None until the gang churns); `evicted` mirrors the supervisor's
+    # verdict for THIS rank.
+    membership_change = None
+    evicted = False
+
+    def _maybe_churn_step(self, t: int) -> None:
+        """Drive the churn supervisor at this step boundary
+        (``BLUEFOG_TPU_CHURN=1`` + a live multi-process transport;
+        otherwise a no-op after one cheap config check).  The PR 7
+        follow-up: training loops no longer have to step the supervisor
+        manually — every window-family ``step()`` feeds it, so failure
+        detection, survivor re-planning and restart-free window rebuild
+        happen before this step's window ops run.  A committed change
+        lands in :attr:`membership_change`; if THIS rank was voted out,
+        :attr:`evicted` flips and a RuntimeError tells the loop to exit
+        (gossiping on as a ghost would wedge the survivors' fences).
+
+        Defers to a MANUALLY-constructed supervisor: when a live
+        controller exists that the process-wide singleton does not own
+        (chaos harness, custom loops calling ``ChurnSupervisor()``
+        directly), its owner is already stepping it — spawning a second
+        supervisor here would double-heartbeat and race recoveries."""
+        from bluefog_tpu.run import supervisor as sup_mod
+        from bluefog_tpu.utils import config as _config
+        if not _config.get().churn:
+            return
+        from bluefog_tpu.ops import membership
+        cur = membership.current()
+        if cur is not None and (sup_mod._singleton is None
+                                or sup_mod._singleton.ctrl is not cur):
+            return
+        sup = sup_mod.maybe_supervisor()
+        if sup is None:
+            return
+        view = sup.step(t)
+        if view is None:
+            return
+        self.membership_change = view
+        if view.evicted:
+            self.evicted = True
+            raise RuntimeError(
+                f"{type(self).__name__}.step: this rank was evicted by "
+                f"membership consensus (epoch {view.epoch}); exit the "
+                "training loop — the survivors have re-planned without it")
+
     @staticmethod
     def _step_timer():
         from bluefog_tpu.utils import telemetry
@@ -396,6 +450,7 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
     def step(self, params, grads, state: DistOptState, *,
              dst_weights=None, require_mutex: bool = True):
         t0 = self._step_timer()
+        self._maybe_churn_step(int(state.step))
         new_params, base_state = self._local_adapt(params, grads, state)
         t = int(state.step)
         if (t + 1) % self.num_steps_per_communication == 0:
@@ -457,6 +512,7 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
     def step(self, params, grads, state: DistOptState, *,
              src_weights=None, require_mutex: bool = True):
         t0 = self._step_timer()
+        self._maybe_churn_step(int(state.step))
         new_params, base_state = self._local_adapt(params, grads, state)
         t = int(state.step)
         if (t + 1) % self.num_steps_per_communication == 0:
@@ -531,6 +587,7 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
     def step(self, params, grads, state: DistOptState, *,
              dst_weights=None, require_mutex: bool = True):
         t0 = self._step_timer()
+        self._maybe_churn_step(int(state.step))
         new_params, base_state = self._local_adapt(params, grads, state)
         if dst_weights is None:
             dst_weights = self._outgoing_weights()

@@ -21,13 +21,17 @@ step the reference never had:
       trace's B/E span pairs.
 
   python -m bluefog_tpu.tools schedule-dump --topology exp2 --n 64 \
-          --torus 8x8 [--slices 2] [--sketch auto] [--rounds]
+          --torus 8x8 [--slices 2] [--sketch auto] [--rounds] \
+          [--hier [--hier-outer-every k] [--hier-compression c]]
       Inspect the compiled-schedule pipeline for a topology on a
       simulated torus: one row per pipeline stage (naive shift-distance,
       König repack, congestion repack, sketch synthesis) with provenance,
       round count and the modeled cost triple (max-link-load, hop-bytes,
       serial-link-time), plus the artifact metadata of the schedule the
-      selection would dispatch.  Pure host math — no accelerator, no
+      selection would dispatch.  ``--hier`` (needs ``--slices >= 2``)
+      appends the two-level hierarchical-gossip table: per-level rounds,
+      per-step wire rows and the ICI/DCN serial split under the given
+      outer cadence and codec.  Pure host math — no accelerator, no
       mesh, no bf.init() required.
 
   python -m bluefog_tpu.tools chaos [--np 4] [--kill-rank K] [--smoke]
@@ -247,7 +251,9 @@ def trace_summary(path: str) -> str:
 def schedule_dump(topology: str, n: int, torus: str, *, slices: int = 1,
                   degree: int = 4, seed: int = 0, sketch: str = "auto",
                   budget: float = 2.0, optimize_placement: bool = False,
-                  show_rounds: bool = False) -> str:
+                  show_rounds: bool = False, hier: bool = False,
+                  hier_outer_every: int = 1,
+                  hier_compression: str = "none") -> str:
     """Text report of the schedule pipeline for one topology x torus.
 
     The artifact refactor makes this nearly free: every stage returns a
@@ -336,7 +342,76 @@ def schedule_dump(topology: str, n: int, torus: str, *, slices: int = 1,
                          f"bottleneck {b:.1f}  "
                          f"{list(rnd.pairs)[:8]}"
                          + (" ..." if len(rnd.pairs) > 8 else ""))
+    if hier:
+        lines.append("")
+        lines.extend(_hier_dump_lines(
+            model, n, slices, hier_outer_every, hier_compression))
     return "\n".join(lines)
+
+
+def _hier_dump_lines(model, n: int, slices: int, outer_every: int,
+                     compression: str) -> List[str]:
+    """Two-level schedule/cost table for ``schedule-dump --hier``: one row
+    per level (plus one per outer phase) with round count, per-step wire
+    rows and the modeled (ICI serial, DCN serial) split — the BENCH-json
+    ``detail.hierarchy`` numbers in table form."""
+    import numpy as np
+
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu.utils import config as _config
+
+    if slices < 2:
+        raise SystemExit(
+            "schedule-dump --hier needs --slices >= 2 (a single slice "
+            "has no DCN level to split against)")
+    try:
+        factor = _config.compression_byte_factor(compression)
+    except ValueError as e:
+        raise SystemExit(f"schedule-dump --hier: {e}")
+    ht = topo.hierarchical_two_level(n, slices,
+                                     outer_every=max(outer_every, 1))
+    first_dcn = model.first_dcn_link
+
+    def split_serial(sched):
+        node = np.asarray(model.device_node, np.int64)
+        ici = dcn = 0.0
+        for rnd in sched.rounds:
+            loads = np.zeros(model.n_links)
+            for s, d in rnd.pairs:
+                np.add.at(loads, model.route(int(node[s]), int(node[d])),
+                          1.0)
+            ici += float(loads[:first_dcn].max(initial=0.0))
+            dcn += float((loads[first_dcn:] * model.dcn_link_cost)
+                         .max(initial=0.0))
+        return ici, dcn
+
+    inner_sched = S._build_schedule(ht.inner_full_matrix(), optimize=True)
+    rows = [("inner (ici, every step)", inner_sched, 1.0, 1.0)]
+    for p in range(len(ht.outer_phases)):
+        sched = S._build_schedule(ht.outer_full_matrix(p), optimize=True)
+        rows.append((f"outer phase {p} (dcn, every {ht.outer_every})",
+                     sched, factor, 1.0 / ht.outer_every))
+    out = [
+        f"hierarchy: {slices} slices of {ht.slice_size}, inner=exp2, "
+        f"outer=exp2 one-peer, outer_every={ht.outer_every}, "
+        f"outer compression={compression} (byte factor {factor}), "
+        f"outer self weight={ht.outer_self_weight}",
+        "",
+        f"{'level':<28} {'rounds':>6} {'rows/step':>10} "
+        f"{'ici_serial':>10} {'dcn_serial':>10}",
+    ]
+    out.append("-" * len(out[-1]))
+    for name, sched, byte_f, cadence_f in rows:
+        edges = sum(len(r.pairs) for r in sched.rounds)
+        ici, dcn = split_serial(sched)
+        out.append(
+            f"{name:<28} {len(sched.rounds):>6} "
+            f"{edges * byte_f * cadence_f:>10.1f} "
+            f"{ici * cadence_f:>10.1f} "
+            f"{dcn * byte_f * cadence_f:>10.1f}")
+    return out
 
 
 def main(argv=None) -> int:
@@ -395,13 +470,24 @@ def main(argv=None) -> int:
     pd.add_argument("--rounds", action="store_true",
                     help="also list the dispatched artifact's rounds with "
                          "per-round bottlenecks")
+    pd.add_argument("--hier", action="store_true",
+                    help="append the two-level hierarchical-gossip table: "
+                         "per-level rounds, per-step wire rows and the "
+                         "ICI/DCN serial-time split (needs --slices >= 2)")
+    pd.add_argument("--hier-outer-every", type=int, default=1,
+                    help="--hier: outer (DCN) cadence (default 1)")
+    pd.add_argument("--hier-compression", default="none",
+                    help="--hier: outer codec none / bf16 / sparse:<frac> "
+                         "(default none)")
     args = parser.parse_args(argv)
     if args.cmd == "schedule-dump":
         print(schedule_dump(
             args.topology, args.n, args.torus, slices=args.slices,
             degree=args.degree, seed=args.seed, sketch=args.sketch,
             budget=args.budget, optimize_placement=args.optimize_placement,
-            show_rounds=args.rounds))
+            show_rounds=args.rounds, hier=args.hier,
+            hier_outer_every=args.hier_outer_every,
+            hier_compression=args.hier_compression))
         return 0
     if args.cmd == "trace-merge":
         out = trace_merge(args.prefix, args.output)

@@ -285,7 +285,13 @@ def run_demo(args) -> int:
     results = {}
     for line in proc.stdout.splitlines():
         if line.startswith(_RESULT_TAG):
-            rec = json.loads(line[len(_RESULT_TAG):])
+            # bfrun multiplexes the gang's stdout; another process's line
+            # can land on the same physical line without a newline in
+            # between.  The record is one JSON object — parse exactly it
+            # and ignore any interleaved trailing bytes (observed flaky
+            # in CI as "Extra data" JSONDecodeError).
+            rec, _end = json.JSONDecoder().raw_decode(
+                line[len(_RESULT_TAG):])
             results[rec["rank"]] = rec
 
     failures = []

@@ -56,6 +56,8 @@ __all__ = [
     "DynamicPhase",
     "dynamic_phase_table",
     "one_peer_exp2_phases",
+    "HierarchicalTopology",
+    "hierarchical_two_level",
 ]
 
 
@@ -485,3 +487,205 @@ def GetInnerOuterExpo2DynamicSendRecvRanks(
             outer_dist_fn=lambda s: 2 ** (s % outer_n))
         yield [send], [recv]
         step += 1
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical two-level topology (dense ICI inner x sparse DCN outer)
+# ---------------------------------------------------------------------------
+#
+# TPU pods are two networks in one: cheap dense ICI within a slice, scarce
+# DCN links between slices (ops/placement.py models the gap as
+# ``dcn_link_cost`` ~ 4x an ICI hop).  The legacy inner/outer dynamic walks
+# above approximate the right decomposition but burn one designated rank per
+# machine per step on DCN; the HiCCL-style composition below instead runs
+# the FULL dense topology inside every slice every step and a one-peer
+# dynamic walk *between* slices on its own cadence, with its own
+# compression — the two levels priced and executed separately
+# (``basics.hierarchical_gossip``).
+
+
+@dataclass(frozen=True)
+class HierarchicalTopology:
+    """Two-level gossip topology artifact.
+
+    ``inner``        — dense intra-slice topology over ``slice_size`` local
+                       ranks (doubly-stochastic weight matrix; executed
+                       over the ICI / LOCAL mesh axis), applied identically
+                       inside every slice every step.
+    ``outer_phases`` — one-peer dynamic walk over ``n_slices`` slices: each
+                       phase is a full slice permutation (cyclic shift);
+                       rank ``(m, i)`` exchanges with rank ``(m', i)`` of
+                       the peer slice over the DCN level.
+    ``outer_every``  — cadence ``k``: the outer level communicates only on
+                       steps with ``step % k == 0``; other steps run the
+                       inner level alone.
+    ``outer_self_weight`` — per-OUTER-STEP self weight ``theta_k`` of the
+                       sparse exchange (``x' = theta_k*x + (1-theta_k)*
+                       x_peer`` per coordinate).  Built cadence-corrected
+                       by :func:`hierarchical_two_level`: the requested
+                       cadence-1 weight ``theta`` is raised to
+                       ``theta**k`` so one cadence-``k`` exchange carries
+                       the outer mixing mass of ``k`` cadence-1 exchanges.
+
+    Every per-step operator — inner-only or inner-then-outer — is doubly
+    stochastic (the inner matrix is doubly stochastic per slice and the
+    outer is a convex combination of the identity and a permutation), so
+    the ``k``-step effective operator is doubly stochastic too: cadence
+    changes staleness, never the preserved global mean.
+    """
+    n: int
+    n_slices: int
+    slice_size: int
+    inner: nx.DiGraph
+    outer_phases: Tuple[DynamicPhase, ...]
+    outer_every: int = 1
+    outer_self_weight: float = 0.5
+    inner_kind: str = "exp2"
+    outer_kind: str = "exp2"
+
+    # -- step policy --------------------------------------------------------
+
+    @property
+    def period(self) -> int:
+        """Full schedule period in training steps."""
+        return self.outer_every * max(len(self.outer_phases), 1)
+
+    def is_outer_step(self, step: int) -> bool:
+        return step % self.outer_every == 0
+
+    def outer_phase_index(self, step: int, sweep_len: int = 1) -> int:
+        """Phase of the outer walk at ``step`` (an outer step).
+
+        ``sweep_len > 1`` (sparse outer compression with ``sweep_len``
+        rotating index blocks) holds each phase for a full block sweep so
+        every coordinate sees every phase — otherwise a block count
+        sharing a factor with the phase count would pin some coordinates
+        to a single shift distance forever."""
+        outer_step = step // self.outer_every
+        return (outer_step // max(sweep_len, 1)) % max(
+            len(self.outer_phases), 1)
+
+    # -- weight matrices -----------------------------------------------------
+
+    def inner_weight_matrix(self) -> np.ndarray:
+        """(slice_size, slice_size) doubly-stochastic inner matrix."""
+        return weight_matrix(self.inner)
+
+    def inner_full_matrix(self) -> np.ndarray:
+        """(n, n) block-diagonal matrix applying ``inner`` in every slice."""
+        return np.kron(np.eye(self.n_slices), self.inner_weight_matrix())
+
+    def outer_slice_matrix(self, phase: int) -> np.ndarray:
+        """(n_slices, n_slices) matrix of one outer phase:
+        ``theta_k * I + (1 - theta_k) * P_shift`` — doubly stochastic for
+        any self weight (convex combination of permutations)."""
+        th = self.outer_self_weight
+        w = np.eye(self.n_slices) * th
+        for src, dst in self.outer_phases[phase].pairs:
+            w[src, dst] += 1.0 - th
+        return w
+
+    def outer_full_matrix(self, phase: int) -> np.ndarray:
+        """(n, n) outer matrix: the slice walk lifted to ranks (rank
+        ``(m, i)`` pairs with the SAME local index ``i`` of the peer
+        slice)."""
+        return np.kron(self.outer_slice_matrix(phase),
+                       np.eye(self.slice_size))
+
+    def effective_weight_matrix(self, step: int) -> np.ndarray:
+        """(n, n) effective operator of one step in the module-wide
+        ``W[src, dst]`` convention: inner first, then (on outer steps) the
+        outer exchange — ``x' = W_outer^T (W_inner^T x)``, i.e.
+        ``W_eff = W_inner @ W_outer``."""
+        w = self.inner_full_matrix()
+        if self.outer_phases and self.is_outer_step(step):
+            # A single-slice topology has no outer level: every step is
+            # the inner operator alone.
+            w = w @ self.outer_full_matrix(self.outer_phase_index(step))
+        return w
+
+    def product_topology(self, step: int = 0) -> nx.DiGraph:
+        """The flat single-level topology equivalent to one hierarchical
+        step — the equivalence-test oracle: executing the dense,
+        uncompressed, cadence-1 hierarchical mode must match flat
+        ``neighbor_allreduce`` over this graph to fp-reassociation
+        tolerance."""
+        return from_weight_matrix(self.effective_weight_matrix(step))
+
+    def dcn_edges_per_outer_step(self) -> int:
+        """Directed inter-slice edges of one outer step (each rank talks
+        to exactly one peer rank in another slice)."""
+        return self.n if self.n_slices > 1 else 0
+
+    def ici_edges_per_step(self) -> int:
+        """Directed intra-slice edges of one step: the inner topology's
+        off-diagonal edge count, replicated in every slice — the ONE
+        place the wire accounting (telemetry, BENCH json, schedule-dump)
+        derives the dense level's per-step rows from."""
+        w = self.inner_weight_matrix()
+        off = w.copy()
+        np.fill_diagonal(off, 0.0)
+        return int((off != 0).sum()) * self.n_slices
+
+
+def _outer_phase_table(n_slices: int, kind: str) -> Tuple[DynamicPhase, ...]:
+    if n_slices <= 1:
+        return ()
+    if kind == "ring":
+        return (DynamicPhase(tuple((m + 1) % n_slices
+                                   for m in range(n_slices))),)
+    if kind == "exp2":
+        return tuple(one_peer_exp2_phases(n_slices))
+    raise ValueError(
+        f"unknown outer walk {kind!r}; expected 'exp2' or 'ring'")
+
+
+def _inner_graph(slice_size: int, kind: str) -> nx.DiGraph:
+    if kind == "exp2":
+        return ExponentialTwoGraph(slice_size)
+    if kind == "ring":
+        return RingGraph(slice_size)
+    raise ValueError(
+        f"unknown inner topology {kind!r}; expected 'exp2' or 'ring'")
+
+
+def hierarchical_two_level(n: int, n_slices: int, *,
+                           inner: str = "exp2", outer: str = "exp2",
+                           outer_every: int = 1,
+                           outer_self_weight: float = 0.5,
+                           cadence_corrected: bool = True,
+                           ) -> HierarchicalTopology:
+    """Build the standard two-level topology: dense ``inner`` (exp2/ring)
+    inside each of ``n_slices`` equal slices, one-peer dynamic ``outer``
+    (exp2 shifts / ring) between slices every ``outer_every`` steps.
+
+    ``outer_self_weight`` is the CADENCE-1 per-exchange self weight
+    ``theta`` (default 0.5 — with exp2 shifts and 0.5/0.5 weights a full
+    outer sweep of ``log2(n_slices)`` exchanges is an EXACT inter-slice
+    average).  With ``cadence_corrected`` (default) the stored per-outer-
+    step weight is ``theta ** outer_every``: one cadence-``k`` exchange
+    then carries the outer mixing mass of ``k`` cadence-1 exchanges
+    (matching self-retention of the non-shared component per ``k``-step
+    window), instead of silently diluting the outer level by ``1/k``.
+    Any value keeps every operator doubly stochastic, so the ``k``-step
+    effective operator still averages — the correction tunes the rate,
+    never the preserved mean.
+    """
+    if n_slices < 1 or n % n_slices:
+        raise ValueError(
+            f"{n} ranks do not split into {n_slices} equal slices")
+    if outer_every < 1:
+        raise ValueError(f"outer_every must be >= 1, got {outer_every}")
+    if not 0.0 < outer_self_weight < 1.0:
+        raise ValueError("outer_self_weight must be in (0, 1), got "
+                         f"{outer_self_weight}")
+    slice_size = n // n_slices
+    theta = (outer_self_weight ** outer_every if cadence_corrected
+             else outer_self_weight)
+    return HierarchicalTopology(
+        n=n, n_slices=n_slices, slice_size=slice_size,
+        inner=_inner_graph(slice_size, inner),
+        outer_phases=_outer_phase_table(n_slices, outer),
+        outer_every=int(outer_every),
+        outer_self_weight=float(theta),
+        inner_kind=inner, outer_kind=outer)

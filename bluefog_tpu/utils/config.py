@@ -15,7 +15,7 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_STALL_WARNING_SEC | 60    | stall-detector threshold (0=off) |
 | BLUEFOG_TPU_WIN_PORT          | 0     | DCN window-service port (0=ephemeral) |
 | BLUEFOG_TPU_WIN_MAX_PENDING   | 4096  | inbound window-message queue bound |
-| BLUEFOG_TPU_WIN_COMPRESSION   | none  | bf16: halve cross-host window payloads |
+| BLUEFOG_TPU_WIN_COMPRESSION   | none  | bf16 (halve cross-host window payloads) or sparse:<frac> (top-|magnitude| + sender error feedback) |
 | BLUEFOG_TPU_WIN_COALESCE      | 1     | 0: legacy per-message transport sends |
 | BLUEFOG_TPU_WIN_COALESCE_LINGER_MS | 1.0 | sender-worker linger before flushing a partial batch |
 | BLUEFOG_TPU_WIN_COALESCE_BYTES | 1 MiB | queued bytes that force an immediate batch flush |
@@ -41,6 +41,12 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_FAKE_TORUS        | unset | synthetic torus spec (e.g. 4x8) for CPU testing |
 | BLUEFOG_TPU_TORUS_WRAP        | auto  | real-coords wrap policy: auto / 1 (torus) / 0 (mesh) |
 | BLUEFOG_TPU_FUSION_BUCKET_MB  | 0     | fusion-buffer bucket cap in MiB (0=one bucket) |
+| BLUEFOG_TPU_HIER              | 0     | 1: enable two-level hierarchical gossip (dense ICI inner x sparse DCN outer) |
+| BLUEFOG_TPU_HIER_OUTER_EVERY  | 1     | outer (inter-slice) cadence: communicate over DCN every k steps |
+| BLUEFOG_TPU_HIER_INNER        | exp2  | intra-slice dense topology: exp2 / ring |
+| BLUEFOG_TPU_HIER_OUTER        | exp2  | inter-slice one-peer walk: exp2 / ring |
+| BLUEFOG_TPU_HIER_OUTER_COMPRESSION | none | outer-level codec: none / bf16 / sparse:<frac> (inner stays dense) |
+| BLUEFOG_TPU_HIER_OUTER_SELF_WEIGHT | 0.5 | cadence-1 outer self weight (cadence-corrected to theta**k) |
 | BFTPU_COORDINATOR             | unset | set by bfrun: coordinator host:port |
 | BFTPU_NUM_PROCESSES           | unset | set by bfrun |
 | BFTPU_PROCESS_ID              | unset | set by bfrun |
@@ -61,16 +67,58 @@ import os
 from dataclasses import dataclass
 from typing import Optional
 
-__all__ = ["Config", "get", "reload"]
+__all__ = ["Config", "get", "reload", "COMPRESSION_VOCAB",
+           "parse_sparse_frac", "compression_byte_factor"]
 
 
-def _validated_compression(value: str) -> str:
-    if value not in ("none", "bf16"):
+# The one wire-compression vocabulary (window transport + hierarchical
+# outer level): error messages enumerate it dynamically so growing the
+# codec set can never leave a stale hardcoded list behind.
+COMPRESSION_VOCAB = ("none", "bf16", "sparse:<frac>")
+
+
+def parse_sparse_frac(value: str) -> float:
+    """Fraction of a ``sparse:<frac>`` codec spec, validated in (0, 1]."""
+    if ":" not in value:
         raise ValueError(
-            f"BLUEFOG_TPU_WIN_COMPRESSION={value!r} is not supported; "
-            "expected 'none' or 'bf16' (a typo here would otherwise "
-            "silently disable compression)")
-    return value
+            f"malformed {value!r}: use 'sparse:<frac>' (e.g. 'sparse:0.25')")
+    try:
+        frac = float(value.split(":", 1)[1])
+    except ValueError:
+        raise ValueError(
+            f"malformed {value!r}: the fraction must be a float in (0, 1], "
+            "e.g. 'sparse:0.25'") from None
+    if not 0.0 < frac <= 1.0:
+        raise ValueError(f"sparse fraction must be in (0, 1], got {frac}")
+    return frac
+
+
+def compression_byte_factor(value: str) -> float:
+    """Wire-bytes multiplier of a compression spec (the ONE accounting
+    rule telemetry, BENCH json and the schedule-dump table share):
+    ``none`` 1.0, ``bf16`` 0.5, ``sparse:<frac>`` the fraction."""
+    if value in (None, "none"):
+        return 1.0
+    if value == "bf16":
+        return 0.5
+    if isinstance(value, str) and value.startswith("sparse"):
+        return parse_sparse_frac(value)
+    raise ValueError(
+        f"unknown compression {value!r}; expected one of "
+        f"{', '.join(COMPRESSION_VOCAB)}")
+
+
+def _validated_compression(value: str, var: str =
+                           "BLUEFOG_TPU_WIN_COMPRESSION") -> str:
+    if value in ("none", "bf16"):
+        return value
+    if value.startswith("sparse"):
+        parse_sparse_frac(value)  # raises on a malformed fraction
+        return value
+    raise ValueError(
+        f"{var}={value!r} is not supported; expected one of "
+        f"{', '.join(COMPRESSION_VOCAB)} (a typo here would otherwise "
+        "silently disable compression)")
 
 
 def _validated_sketch(value: str) -> str:
@@ -172,6 +220,23 @@ class Config:
     # (optim/functional.py); 0 = one fused buffer (legacy behavior).  An
     # explicit fusion_buckets= argument on the optimizer overrides this.
     fusion_bucket_mb: float
+    # Two-level hierarchical gossip (topology.HierarchicalTopology +
+    # basics.hierarchical_gossip); OFF by default — with hier=0 no
+    # hierarchical state exists anywhere and every flat path is
+    # bit-identical to the pre-hier tree.
+    hier: bool
+    # Outer (inter-slice DCN) cadence: communicate between slices every k
+    # steps; intermediate steps run the dense intra-slice level alone.
+    hier_outer_every: int
+    # Per-level topology kinds ("exp2" or "ring").
+    hier_inner: str
+    hier_outer: str
+    # Outer-level wire codec (none / bf16 / sparse:<frac>); the inner ICI
+    # level always stays dense.
+    hier_outer_compression: str
+    # Cadence-1 outer self weight theta; the builder cadence-corrects it
+    # to theta**k (see topology.hierarchical_two_level).
+    hier_outer_self_weight: float
     # Whether the consensus period was explicitly configured: samplers
     # that COST communication (the collective optimizer family) stay off
     # unless the operator asked; free samplers use the default period.
@@ -238,6 +303,19 @@ class Config:
             torus_wrap=os.environ.get("BLUEFOG_TPU_TORUS_WRAP", "auto"),
             fusion_bucket_mb=float(
                 os.environ.get("BLUEFOG_TPU_FUSION_BUCKET_MB", "0")),
+            hier=_flag("BLUEFOG_TPU_HIER"),
+            hier_outer_every=int(os.environ.get(
+                "BLUEFOG_TPU_HIER_OUTER_EVERY", "1")),
+            hier_inner=os.environ.get(
+                "BLUEFOG_TPU_HIER_INNER", "exp2").lower(),
+            hier_outer=os.environ.get(
+                "BLUEFOG_TPU_HIER_OUTER", "exp2").lower(),
+            hier_outer_compression=_validated_compression(
+                os.environ.get("BLUEFOG_TPU_HIER_OUTER_COMPRESSION",
+                               "none").lower(),
+                var="BLUEFOG_TPU_HIER_OUTER_COMPRESSION"),
+            hier_outer_self_weight=float(os.environ.get(
+                "BLUEFOG_TPU_HIER_OUTER_SELF_WEIGHT", "0.5")),
             profile=_flag("BLUEFOG_TPU_PROFILE"),
             profile_every=int(
                 os.environ.get("BLUEFOG_TPU_PROFILE_EVERY", "50")),

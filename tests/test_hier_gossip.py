@@ -1,0 +1,673 @@
+"""Hierarchical two-level gossip (BLUEFOG_TPU_HIER): the
+``topology.HierarchicalTopology`` artifact, the ``collective.
+hierarchical_gossip`` executor (dense ICI inner x sparse DCN outer,
+cadence, per-level compression), the ``sparse:<frac>`` window wire codec
+with sender-side error feedback, the per-level telemetry, and the
+satellite coverage — legacy inner/outer generator structure, the churn
+supervisor driven from window-optimizer ``step()``, and the dynamically
+enumerated compression vocabulary.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as topo
+from bluefog_tpu.utils import config
+
+N = 8  # virtual mesh size (conftest)
+
+_KNOBS = ("BLUEFOG_TPU_HIER", "BLUEFOG_TPU_HIER_OUTER_EVERY",
+          "BLUEFOG_TPU_HIER_INNER", "BLUEFOG_TPU_HIER_OUTER",
+          "BLUEFOG_TPU_HIER_OUTER_COMPRESSION",
+          "BLUEFOG_TPU_HIER_OUTER_SELF_WEIGHT",
+          "BLUEFOG_TPU_WIN_COMPRESSION", "BLUEFOG_TPU_FAKE_TORUS",
+          "BLUEFOG_TPU_PLACEMENT", "BLUEFOG_TPU_CHURN")
+
+
+@pytest.fixture(autouse=True)
+def _restore_env():
+    saved = {k: os.environ.get(k) for k in _KNOBS}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    config.reload()
+
+
+def _env(**kw):
+    for k in _KNOBS:
+        os.environ.pop(k, None)
+    os.environ.update(kw)
+    config.reload()
+
+
+def _rank_major(seed=0, shape=(N, 6)):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# HierarchicalTopology artifact
+# ---------------------------------------------------------------------------
+
+def _assert_doubly_stochastic(w):
+    np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-12)
+    np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+
+
+@pytest.mark.parametrize("n,slices,k,theta", [
+    (8, 2, 1, 0.5), (8, 2, 2, 0.5), (16, 4, 3, 0.7), (12, 3, 2, 0.4)])
+def test_effective_matrices_doubly_stochastic(n, slices, k, theta):
+    ht = topo.hierarchical_two_level(n, slices, outer_every=k,
+                                     outer_self_weight=theta)
+    for step in range(ht.period * 2):
+        _assert_doubly_stochastic(ht.effective_weight_matrix(step))
+
+
+def test_cadence_corrected_self_weight():
+    theta = 0.5
+    for k in (1, 2, 3):
+        ht = topo.hierarchical_two_level(8, 2, outer_every=k,
+                                         outer_self_weight=theta)
+        assert ht.outer_self_weight == pytest.approx(theta ** k)
+    raw = topo.hierarchical_two_level(8, 2, outer_every=3,
+                                      outer_self_weight=theta,
+                                      cadence_corrected=False)
+    assert raw.outer_self_weight == theta
+
+
+def test_cadence_and_phase_policy():
+    ht = topo.hierarchical_two_level(16, 4, outer_every=2)
+    assert len(ht.outer_phases) == 2  # exp2 over 4 slices: shifts 1, 2
+    assert ht.period == 4
+    assert [ht.is_outer_step(s) for s in range(4)] == [
+        True, False, True, False]
+    # Default: phase advances once per outer step.
+    assert [ht.outer_phase_index(s) for s in (0, 2, 4, 6)] == [0, 1, 0, 1]
+    # Sparse sweep-hold: the phase is pinned for sweep_len outer steps.
+    assert [ht.outer_phase_index(s, sweep_len=2)
+            for s in (0, 2, 4, 6)] == [0, 0, 1, 1]
+
+
+def test_inner_only_steps_have_no_dcn_edges():
+    ht = topo.hierarchical_two_level(8, 2, outer_every=3)
+    slice_of = np.arange(8) // 4
+    for step in range(6):
+        w = ht.effective_weight_matrix(step)
+        srcs, dsts = np.nonzero(w)
+        crossing = [(s, d) for s, d in zip(srcs, dsts)
+                    if slice_of[s] != slice_of[d]]
+        if ht.is_outer_step(step):
+            assert crossing
+        else:
+            assert not crossing
+
+
+def test_outer_sweep_is_exact_interslice_average():
+    """With 0.5/0.5 weights a full one-peer exp2 sweep over the slices is
+    an exact inter-slice average — the property the default self weight
+    is chosen for."""
+    ht = topo.hierarchical_two_level(16, 4, outer_self_weight=0.5)
+    prod = np.eye(16)
+    for p in range(len(ht.outer_phases)):
+        prod = prod @ ht.outer_full_matrix(p)
+    # After the sweep every rank holds the average of its local index
+    # across all 4 slices.
+    expect = np.kron(np.full((4, 4), 0.25), np.eye(4))
+    np.testing.assert_allclose(prod, expect, atol=1e-12)
+
+
+def test_builder_validation():
+    with pytest.raises(ValueError, match="equal slices"):
+        topo.hierarchical_two_level(8, 3)
+    with pytest.raises(ValueError, match="outer_every"):
+        topo.hierarchical_two_level(8, 2, outer_every=0)
+    with pytest.raises(ValueError, match="outer_self_weight"):
+        topo.hierarchical_two_level(8, 2, outer_self_weight=1.0)
+    with pytest.raises(ValueError, match="inner topology"):
+        topo.hierarchical_two_level(8, 2, inner="mesh")
+    with pytest.raises(ValueError, match="outer walk"):
+        topo.hierarchical_two_level(8, 2, outer="star")
+
+
+def test_product_topology_roundtrip():
+    ht = topo.hierarchical_two_level(8, 2, inner="ring")
+    g = ht.product_topology(0)
+    np.testing.assert_allclose(topo.weight_matrix(g),
+                               ht.effective_weight_matrix(0))
+
+
+# ---------------------------------------------------------------------------
+# Executor: dense / cadence / compression vs the matrix oracle
+# ---------------------------------------------------------------------------
+
+def _sim_step(ht, x, step, frac=None):
+    """Numpy oracle of one hierarchical step (sparse = block-restricted
+    outer exchange, matching the compiled executor)."""
+    y = ht.inner_full_matrix().T @ x
+    if ht.is_outer_step(step):
+        outer_step = step // ht.outer_every
+        if frac is None:
+            wo = ht.outer_full_matrix(ht.outer_phase_index(step))
+            y = wo.T @ y
+        else:
+            size = x.shape[1]
+            kk = max(1, int(np.ceil(frac * size)))
+            nblocks = -(-size // kk)
+            rot = (np.arange(kk) + (outer_step % nblocks) * kk) % size
+            wo = ht.outer_full_matrix(
+                ht.outer_phase_index(step, sweep_len=nblocks))
+            y[:, rot] = wo.T @ y[:, rot]
+    return y
+
+
+def test_dense_cadence1_matches_flat_product():
+    """Acceptance: dense/uncompressed/cadence-1 hierarchical gossip is
+    equivalent to flat neighbor averaging over the two-level product
+    topology <= 1e-6."""
+    _env(BLUEFOG_TPU_HIER="1")
+    bf.init(lambda: topo.ExponentialGraph(N), local_size=4)
+    ht = topo.hierarchical_two_level(N, 2)
+    x = _rank_major(1)
+    for step in range(3):
+        out = np.asarray(bf.hierarchical_gossip(x, step))
+        flat = np.asarray(bf.neighbor_allreduce(
+            x, src_weights=ht.effective_weight_matrix(step)))
+        assert np.abs(out - flat).max() <= 1e-6
+
+
+def test_cadence_and_phase_switch_executor():
+    _env(BLUEFOG_TPU_HIER="1", BLUEFOG_TPU_HIER_OUTER_EVERY="2")
+    bf.init(lambda: topo.ExponentialGraph(N), local_size=2)  # 4 slices
+    from bluefog_tpu import basics
+    ht = basics._hier_topology(basics._ctx)
+    assert ht.outer_every == 2 and ht.n_slices == 4
+    x = _rank_major(2).astype(np.float64).astype(np.float32)
+    X = x.copy()
+    for step in range(6):
+        out = np.asarray(bf.hierarchical_gossip(X, step))
+        expect = _sim_step(ht, X.astype(np.float64), step)
+        assert np.abs(out - expect).max() <= 1e-5
+        X = out
+
+
+def test_sparse_outer_executor_matches_oracle():
+    _env(BLUEFOG_TPU_HIER="1", BLUEFOG_TPU_HIER_OUTER_EVERY="2",
+         BLUEFOG_TPU_HIER_OUTER_COMPRESSION="sparse:0.5")
+    bf.init(lambda: topo.ExponentialGraph(N), local_size=4)
+    from bluefog_tpu import basics
+    ht = basics._hier_topology(basics._ctx)
+    x = _rank_major(3)
+    X = x.copy()
+    for step in range(8):
+        out = np.asarray(bf.hierarchical_gossip(X, step))
+        expect = _sim_step(ht, X.astype(np.float64), step, frac=0.5)
+        assert np.abs(out - expect).max() <= 1e-5
+        X = out
+
+
+def test_bf16_outer_residual():
+    """bf16 outer compression: close to the dense result at bf16
+    tolerance, and inner-only steps are NOT quantized at all (the codec
+    is per-level)."""
+    _env(BLUEFOG_TPU_HIER="1", BLUEFOG_TPU_HIER_OUTER_EVERY="2",
+         BLUEFOG_TPU_HIER_OUTER_COMPRESSION="bf16")
+    bf.init(lambda: topo.ExponentialGraph(N), local_size=4)
+    from bluefog_tpu import basics
+    ht = basics._hier_topology(basics._ctx)
+    x = _rank_major(4)
+    out0 = np.asarray(bf.hierarchical_gossip(x, 0))   # outer step
+    dense0 = _sim_step(ht, x.astype(np.float64), 0)
+    assert np.abs(out0 - dense0).max() <= 2e-2  # bf16-scale error only
+    out1 = np.asarray(bf.hierarchical_gossip(x, 1))   # inner-only step
+    dense1 = _sim_step(ht, x.astype(np.float64), 1)
+    assert np.abs(out1 - dense1).max() <= 1e-6   # untouched by the codec
+
+
+def test_hier_off_is_bit_identical_and_gated():
+    """BLUEFOG_TPU_HIER=0: the hierarchical entry point refuses, and the
+    flat path is bit-identical whether the knob is 0, unset or 1."""
+    x = _rank_major(5)
+    _env()
+    bf.init(lambda: topo.ExponentialGraph(N), local_size=4)
+    out_unset = np.asarray(bf.neighbor_allreduce(x))
+    with pytest.raises(RuntimeError, match="BLUEFOG_TPU_HIER"):
+        bf.hierarchical_gossip(x, 0)
+    assert bf.hierarchical_gossip_info() is None
+    bf.shutdown()
+    for knob in ("0", "1"):
+        _env(BLUEFOG_TPU_HIER=knob)
+        bf.init(lambda: topo.ExponentialGraph(N), local_size=4)
+        assert np.array_equal(np.asarray(bf.neighbor_allreduce(x)),
+                              out_unset)
+        bf.shutdown()
+
+
+def test_hier_needs_multislice_mesh():
+    _env(BLUEFOG_TPU_HIER="1")
+    bf.init(lambda: topo.ExponentialGraph(N))  # local_size == n: 1 slice
+    with pytest.raises(RuntimeError, match="multi-slice"):
+        bf.hierarchical_gossip(_rank_major(6), 0)
+
+
+def test_per_level_telemetry():
+    from bluefog_tpu.utils import telemetry
+    _env(BLUEFOG_TPU_HIER="1", BLUEFOG_TPU_HIER_OUTER_EVERY="2",
+         BLUEFOG_TPU_HIER_OUTER_COMPRESSION="sparse:0.25")
+    telemetry.reset()
+    bf.init(lambda: topo.ExponentialGraph(N), local_size=4)
+    x = _rank_major(7)
+    for step in range(4):  # steps 0, 2 are outer
+        bf.hierarchical_gossip(x, step)
+    snap = bf.telemetry_snapshot()
+    ici = snap['bf_comm_level_bytes_total{level="ici"}']
+    dcn = snap['bf_comm_level_bytes_total{level="dcn"}']
+    assert snap["bf_hier_outer_steps_total"] == 2.0
+    row_bytes = x.nbytes / N
+    # inner exp2(4): 2 off-diag offsets -> 8 directed edges per slice pair
+    # of slices => 16 rows per step, 4 steps.
+    assert ici == pytest.approx(row_bytes * 16 * 4)
+    # outer: 8 ranks x 0.25 sparse, on 2 of 4 steps.
+    assert dcn == pytest.approx(row_bytes * 8 * 0.25 * 2)
+    # And the series are visible on /metrics.
+    rendered = telemetry.render_prometheus()
+    assert "bf_comm_level_bytes_total" in rendered
+    assert "bf_hier_outer_steps_total" in rendered
+
+
+def test_placement_prices_hier_levels():
+    """With a fake multi-slice torus + HIER on, set_topology's placement
+    search prices the two levels too (and the executor still matches the
+    oracle under the installed placement)."""
+    _env(BLUEFOG_TPU_HIER="1", BLUEFOG_TPU_FAKE_TORUS="2x4")
+    bf.init(lambda: topo.ExponentialGraph(N), local_size=4)
+    assert bf.placement_info() is not None
+    ht = topo.hierarchical_two_level(N, 2)
+    x = _rank_major(8)
+    out = np.asarray(bf.hierarchical_gossip(x, 0))
+    expect = ht.effective_weight_matrix(0).T @ x.astype(np.float64)
+    assert np.abs(out - expect).max() <= 1e-5
+
+
+# ---------------------------------------------------------------------------
+# Optimizer families
+# ---------------------------------------------------------------------------
+
+def test_hier_gossip_optimizer_awc():
+    import optax
+    _env(BLUEFOG_TPU_HIER="1")
+    bf.init(lambda: topo.ExponentialGraph(N), local_size=4)
+    ht = topo.hierarchical_two_level(N, 2)
+    opt = bf.optim.DistributedHierarchicalGossipOptimizer(optax.sgd(0.1))
+    params = {"w": _rank_major(9)}
+    grads = {"w": _rank_major(10) * 0.1}
+    state = opt.init(params)
+    new_params, _ = opt.step(params, grads, state)
+    expect = (ht.effective_weight_matrix(0).T
+              @ params["w"].astype(np.float64)) - 0.1 * grads["w"]
+    assert np.abs(np.asarray(new_params["w"]) - expect).max() <= 1e-5
+    # Per-level accounting flowed through the optimizer step too.
+    snap = bf.telemetry_snapshot()
+    assert snap.get("bf_hier_outer_steps_total", 0) >= 1.0
+
+
+def test_hier_gossip_optimizer_requires_knob():
+    import optax
+    _env()
+    bf.init(lambda: topo.ExponentialGraph(N), local_size=4)
+    opt = bf.optim.DistributedHierarchicalGossipOptimizer(optax.sgd(0.1))
+    params = {"w": _rank_major(11)}
+    with pytest.raises(RuntimeError, match="BLUEFOG_TPU_HIER"):
+        opt.step(params, params, opt.init(params))
+
+
+def test_window_optimizer_drives_churn_supervisor(monkeypatch):
+    """Satellite (PR 7 follow-up): every window-family step() feeds the
+    churn supervisor — no manual supervisor.step() in the training loop."""
+    import optax
+
+    from bluefog_tpu.run import supervisor as sup_mod
+
+    class _View:
+        epoch = 3
+        evicted = False
+
+    class _Sup:
+        def __init__(self):
+            self.steps = []
+
+        def step(self, t):
+            self.steps.append(t)
+            return _View() if t == 1 else None
+
+    stub = _Sup()
+    monkeypatch.setattr(sup_mod, "maybe_supervisor", lambda: stub)
+    _env(BLUEFOG_TPU_CHURN="1")
+    bf.init(lambda: topo.ExponentialGraph(N))
+    opt = bf.optim.DistributedWinPutOptimizer(optax.sgd(0.05))
+    params = {"w": _rank_major(12)}
+    state = opt.init(params)
+    for _ in range(3):
+        params, state = opt.step(params, {"w": _rank_major(13)}, state)
+    assert stub.steps == [0, 1, 2]
+    assert opt.membership_change is not None
+    assert opt.membership_change.epoch == 3
+    assert not opt.evicted
+    opt.free()
+
+
+def test_window_optimizer_eviction_raises(monkeypatch):
+    import optax
+
+    from bluefog_tpu.run import supervisor as sup_mod
+
+    class _View:
+        epoch = 5
+        evicted = True
+
+    class _Sup:
+        def step(self, t):
+            return _View()
+
+    monkeypatch.setattr(sup_mod, "maybe_supervisor", lambda: _Sup())
+    _env(BLUEFOG_TPU_CHURN="1")
+    bf.init(lambda: topo.ExponentialGraph(N))
+    opt = bf.optim.DistributedPushSumOptimizer(optax.sgd(0.05))
+    params = {"w": _rank_major(14)}
+    state = opt.init(params)
+    with pytest.raises(RuntimeError, match="evicted"):
+        opt.step(params, {"w": _rank_major(15)}, state)
+    assert opt.evicted
+    opt.free()
+
+
+def test_window_optimizer_no_churn_no_supervisor():
+    """Default (churn off): maybe_supervisor is a cheap no-op — no
+    supervisor singleton is ever constructed by the optimizer path."""
+    import optax
+
+    from bluefog_tpu.run import supervisor as sup_mod
+    _env()
+    bf.init(lambda: topo.ExponentialGraph(N))
+    opt = bf.optim.DistributedWinPutOptimizer(optax.sgd(0.05))
+    params = {"w": _rank_major(16)}
+    state = opt.init(params)
+    params, state = opt.step(params, {"w": _rank_major(17)}, state)
+    assert sup_mod._singleton is None
+    assert opt.membership_change is None
+    opt.free()
+
+
+# ---------------------------------------------------------------------------
+# sparse:<frac> wire codec (window transport)
+# ---------------------------------------------------------------------------
+
+def test_sparse_codec_roundtrip_bit_exact():
+    from bluefog_tpu.ops import transport as T
+    rng = np.random.default_rng(0)
+    row = rng.standard_normal(33).astype(np.float32)
+    idx = np.sort(np.argsort(-np.abs(row))[:9]).astype(np.int32)
+    payload = T.sparse_encode(row[idx], idx)
+    d_idx, d_val = T.sparse_decode(payload)
+    assert np.array_equal(d_idx, idx)
+    assert np.array_equal(d_val.view(np.int32), row[idx].view(np.int32))
+
+
+def test_sparse_codec_through_op_batch_framing():
+    """Acceptance: sparse:<frac> round-trips BIT-exact through the
+    OP_BATCH container framing."""
+    from bluefog_tpu.ops import transport as T
+    rng = np.random.default_rng(1)
+    rows = [rng.standard_normal(16).astype(np.float32) for _ in range(3)]
+    msgs = []
+    for i, row in enumerate(rows):
+        idx = np.sort(np.argsort(-np.abs(row))[:4]).astype(np.int32)
+        msgs.append((T.OP_ACCUMULATE | T.OP_SPARSE_FLAG, f"w{i}", 0, 1,
+                     0.5, 0.0, T.sparse_encode(row[idx], idx).tobytes()))
+    decoded = T._decode_batch(T._encode_batch(msgs))
+    assert len(decoded) == len(msgs)
+    for (op, name, _s, _d, _w, _p, payload), orig in zip(decoded, msgs):
+        assert op & T.OP_SPARSE_FLAG
+        assert bytes(payload) == orig[6]
+        T.sparse_decode(payload)  # decodes cleanly from the framed view
+
+
+def test_sparse_codec_rejects_malformed():
+    from bluefog_tpu.ops import transport as T
+    payload = T.sparse_encode(np.ones(3, np.float32),
+                              np.arange(3, dtype=np.int32))
+    with pytest.raises(ValueError, match="does not match header"):
+        T.sparse_decode(payload.tobytes() + b"\0")
+    with pytest.raises(ValueError, match="matching 1-D"):
+        T.sparse_encode(np.ones((2, 2), np.float32),
+                        np.arange(4, dtype=np.int32))
+
+
+def test_payload_row_sparse_scatter_and_bounds():
+    from bluefog_tpu.ops import transport as T
+    from bluefog_tpu.ops import window as W
+
+    class _Win:
+        name = "w"
+        shape = (6,)
+        dtype = np.dtype(np.float32)
+
+    vals = np.asarray([1.5, -2.0], np.float32)
+    idx = np.asarray([1, 4], np.int32)
+    row = W._payload_row(_Win(), bytes(T.sparse_encode(vals, idx)),
+                         sparse=True)
+    np.testing.assert_array_equal(
+        row, np.asarray([0, 1.5, 0, 0, -2.0, 0], np.float32))
+    bad = T.sparse_encode(vals, np.asarray([1, 6], np.int32))
+    with pytest.raises(ValueError, match="outside"):
+        W._payload_row(_Win(), bytes(bad), sparse=True)
+
+
+def test_sender_error_feedback_conserves_mass():
+    """The EF residual: across consecutive sends on one edge, decoded
+    wire mass + the live residual equals the exact input mass — the
+    invariant that keeps sparsification bias from breaking consensus."""
+    from bluefog_tpu.ops import transport as T
+    from bluefog_tpu.ops import window as W
+    W._drop_ef_residuals()
+    rng = np.random.default_rng(2)
+    total_in = np.zeros(16, np.float64)
+    total_sent = np.zeros(16, np.float64)
+    try:
+        for _ in range(5):
+            row = rng.standard_normal(16).astype(np.float32)
+            total_in += row
+            payload = W._sparse_payload("wef", 0, 1, row, 0.25)
+            idx, vals = T.sparse_decode(payload)
+            assert idx.size == 4  # ceil(0.25 * 16)
+            total_sent[idx] += vals
+        with W._ef_lock:
+            residual = W._ef_residuals[("wef", 0, 1)].astype(np.float64)
+        np.testing.assert_allclose(total_sent + residual, total_in,
+                                   atol=1e-5)
+    finally:
+        W._drop_ef_residuals()
+    assert ("wef", 0, 1) not in W._ef_residuals
+
+
+def test_sparse_codec_applies_to_accumulate_only(monkeypatch):
+    """The wire codec sparsifies ACCUMULATE edges only: a PUT overwrites
+    its staging slot, where a scattered-into-zeros row would zero every
+    unsent coordinate — puts (and GET replies) must ship exact."""
+    from bluefog_tpu.ops import transport as T
+    from bluefog_tpu.ops import window as W
+
+    sent = []
+
+    class _StubTransport:
+        def send(self, host, port, op, name, src, dst, weight, payload,
+                 p_weight=0.0):
+            sent.append((op, np.asarray(payload).copy()))
+
+    class _StubDistrib:
+        transport = _StubTransport()
+        proc_addr = {0: ("h", 1), 1: ("h", 2)}
+        rank_owner = {0: 0, 1: 1}
+        my_proc = 0
+
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_COMPRESSION", "sparse:0.25")
+    config.reload()
+    monkeypatch.setattr(W._store, "distrib", _StubDistrib())
+    W._drop_ef_residuals()
+    try:
+        row = np.arange(16, dtype=np.float32)
+        W._send_to_proc(1, T.OP_ACCUMULATE, "w", 0, 1, 1.0, 0.0,
+                        payload=row.view(np.uint8).reshape(-1)
+                        .view(np.float32))
+        W._send_to_proc(1, T.OP_PUT, "w", 0, 1, 1.0, 0.0,
+                        payload=row.copy())
+        W._send_to_proc(1, T.OP_GET_REPLY, "w", 0, 1, 1.0, 0.0,
+                        payload=row.copy())
+        (op_acc, p_acc), (op_put, p_put), (op_get, p_get) = sent
+        assert op_acc & T.OP_SPARSE_FLAG
+        idx, vals = T.sparse_decode(p_acc)
+        assert idx.size == 4  # ceil(0.25 * 16)
+        assert not op_put & T.OP_SPARSE_FLAG
+        assert not op_get & T.OP_SPARSE_FLAG
+        np.testing.assert_array_equal(
+            p_put.view(np.float32), row)  # exact dense put
+    finally:
+        W._drop_ef_residuals()
+    monkeypatch.delenv("BLUEFOG_TPU_WIN_COMPRESSION")
+    config.reload()
+
+
+def test_single_slice_artifact_is_inner_only():
+    """The degenerate n_slices=1 artifact has no outer level: every step
+    is the inner operator alone (no IndexError on the empty phase
+    table)."""
+    ht = topo.hierarchical_two_level(8, 1)
+    assert ht.outer_phases == ()
+    assert ht.dcn_edges_per_outer_step() == 0
+    for step in range(3):
+        np.testing.assert_allclose(ht.effective_weight_matrix(step),
+                                   ht.inner_full_matrix())
+
+
+def test_ef_residual_dropped_on_win_free():
+    from bluefog_tpu.ops import window as W
+    W._drop_ef_residuals()
+    with W._ef_lock:
+        W._ef_residuals[("a", 0, 1)] = np.zeros(4, np.float32)
+        W._ef_residuals[("b", 0, 1)] = np.zeros(4, np.float32)
+    W.win_free("a")   # no such window: False, but residuals still purged
+    assert ("a", 0, 1) not in W._ef_residuals
+    assert ("b", 0, 1) in W._ef_residuals
+    W._free_all_windows()
+    assert not W._ef_residuals
+
+
+# ---------------------------------------------------------------------------
+# Config vocabulary (satellite: dynamic enumeration)
+# ---------------------------------------------------------------------------
+
+def test_compression_vocabulary_accepts_sparse(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_COMPRESSION", "sparse:0.25")
+    config.reload()
+    assert config.get().win_compression == "sparse:0.25"
+    assert config.parse_sparse_frac("sparse:0.25") == 0.25
+    monkeypatch.delenv("BLUEFOG_TPU_WIN_COMPRESSION")
+    config.reload()
+
+
+def test_compression_error_enumerates_vocabulary(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_COMPRESSION", "fp16")
+    with pytest.raises(ValueError) as e:
+        config.reload()
+    for word in config.COMPRESSION_VOCAB:
+        assert word in str(e.value)
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_COMPRESSION", "sparse:2.0")
+    with pytest.raises(ValueError, match=r"\(0, 1\]"):
+        config.reload()
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_COMPRESSION", "sparse:x")
+    with pytest.raises(ValueError, match="float"):
+        config.reload()
+    monkeypatch.delenv("BLUEFOG_TPU_WIN_COMPRESSION")
+    config.reload()
+
+
+def test_hier_outer_compression_validated(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_HIER_OUTER_COMPRESSION", "lz4")
+    with pytest.raises(ValueError, match="HIER_OUTER_COMPRESSION"):
+        config.reload()
+    monkeypatch.delenv("BLUEFOG_TPU_HIER_OUTER_COMPRESSION")
+    config.reload()
+
+
+# ---------------------------------------------------------------------------
+# Legacy inner/outer dynamic generators (satellite: structural coverage)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("gen_name,world,local", [
+    ("GetInnerOuterRingDynamicSendRecvRanks", 12, 4),
+    ("GetInnerOuterRingDynamicSendRecvRanks", 16, 4),
+    ("GetInnerOuterExpo2DynamicSendRecvRanks", 24, 6),
+    ("GetInnerOuterExpo2DynamicSendRecvRanks", 32, 8),
+])
+def test_inner_outer_walk_structure(gen_name, world, local):
+    """Structure the consistency tests don't pin down: exactly one local
+    rank per machine (``step % local``) crosses machines each step — to
+    the SAME local slot of another machine — while every other rank walks
+    strictly inside its machine and never targets the outgoing rank."""
+    gen = getattr(topo, gen_name)
+    walkers = [gen(world, local, r) for r in range(world)]
+    machines = world // local
+    for step in range(2 * local):
+        outgoing_local = step % local
+        sends = [next(w)[0][0] for w in walkers]
+        for r, s in enumerate(sends):
+            m, i = divmod(r, local)
+            sm, si = divmod(s, local)
+            if i == outgoing_local:
+                # The designated rank hops machines, same local slot.
+                assert sm != m and si == i
+            else:
+                # Everyone else stays home and detours around the
+                # outgoing rank.
+                assert sm == m and si != outgoing_local and s != r
+
+
+def test_inner_outer_ring_inner_distance():
+    """Ring inner walk: the stay-home ranks advance by exactly one local
+    position (after skipping over the outgoing slot)."""
+    world, local = 12, 4
+    walkers = [topo.GetInnerOuterRingDynamicSendRecvRanks(world, local, r)
+               for r in range(world)]
+    for step in range(local):
+        outgoing_local = step % local
+        sends = [next(w)[0][0] for w in walkers]
+        for r, s in enumerate(sends):
+            m, i = divmod(r, local)
+            if i == outgoing_local:
+                continue
+            fwd = 1
+            if fwd >= (outgoing_local - i) % local:
+                fwd += 1
+            assert s == m * local + (i + fwd) % local
+
+
+def test_inner_outer_expo2_outer_distances_cycle():
+    """The outgoing rank's machine hop follows the Exp2 distance ladder
+    2**(step % ceil(log2(machines-1)))."""
+    world, local = 32, 4  # 8 machines
+    machines = world // local
+    outer_n = int(np.log2(machines - 1)) + 1
+    walkers = [topo.GetInnerOuterExpo2DynamicSendRecvRanks(world, local, r)
+               for r in range(world)]
+    for step in range(2 * outer_n * local):
+        sends = [next(w)[0][0] for w in walkers]
+        outgoing_local = step % local
+        d = 2 ** (step % outer_n)
+        for m in range(machines):
+            r = m * local + outgoing_local
+            expect = ((m + d) % machines) * local + outgoing_local
+            assert sends[r] == expect
