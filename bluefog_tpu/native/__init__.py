@@ -5,6 +5,13 @@ attempting a one-time build when a toolchain is available.  Everything has a
 pure-Python fallback, so ``lib() is None`` is always a supported state — the
 native layer is a performance/production feature (host-side schedule
 compilation, timeline writer, DCN window transport), not a correctness one.
+
+Staleness: a library older than any ``src/*.cc``/``*.h`` is rebuilt in place
+before loading; when no toolchain is available the stale build is still
+loaded (with a warning) but :func:`is_stale` reports it, and the window
+transport's native fast path (``BLUEFOG_TPU_WIN_NATIVE``) auto-falls back to
+the Python hot loop — old compiled code is never silently driven by new
+Python expecting new symbols or struct layouts.
 """
 
 from __future__ import annotations
@@ -13,13 +20,15 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import List, Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_HERE, "src")
 _LIB_PATH = os.path.join(_HERE, "libbluefog_tpu_native.so")
 
 _lib = None
 _tried = False
+_stale = False
 _lock = threading.Lock()
 
 
@@ -32,6 +41,62 @@ class WinMsg(ctypes.Structure):
         ("p_weight", ctypes.c_double),
         ("name", ctypes.c_char * 128),
         ("payload_len", ctypes.c_uint64),
+    ]
+
+
+class WinItem(ctypes.Structure):
+    """Mirror of ``bf_win_item_t``: one ordered drain item — a raw message
+    (kind 0) or a folded commit entry (kind 1)."""
+    _fields_ = [
+        ("kind", ctypes.c_uint8),
+        ("op", ctypes.c_uint8),
+        ("replace", ctypes.c_uint8),
+        ("frame", ctypes.c_uint8),
+        ("src", ctypes.c_int32),
+        ("dst", ctypes.c_int32),
+        ("puts", ctypes.c_int32),
+        ("accs", ctypes.c_int32),
+        ("weight", ctypes.c_double),
+        ("p_weight", ctypes.c_double),
+        ("off", ctypes.c_uint64),
+        ("len", ctypes.c_uint64),
+        ("wire_bytes", ctypes.c_uint64),
+        ("name", ctypes.c_char * 128),
+    ]
+
+
+class WinRxStats(ctypes.Structure):
+    """Mirror of ``bf_winrx_stats_t`` (cumulative native-drain counters)."""
+    _fields_ = [
+        ("batch_frames", ctypes.c_uint64),
+        ("msgs", ctypes.c_uint64),
+        ("folded_msgs", ctypes.c_uint64),
+        ("commits", ctypes.c_uint64),
+        ("bytes", ctypes.c_uint64),
+        ("by_op", ctypes.c_uint64 * 16),
+        ("batch_size_hist", ctypes.c_uint64 * 25),
+        ("batch_size_sum", ctypes.c_double),
+    ]
+
+
+class WinTxStats(ctypes.Structure):
+    """Mirror of ``bf_wintx_stats_t`` (cumulative native-sender counters)."""
+    _fields_ = [
+        ("msgs_enq", ctypes.c_uint64),
+        ("msgs_done", ctypes.c_uint64),
+        ("frames", ctypes.c_uint64),
+        ("batches", ctypes.c_uint64),
+        ("batched_msgs", ctypes.c_uint64),
+        ("bytes", ctypes.c_uint64),
+        ("errors", ctypes.c_uint64),
+        ("retries", ctypes.c_uint64),
+        ("dropped_msgs", ctypes.c_uint64),
+        ("queue_len", ctypes.c_uint64),
+        ("by_op", ctypes.c_uint64 * 16),
+        ("batch_size_hist", ctypes.c_uint64 * 25),
+        ("send_sec_hist", ctypes.c_uint64 * 25),
+        ("batch_size_sum", ctypes.c_double),
+        ("send_sec_sum", ctypes.c_double),
     ]
 
 
@@ -69,33 +134,148 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         dbl, dbl, ptr(ctypes.c_uint8), u64]
     lib.bf_winsvc_stop.restype = None
     lib.bf_winsvc_stop.argtypes = [ctypes.c_void_p]
+
+    # Window-transport native hot path (this PR's symbols).  An older .so
+    # — stale build without a toolchain to refresh it — simply lacks them;
+    # bind what exists and let has_win_native() report the capability.
+    try:
+        lib.bf_winsvc_win_set.restype = i32
+        lib.bf_winsvc_win_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          i64]
+        lib.bf_winsvc_drain.restype = i32
+        lib.bf_winsvc_drain.argtypes = [
+            ctypes.c_void_p, ptr(WinItem), i32, ptr(ctypes.c_uint8), u64,
+            ptr(ctypes.c_float), u64, i32, i32]
+        lib.bf_winsvc_rx_stats.restype = None
+        lib.bf_winsvc_rx_stats.argtypes = [ctypes.c_void_p, ptr(WinRxStats)]
+
+        lib.bf_wintx_start.restype = ctypes.c_void_p
+        lib.bf_wintx_start.argtypes = [u64, u64, i32, i32, dbl]
+        lib.bf_wintx_send.restype = i32
+        # payload rides as c_char_p: the producer fast path passes BYTES
+        # (ndarray.tobytes()), and bytes->char* is ctypes' cheapest
+        # pointer conversion by a wide margin.
+        lib.bf_wintx_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, i32, ctypes.c_uint8,
+            ctypes.c_char_p, i32, i32, dbl, dbl, ctypes.c_char_p, u64, i32]
+        lib.bf_wintx_flush.restype = i32
+        lib.bf_wintx_flush.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       i32, dbl]
+        lib.bf_wintx_err_count.restype = i64
+        lib.bf_wintx_err_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           i32]
+        lib.bf_wintx_kick.restype = None
+        lib.bf_wintx_kick.argtypes = [ctypes.c_void_p]
+        lib.bf_wintx_drop_peer.restype = i64
+        lib.bf_wintx_drop_peer.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           i32]
+        lib.bf_wintx_set_partition.restype = None
+        lib.bf_wintx_set_partition.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p]
+        lib.bf_wintx_stats.restype = None
+        lib.bf_wintx_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       i32, ptr(WinTxStats)]
+        lib.bf_wintx_stop.restype = None
+        lib.bf_wintx_stop.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        pass
     return lib
 
 
+def _fastcall_artifact() -> Optional[str]:
+    """Path of the built ``_bf_fastcall`` extension module, if any."""
+    try:
+        for fn in os.listdir(_HERE):
+            if fn.startswith("_bf_fastcall") and fn.endswith(".so"):
+                return os.path.join(_HERE, fn)
+    except OSError:
+        pass
+    return None
+
+
+def _stale_sources(lib_path: str = _LIB_PATH,
+                   src_dir: str = _SRC_DIR) -> List[str]:
+    """Source files newer than their built artifact (empty list = fresh).
+
+    Pure mtime comparison over ``src/*.cc`` / ``src/*.h`` — the same
+    staleness rule the Makefile's dependency graph encodes, applied at
+    LOAD time so an edited native source can never be silently shadowed
+    by an old compiled artifact.  ``fastcall.cc`` is judged against the
+    ``_bf_fastcall`` module (its artifact); on hosts without Python.h the
+    module legitimately does not exist and fastcall.cc is ignored."""
+    try:
+        lib_mtime = os.path.getmtime(lib_path)
+    except OSError:
+        return []
+    fast = _fastcall_artifact() if src_dir == _SRC_DIR else None
+    try:
+        fast_mtime = os.path.getmtime(fast) if fast else None
+    except OSError:
+        fast_mtime = None
+    out = []
+    try:
+        entries = sorted(os.listdir(src_dir))
+    except OSError:
+        return []
+    for fn in entries:
+        if not (fn.endswith(".cc") or fn.endswith(".h")):
+            continue
+        ref = lib_mtime
+        if fn == "fastcall.cc":
+            if fast_mtime is None:
+                continue
+            ref = fast_mtime
+        try:
+            if os.path.getmtime(os.path.join(src_dir, fn)) > ref:
+                out.append(fn)
+        except OSError:
+            continue
+    return out
+
+
 def build(force: bool = False) -> bool:
-    """Compile the native library in place; returns success."""
-    if os.path.exists(_LIB_PATH) and not force:
+    """Compile the native library in place; returns success.  Without
+    ``force`` the Makefile's own dependency graph decides what (if
+    anything) recompiles, so calling this on a fresh tree is a no-op."""
+    if os.path.exists(_LIB_PATH) and not force and not _stale_sources():
         return True
     try:
         subprocess.run(["make", "-C", _HERE, "-s"] + (["-B"] if force else []),
                        check=True, capture_output=True, timeout=120)
-        return os.path.exists(_LIB_PATH)
+        return os.path.exists(_LIB_PATH) and not _stale_sources()
     except (subprocess.SubprocessError, FileNotFoundError):
         return False
 
 
 def lib(auto_build: bool = True) -> Optional[ctypes.CDLL]:
-    """The loaded native library, or None when unavailable."""
-    global _lib, _tried
+    """The loaded native library, or None when unavailable.
+
+    A stale library (any ``src/*.cc``/``.h`` newer than the ``.so``) is
+    rebuilt before loading; if the rebuild fails (no toolchain) the stale
+    build is loaded with a warning and :func:`is_stale` flips — consumers
+    with layout-sensitive fast paths (the window transport) check it and
+    fall back to their Python implementations."""
+    global _lib, _tried, _stale
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
+        allow_build = (auto_build and
+                       os.environ.get("BLUEFOG_TPU_NO_NATIVE") != "1")
         if not os.path.exists(_LIB_PATH):
-            if not (auto_build and
-                    os.environ.get("BLUEFOG_TPU_NO_NATIVE") != "1" and
-                    build()):
+            if not (allow_build and build()):
                 return None
+        else:
+            stale = _stale_sources()  # one scan: condition AND warning
+            if stale and not (allow_build and build()):
+                _stale = True
+                import logging
+                logging.getLogger("bluefog_tpu").warning(
+                    "native core is STALE (%s newer than the built "
+                    "library) and could not be rebuilt — loading the old "
+                    "build; the window transport's native fast path is "
+                    "disabled (Python fallback).  Run `make -C "
+                    "bluefog_tpu/native` to refresh.", ", ".join(stale))
         try:
             _lib = _bind(ctypes.CDLL(_LIB_PATH))
         except OSError:
@@ -105,3 +285,49 @@ def lib(auto_build: bool = True) -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return lib() is not None
+
+
+def is_stale() -> bool:
+    """True when the loaded library is older than its sources and could
+    not be rebuilt (fast paths must not trust its symbols/layouts)."""
+    lib()
+    return _stale
+
+
+def has_win_native() -> bool:
+    """True when the loaded library carries the window-transport native
+    hot path (``bf_wintx_*`` / ``bf_winsvc_drain``) and is not stale."""
+    handle = lib()
+    return (handle is not None and not _stale
+            and hasattr(handle, "bf_wintx_start")
+            and hasattr(handle, "bf_winsvc_drain"))
+
+
+_FASTCALL_ABI = 1
+_fastcall = None
+_fastcall_tried = False
+
+
+def fastcall():
+    """The optional ``_bf_fastcall`` METH_FASTCALL module (hot-path send
+    binding), or None — missing module, stale core, or an ABI-version
+    mismatch all fall back to the ctypes bindings, never misparse."""
+    global _fastcall, _fastcall_tried
+    if _fastcall_tried:
+        return _fastcall
+    _fastcall_tried = True
+    if not has_win_native():
+        return None
+    try:
+        from bluefog_tpu.native import _bf_fastcall  # type: ignore
+    except ImportError:
+        return None
+    if getattr(_bf_fastcall, "ABI_VERSION", None) != _FASTCALL_ABI:
+        import logging
+        logging.getLogger("bluefog_tpu").warning(
+            "_bf_fastcall ABI %s != expected %s (stale build?) — using the "
+            "ctypes bindings", getattr(_bf_fastcall, "ABI_VERSION", None),
+            _FASTCALL_ABI)
+        return None
+    _fastcall = _bf_fastcall
+    return _fastcall

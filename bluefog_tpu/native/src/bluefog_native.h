@@ -94,6 +94,160 @@ int32_t bf_winsvc_send(const char* host, int32_t port, uint8_t op,
 
 void bf_winsvc_stop(bf_winsvc_t* s);
 
+/* -------- native receive/drain fast path (BLUEFOG_TPU_WIN_NATIVE) -------
+ *
+ * The host framework registers each f32 window's flat element count; the
+ * drain call then decodes queued OP_BATCH frames in C++ (dense f32, bf16
+ * and sparse payload codecs), groups runs of consecutive put/accumulate
+ * sub-messages per window, folds consecutive same-slot contributions
+ * (matching ops/window._apply_data_run: a put starts a fresh entry, an
+ * accumulate folds into the immediately-previous entry of the same
+ * (dst, src) slot) and hands back an ORDERED item list: folded commit
+ * entries interleaved with raw messages (control ops, unregistered or
+ * non-f32 windows, undecodable payloads) in exact stream order — the
+ * FIFO property win_fence and the distributed mutex rely on. */
+
+typedef struct {
+  uint8_t kind;        /* 0 = raw message, 1 = folded commit entry */
+  uint8_t op;          /* raw: wire op byte, compression flags intact */
+  uint8_t replace;     /* commit: 1 iff the run's first contribution was
+                        * a PUT (slot overwrite, then accumulates fold) */
+  uint8_t frame;       /* nonzero: ordinal (1..255, cycling) of the decoded
+                        * OP_BATCH frame this item came from — consecutive
+                        * items sharing it belong to one frame, so a host
+                        * consumer can reconstruct per-frame delivery.
+                        * 0: singleton or fallback whole-frame item. */
+  int32_t src;
+  int32_t dst;
+  int32_t puts;        /* commit: PUT messages folded in (0 or 1) */
+  int32_t accs;        /* commit: ACCUMULATE messages folded in */
+  double weight;       /* raw only (commit values are pre-scaled) */
+  double p_weight;     /* raw: p_weight; commit: folded associated-P mass */
+  uint64_t off;        /* raw: byte offset into raw_buf; commit: ELEMENT
+                        * offset into val_buf */
+  uint64_t len;        /* raw: payload bytes; commit: element count */
+  uint64_t wire_bytes; /* commit: summed wire payload bytes (telemetry) */
+  char name[128];
+} bf_win_item_t;
+
+/* Cumulative counters of the native drain path (monotonic; snapshot and
+ * diff on the host side).  Histogram buckets use the telemetry module's
+ * shared log-spaced boundary table (1e-6 .. 5e1, 24 boundaries + overflow),
+ * so bucket counts merge into the registry by elementwise addition. */
+typedef struct {
+  uint64_t batch_frames;   /* OP_BATCH frames fully decoded natively */
+  uint64_t msgs;           /* sub-messages in those frames */
+  uint64_t folded_msgs;    /* data sub-messages folded into commits */
+  uint64_t commits;        /* commit entries emitted */
+  uint64_t bytes;          /* frame payload bytes of decoded batches */
+  uint64_t by_op[16];      /* sub-message counts by base op code */
+  uint64_t batch_size_hist[25];
+  double batch_size_sum;
+} bf_winrx_stats_t;
+
+/* Register (elems > 0) or unregister (elems <= 0) a window for the native
+ * fold path: a flat f32 row of `elems` elements.  Unregistered windows'
+ * messages pass through as raw items.  Returns 0, -4 if the name exceeds
+ * the 128-byte field. */
+int32_t bf_winsvc_win_set(bf_winsvc_t* s, const char* name, int64_t elems);
+
+/* Pop up to max_frames queued inbound frames, decode + fold, and fill the
+ * caller's buffers.  Returns the number of items written (>0), 0 when the
+ * queue is empty, or a grow request with nothing consumed: -1 raw_buf too
+ * small, -2 val_buf too small, -3 items array too small (the offending
+ * frame stays queued).  With wait_ms > 0 and an empty queue, blocks up to
+ * that long for the first frame (the caller's GIL is released across the
+ * call, so the drain thread sleeps in C instead of polling).  Fold runs
+ * never span frames, so the result is bit-identical to the Python batched
+ * apply on the same frames. */
+int32_t bf_winsvc_drain(bf_winsvc_t* s, bf_win_item_t* items,
+                        int32_t max_items, uint8_t* raw_buf, uint64_t raw_cap,
+                        float* val_buf, uint64_t val_cap, int32_t max_frames,
+                        int32_t wait_ms);
+
+void bf_winsvc_rx_stats(bf_winsvc_t* s, bf_winrx_stats_t* out);
+
+/* -------- native transmit path: per-peer coalescing send queues --------
+ *
+ * The C++ twin of ops/transport._PeerSender: one bounded queue + one
+ * worker thread per peer, flushing as a single OP_BATCH frame (or a plain
+ * legacy frame for a singleton) on a byte threshold, a linger timeout, an
+ * urgent op, or an explicit flush — one sendmsg per frame, no Python
+ * thread and no GIL anywhere on the per-message path. */
+
+typedef struct bf_wintx bf_wintx_t;
+
+/* Cumulative per-peer counters (aggregate with host=NULL includes retired
+ * peers so totals stay monotonic across drop_peer/recreate cycles). */
+typedef struct {
+  uint64_t msgs_enq;       /* messages accepted by bf_wintx_send */
+  uint64_t msgs_done;      /* handed to TCP, failed, or dropped */
+  uint64_t frames;         /* frames successfully handed to TCP */
+  uint64_t batches;        /* frames carrying > 1 message */
+  uint64_t batched_msgs;   /* messages in such frames */
+  uint64_t bytes;          /* payload bytes enqueued */
+  uint64_t errors;         /* failed frame sends (batches dropped) */
+  uint64_t retries;        /* transient-retry attempts */
+  uint64_t dropped_msgs;   /* queued messages discarded by drop_peer */
+  uint64_t queue_len;      /* current queue length (gauge) */
+  uint64_t by_op[16];      /* enqueued messages by base op code */
+  uint64_t batch_size_hist[25];  /* telemetry bucket table, see above */
+  uint64_t send_sec_hist[25];    /* frame send duration (seconds table) */
+  double batch_size_sum;
+  double send_sec_sum;
+} bf_wintx_stats_t;
+
+/* Start the native sender.  flush_bytes/linger_us/queue_max mirror the
+ * BLUEFOG_TPU_WIN_COALESCE_* knobs; retries/backoff_sec the transient-
+ * retry policy (jittered exponential, as in the Python path). */
+bf_wintx_t* bf_wintx_start(uint64_t flush_bytes, uint64_t linger_us,
+                           int32_t queue_max, int32_t retries,
+                           double backoff_sec);
+
+/* Enqueue one message onto (host, port)'s queue; blocking backpressure
+ * when full.  urgent != 0 cuts the linger (and drags queued data onto the
+ * wire ahead of it).  Returns 0, -4 name >= 128 bytes (deterministic),
+ * -5 transport/peer stopping, or a stored negative send-error code from a
+ * previously failed batch to this peer (consumed, as the Python sender's
+ * stored error is). */
+int32_t bf_wintx_send(bf_wintx_t* t, const char* host, int32_t port,
+                      uint8_t op, const char* name, int32_t src, int32_t dst,
+                      double weight, double p_weight, const uint8_t* payload,
+                      uint64_t payload_len, int32_t urgent);
+
+/* Block until everything enqueued to (host, port) BEFORE this call has
+ * been handed to TCP.  host == NULL drains every peer.  Returns 0, a
+ * stored send-error code (consumed), -6 on timeout, -5 stopped with
+ * messages unsent. */
+int32_t bf_wintx_flush(bf_wintx_t* t, const char* host, int32_t port,
+                       double timeout_sec);
+
+/* Monotonic failed-batch count for (host, port) (0 if unknown/retired);
+ * host == NULL sums the active peers — the error-epoch token. */
+int64_t bf_wintx_err_count(bf_wintx_t* t, const char* host, int32_t port);
+
+/* Non-blocking: wake every sender with a pending queue (pacing). */
+void bf_wintx_kick(bf_wintx_t* t);
+
+/* Retire a peer: discard its queue (returns the count, recorded in
+ * dropped_msgs), fail any blocked flusher, let the worker exit.  A later
+ * send to the same address lazily creates a fresh sender. */
+int64_t bf_wintx_drop_peer(bf_wintx_t* t, const char* host, int32_t port);
+
+/* Declare "host:port,host:port" peers unreachable (chaos fault
+ * injection): their batch sends fail with no wire traffic and no retries.
+ * NULL or "" heals. */
+void bf_wintx_set_partition(bf_wintx_t* t, const char* csv);
+
+/* Counter snapshot: host == NULL aggregates every peer ever created;
+ * otherwise the named active peer (zeroed if unknown). */
+void bf_wintx_stats(bf_wintx_t* t, const char* host, int32_t port,
+                    bf_wintx_stats_t* out);
+
+/* Drain queues (workers finish in-flight batches; unreachable peers fail
+ * fast), join every worker, free the transport. */
+void bf_wintx_stop(bf_wintx_t* t);
+
 #ifdef __cplusplus
 }
 #endif

@@ -27,6 +27,20 @@ the distributed mutex rely on — is exactly preserved: a FENCE_REQ enqueued
 after puts is decoded after them from the same batch stream.  Small
 per-parameter gossip rows then cost wire time per BYTE, not per message
 (HiCCL's aggregation argument, arxiv 2408.05962).
+
+Native hot path (``BLUEFOG_TPU_WIN_NATIVE``, default on): the whole hot
+loop above — per-peer queues, sender workers, OP_BATCH frame encode,
+inbound batch decode, the bf16/sparse payload codecs, and the same-slot
+drain folding — runs in the C++ core (``native/src/winsvc.cc``,
+``bf_wintx_*`` / ``bf_winsvc_drain``) instead of Python threads under the
+GIL: ``send()`` is one ctypes call into a C++ per-peer queue, and the
+drain thread receives ONE already-folded commit set per ``win.lock`` hold
+instead of per-message Python decode work.  The Python implementation in
+this module is kept fully intact as the ``BLUEFOG_TPU_WIN_NATIVE=0``
+fallback AND the equivalence oracle (same wire frames, bit-identical
+folded state — ``tests/test_transport_batch.py``); the native path
+auto-falls back to it whenever the ``.so`` is missing, stale, or predates
+the ``bf_wintx`` symbols.
 """
 
 from __future__ import annotations
@@ -407,11 +421,20 @@ class WindowTransport:
     it keeps.  ``apply_batch(msgs)``, when supplied, receives one decoded
     OP_BATCH frame as a list of such messages (arrival order); without it,
     batches fall back to per-message ``apply`` calls.
+
+    ``apply_items(items)``, when supplied AND the native hot path is
+    active, receives the native drain's ordered item list: tuples
+    ``(0, msg)`` for raw messages (``msg`` exactly as ``apply`` takes it,
+    payload a zero-copy view) and ``(1, commit)`` for folded commit
+    entries ``(name, replace, src, dst, p_mass, puts, accs, values,
+    wire_bytes)`` with ``values`` a zero-copy f32 view valid only for the
+    call.  Windows opt into native folding via :meth:`register_window`;
+    unregistered traffic always arrives raw.
     """
 
     def __init__(self, apply: Callable, *, apply_batch: Callable = None,
-                 port: int = 0, max_pending: int = 4096,
-                 drain_interval: float = 0.002):
+                 apply_items: Callable = None, port: int = 0,
+                 max_pending: int = 4096, drain_interval: float = 0.002):
         self._lib = native.lib()
         if self._lib is None:
             raise RuntimeError(
@@ -422,6 +445,7 @@ class WindowTransport:
             raise OSError(f"cannot start window service on port {port}")
         self._apply = apply
         self._apply_batch = apply_batch
+        self._apply_items = apply_items
         self._interval = drain_interval
         cfg = config.get()
         self.coalesce = bool(cfg.win_coalesce)
@@ -443,6 +467,44 @@ class WindowTransport:
         # per native send, 1.0 = no coalescing happening).
         self._tx_frames = 0
         self._tx_msgs = 0
+        # -- native hot path (BLUEFOG_TPU_WIN_NATIVE) -----------------------
+        # The whole coalesce/encode/decode/fold loop moves into the C++
+        # core; the Python classes above stay as the =0 fallback and the
+        # equivalence oracle.  Auto-fallback: a missing/stale .so or one
+        # predating the bf_wintx symbols pins the Python path.
+        self.native_path = (self.coalesce and bool(cfg.win_native)
+                            and native.has_win_native())
+        self._tx = None
+        if self.native_path:
+            self._tx = self._lib.bf_wintx_start(
+                self._flush_bytes, int(self._linger * 1e6),
+                self._tx_queue_max, self._retries, self._retry_backoff)
+            if not self._tx:
+                self.native_path = False
+        if self.native_path:
+            # Encoded host/name caches: the per-message fast path must be
+            # one FFI call, not per-call .encode() allocations.  The
+            # METH_FASTCALL module (built alongside the .so) cuts the
+            # FFI cost ~5x vs ctypes AND takes the payload zero-copy via
+            # the buffer protocol; ctypes stays as the everywhere
+            # fallback.
+            fc = native.fastcall()
+            self._fc_send = fc.wintx_send if fc is not None else None
+            self._tx_send = self._lib.bf_wintx_send
+            self._hostb: Dict[str, bytes] = {}
+            self._nameb: Dict[str, bytes] = {}
+            self._peer_addrs: set = set()
+            self._tx_last = native.WinTxStats()
+            self._rx_last = native.WinRxStats()
+            self._peer_last: Dict[Tuple[str, int], Tuple] = {}
+            # Drain buffers (grown on demand): ordered item array, raw
+            # payload bytes, folded f32 values.
+            self._items_cap = 512
+            self._items = (native.WinItem * self._items_cap)()
+            self._raw_buf = np.empty(1 << 20, dtype=np.uint8)
+            self._val_buf = np.empty(1 << 18, dtype=np.float32)
+            from bluefog_tpu.utils import telemetry
+            telemetry.set_gauge("bf_win_native_active", 1)
         self._stop = threading.Event()
         self._buf = np.empty(1 << 20, dtype=np.uint8)  # grows on demand
         self._drainer = threading.Thread(target=self._drain, daemon=True,
@@ -453,10 +515,73 @@ class WindowTransport:
     def port(self) -> int:
         return int(self._lib.bf_winsvc_port(self._svc))
 
+    # -- native window registry (drain-side folding) -------------------------
+    def register_window(self, name: str, elems: int) -> None:
+        """Opt a window into the native drain fold path: a flat f32 row of
+        ``elems`` elements.  No-op on the Python path; non-f32 windows must
+        simply not register (their messages arrive raw)."""
+        if self.native_path and elems > 0 and len(name.encode()) < 128:
+            self._lib.bf_winsvc_win_set(self._svc, name.encode(), elems)
+
+    def unregister_window(self, name: str) -> None:
+        if self.native_path:
+            self._lib.bf_winsvc_win_set(self._svc, name.encode(), -1)
+
     # -- outbound ----------------------------------------------------------
     def send(self, host: str, port: int, op: int, name: str, src: int,
              dst: int, weight: float, tensor: np.ndarray,
              p_weight: float = 0.0) -> None:
+        if self._tx is not None:
+            # Native fast path: ONE ctypes call — enqueue onto the C++
+            # per-peer queue (blocking backpressure in C, GIL released).
+            # No per-message Python allocations beyond the payload bytes:
+            # host/name encodings are cached, telemetry is pumped from the
+            # native counters at flush boundaries instead of per message.
+            hb = self._hostb.get(host)
+            if hb is None:
+                hb = self._hostb[host] = host.encode()
+                self._peer_addrs.add((host, port))
+            nb = self._nameb.get(name)
+            if nb is None:
+                nb = self._nameb[name] = name.encode()
+            urgent = 1 if (op & ~OP_FLAG_MASK) in _URGENT_OPS else 0
+            if self._fc_send is not None:
+                # METH_FASTCALL path: the payload rides the buffer
+                # protocol — zero-copy for a contiguous ndarray, one
+                # enqueue-side copy total (into the C++ arena).
+                try:
+                    rc = self._fc_send(self._tx, hb, port, op, nb, src,
+                                       dst, float(weight), float(p_weight),
+                                       tensor, urgent)
+                except (BufferError, TypeError):
+                    rc = self._fc_send(
+                        self._tx, hb, port, op, nb, src, dst,
+                        float(weight), float(p_weight),
+                        np.ascontiguousarray(tensor).tobytes(), urgent)
+            else:
+                # ctypes fallback.  tobytes() is deliberate: extracting a
+                # raw data POINTER from an ndarray via .ctypes costs ~4x
+                # the small-row byte copy.
+                if tensor.__class__ is np.ndarray \
+                        and tensor.flags.c_contiguous:
+                    payload = tensor.tobytes()
+                else:
+                    payload = np.ascontiguousarray(tensor).tobytes()
+                rc = self._tx_send(self._tx, hb, port, op, nb, src, dst,
+                                   weight, p_weight, payload, len(payload),
+                                   urgent)
+            if rc == 0:
+                return
+            if rc == -4:
+                # Deterministic, path-independent rejection (same rule the
+                # Python path enforces before enqueue): the receiver's
+                # fixed name[128] field caps every route.
+                raise ValueError(
+                    "window transport: window name exceeds the receiver's "
+                    f"128-byte name field (127 usable bytes): {name!r}")
+            raise ConnectionError(
+                f"win transport send to {host}:{port} failed "
+                f"(native code {rc})")
         from bluefog_tpu.utils import telemetry
         if len(name.encode()) >= 128:
             # Deterministic, path-independent rejection: the receiver's
@@ -497,6 +622,9 @@ class WindowTransport:
         pending queue so it ships without waiting out the linger.  Used by
         overlap-mode optimizers to pace gossip onto the wire while the
         caller goes back to compute."""
+        if self._tx is not None:
+            self._lib.bf_wintx_kick(self._tx)
+            return
         with self._senders_lock:
             senders = list(self._senders.values())
         for s in senders:
@@ -513,6 +641,9 @@ class WindowTransport:
         failures to ops that addressed the partitioned peers, exactly as
         with a real outage."""
         self._partitioned = frozenset(addrs or ())
+        if self._tx is not None:
+            csv = ",".join(f"{h}:{p}" for h, p in sorted(self._partitioned))
+            self._lib.bf_wintx_set_partition(self._tx, csv.encode())
 
     def drop_peer(self, host: str, port: int) -> None:
         """Retire a peer's sender queue cleanly (churn controller: the peer
@@ -521,6 +652,25 @@ class WindowTransport:
         backpressure wait are released with a ConnectionError.  Idempotent;
         a later send to the same address would lazily create a fresh
         sender (peer restart)."""
+        if self._tx is not None:
+            # Same retirement on the native queues (churn supervisor
+            # follow-up): the C++ worker exits instead of retrying into a
+            # closed socket; discarded messages keep their counter.
+            dropped = int(self._lib.bf_wintx_drop_peer(
+                self._tx, host.encode(), port))
+            # Prune the stats-pump bookkeeping so a long churny job never
+            # accumulates per-flush FFI calls and dead gauge series for
+            # endpoints that no longer exist (re-added lazily on a fresh
+            # send, exactly like the native peer itself).
+            self._peer_addrs.discard((host, port))
+            self._peer_last.pop((host, port), None)
+            from bluefog_tpu.utils import telemetry
+            telemetry.clear_gauge("bf_win_tx_queue_depth",
+                                  peer=f"{host}:{port}")
+            if dropped and telemetry.enabled():
+                telemetry.inc("bf_win_tx_dropped_msgs_total", float(dropped),
+                              peer=f"{host}:{port}")
+            return
         with self._senders_lock:
             s = self._senders.pop((host, port), None)
         if s is None:
@@ -556,6 +706,11 @@ class WindowTransport:
         peers failed in between — even one whose stored error a concurrent
         flusher already consumed.  Scoped per peer: failures on peers
         outside ``addrs`` never count."""
+        if self._tx is not None:
+            if addrs is None:
+                return int(self._lib.bf_wintx_err_count(self._tx, None, 0))
+            return sum(int(self._lib.bf_wintx_err_count(
+                self._tx, h.encode(), p)) for h, p in addrs)
         return sum(s.err_count for s in self._select_senders(addrs))
 
     def _select_senders(self, addrs) -> List[_PeerSender]:
@@ -579,6 +734,9 @@ class WindowTransport:
         those peers after it raises here, even when the per-sender error
         was already consumed by a concurrent flusher.  No-op on the
         legacy per-message path and on empty queues."""
+        if self._tx is not None:
+            self._flush_native(timeout, addrs, since)
+            return
         senders = self._select_senders(addrs)
         errors = []
         for s in senders:
@@ -594,6 +752,145 @@ class WindowTransport:
                 "win transport: a batched send containing this op's "
                 "message(s) failed on a sender worker (see the "
                 "bluefog_tpu log for the peer and cause)")
+
+    def _flush_native(self, timeout: float, addrs, since) -> None:
+        """Native-path flush: drain the C++ per-peer queues, surface stored
+        async send errors, pump the native counters into telemetry, then
+        apply the same error-epoch ``since`` rule as the Python path."""
+        errors = []
+        if addrs is None:
+            rc = int(self._lib.bf_wintx_flush(self._tx, None, 0,
+                                              float(timeout)))
+            if rc:
+                errors.append(rc)
+        else:
+            for (h, p) in addrs:
+                rc = int(self._lib.bf_wintx_flush(self._tx, h.encode(), p,
+                                                  float(timeout)))
+                if rc:
+                    errors.append(rc)
+        self._pump_native_tx_stats()
+        if errors:
+            rc = errors[0]
+            if rc == -6:
+                raise ConnectionError(
+                    f"win transport flush timed out after {timeout:.0f}s "
+                    "(messages still queued on the native sender)")
+            if rc == -5:
+                raise ConnectionError(
+                    "win transport stopped with message(s) unsent")
+            if rc == -8:
+                raise ConnectionError(
+                    "win transport peer retired by the churn controller "
+                    "with queued message(s) discarded")
+            raise ConnectionError(
+                "win transport: a batched send containing this op's "
+                f"message(s) failed on a native sender worker (code {rc})")
+        if since is not None and self.error_token(addrs) > since:
+            raise ConnectionError(
+                "win transport: a batched send containing this op's "
+                "message(s) failed on a sender worker (see the "
+                "bluefog_tpu log for the peer and cause)")
+
+    def _pump_native_tx_stats(self) -> None:
+        """Diff the cumulative native sender counters into the telemetry
+        registry — the SAME series the Python path maintains per message,
+        observed from the native counters at flush boundaries instead
+        (plus the ``bf_win_native_*`` markers).  Histogram buckets merge
+        directly: the C++ core uses the shared boundary table."""
+        from bluefog_tpu.utils import telemetry
+        if self._tx is None or not telemetry.enabled():
+            return
+        with self._stats_lock:
+            cur = native.WinTxStats()
+            self._lib.bf_wintx_stats(self._tx, None, 0, ctypes.byref(cur))
+            last, self._tx_last = self._tx_last, cur
+            for i in range(16):
+                d = cur.by_op[i] - last.by_op[i]
+                if d > 0:
+                    telemetry.inc("bf_win_tx_msgs_total", float(d),
+                                  op=_op_label(i))
+            d = cur.frames - last.frames
+            if d > 0:
+                telemetry.inc("bf_win_native_tx_frames_total", float(d))
+            d = cur.batches - last.batches
+            if d > 0:
+                telemetry.inc("bf_win_tx_batches_total", float(d))
+            d = cur.batched_msgs - last.batched_msgs
+            if d > 0:
+                telemetry.inc("bf_win_tx_batched_msgs_total", float(d))
+            if cur.frames > 0:
+                telemetry.set_gauge("bf_win_tx_coalesce_ratio",
+                                    cur.batch_size_sum / cur.frames)
+            telemetry.observe_bucket_counts(
+                "bf_win_tx_batch_size",
+                [cur.batch_size_hist[i] - last.batch_size_hist[i]
+                 for i in range(25)],
+                cur.batch_size_sum - last.batch_size_sum)
+            telemetry.observe_bucket_counts(
+                "bf_win_rpc_seconds",
+                [cur.send_sec_hist[i] - last.send_sec_hist[i]
+                 for i in range(25)],
+                cur.send_sec_sum - last.send_sec_sum, op="native")
+            # Per-peer series (bytes, errors, retries, queue depth).
+            for (h, p) in list(self._peer_addrs):
+                ps = native.WinTxStats()
+                self._lib.bf_wintx_stats(self._tx, h.encode(), p,
+                                         ctypes.byref(ps))
+                peer = f"{h}:{p}"
+                lb, le, lr = self._peer_last.get((h, p), (0, 0, 0))
+                # max(0, ...): a drop_peer/recreate cycle resets the
+                # per-peer counters; the clamped diff keeps the labeled
+                # series monotonic (aggregate series use the graveyard-
+                # inclusive totals above and never reset).
+                d = max(0, ps.bytes - lb)
+                if d:
+                    telemetry.inc("bf_win_tx_bytes_total", float(d),
+                                  peer=peer)
+                d = max(0, ps.errors - le)
+                if d:
+                    telemetry.inc("bf_win_tx_errors_total", float(d),
+                                  peer=peer)
+                d = max(0, ps.retries - lr)
+                if d:
+                    telemetry.inc("bf_win_tx_retries_total", float(d),
+                                  peer=peer)
+                telemetry.set_gauge("bf_win_tx_queue_depth",
+                                    float(ps.queue_len), peer=peer)
+                self._peer_last[(h, p)] = (ps.bytes, ps.errors, ps.retries)
+
+    def _pump_native_rx_stats(self) -> None:
+        """Diff the cumulative native drain counters into telemetry (same
+        series the Python decode path maintains per frame/message)."""
+        from bluefog_tpu.utils import telemetry
+        if not telemetry.enabled():
+            return
+        cur = native.WinRxStats()
+        self._lib.bf_winsvc_rx_stats(self._svc, ctypes.byref(cur))
+        last, self._rx_last = self._rx_last, cur
+        d = cur.batch_frames - last.batch_frames
+        if d > 0:
+            telemetry.inc("bf_win_rx_batches_total", float(d))
+            telemetry.inc("bf_win_native_rx_frames_total", float(d))
+        d = cur.bytes - last.bytes
+        if d > 0:
+            telemetry.inc("bf_win_rx_bytes_total", float(d))
+        for i in range(16):
+            d = cur.by_op[i] - last.by_op[i]
+            if d > 0:
+                telemetry.inc("bf_win_rx_msgs_total", float(d),
+                              op=_op_label(i))
+        d = cur.folded_msgs - last.folded_msgs
+        if d > 0:
+            telemetry.inc("bf_win_native_rx_folded_msgs_total", float(d))
+        d = cur.commits - last.commits
+        if d > 0:
+            telemetry.inc("bf_win_native_rx_commits_total", float(d))
+        telemetry.observe_bucket_counts(
+            "bf_win_rx_batch_size",
+            [cur.batch_size_hist[i] - last.batch_size_hist[i]
+             for i in range(25)],
+            cur.batch_size_sum - last.batch_size_sum)
 
     def _sender(self, host: str, port: int) -> _PeerSender:
         key = (host, port)
@@ -680,11 +977,206 @@ class WindowTransport:
             if telemetry.enabled():
                 telemetry.inc("bf_win_tx_errors_total",
                               peer=f"{host}:{port}")
+            if rc == -4:
+                # Deterministic caller bug, not a connectivity problem:
+                # the receiver's fixed name[128] field rejects the route.
+                raise ValueError(
+                    "window transport: window name exceeds the receiver's "
+                    f"128-byte name field (127 usable bytes): {name!r}")
             raise ConnectionError(
                 f"win transport send to {host}:{port} failed (code {rc})")
 
     # -- inbound -----------------------------------------------------------
     def _drain(self):
+        if self.native_path:
+            return self._drain_native()
+        return self._drain_python()
+
+    def _drain_native(self):
+        """Native drain loop: ``bf_winsvc_drain`` pops queued frames and
+        hands back an ordered item list — batch decode, payload codecs and
+        same-slot folding already done in C++.  Per-item Python work is
+        per RUN (one folded commit per slot run), not per message; raw
+        items (control ops, unregistered windows, foreign frames) flow
+        through the exact legacy paths."""
+        from bluefog_tpu.utils import telemetry
+        lib, svc = self._lib, self._svc
+        burst = 0          # messages applied back-to-back (depth proxy)
+        burst_t0 = 0.0
+        burst_t_end = 0.0  # after the LAST applied result — the blocking
+                           # idle wait inside the drain call is not burst
+                           # service time
+        max_frames = 64
+        # Block INSIDE the native call (GIL released) while the queue is
+        # empty — no Python-side poll loop stealing the GIL from senders.
+        # Wake-on-data is instant (condition variable), so the 50 ms cap
+        # only bounds how often the stop flag is checked.
+        wait_ms = 50
+        while not self._stop.is_set():
+            t_call = time.perf_counter()
+            n = lib.bf_winsvc_drain(
+                svc, self._items, self._items_cap,
+                self._raw_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint8)),
+                self._raw_buf.size,
+                self._val_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)),
+                self._val_buf.size, max_frames, wait_ms)
+            if n > 0 and burst \
+                    and time.perf_counter() - t_call > 0.002:
+                # The call sat WAITING before this data arrived: the queue
+                # had run dry, so the previous burst ended back then —
+                # same boundary the polling Python drain observes.
+                telemetry.set_gauge("bf_win_rx_queue_depth", burst)
+                telemetry.observe("bf_win_drain_burst_seconds",
+                                  burst_t_end - burst_t0)
+                burst = 0
+                self._pump_native_rx_stats()
+            if n == -1:  # next frame's raw payloads exceed the buffer
+                self._raw_buf = np.empty(max(self._raw_buf.size * 2, 1 << 24),
+                                         dtype=np.uint8)
+                continue
+            if n == -2:  # next frame's folded values exceed the buffer
+                self._val_buf = np.empty(max(self._val_buf.size * 2, 1 << 22),
+                                         dtype=np.float32)
+                continue
+            if n == -3:  # more sub-message runs than item slots
+                self._items_cap *= 2
+                self._items = (native.WinItem * self._items_cap)()
+                continue
+            if n == 0:
+                # The wait already happened inside the native call — no
+                # Python-side sleep here.
+                if burst:
+                    telemetry.set_gauge("bf_win_rx_queue_depth", burst)
+                    telemetry.observe("bf_win_drain_burst_seconds",
+                                      burst_t_end - burst_t0)
+                    burst = 0
+                    self._pump_native_rx_stats()
+                continue
+            if not burst:
+                burst_t0 = time.perf_counter()
+            burst += self._apply_native_items(int(n))
+            burst_t_end = time.perf_counter()
+
+    def _raw_item_msg(self, it, raw_mv) -> Msg:
+        return (int(it.op), it.name.decode(), int(it.src), int(it.dst),
+                float(it.weight), float(it.p_weight),
+                raw_mv[it.off:it.off + it.len])
+
+    def _fallback_batch_frame(self, payload) -> Optional[List[Msg]]:
+        """Python-decode a batch frame the native drain handed back whole
+        (bad version, oversized names): the Python decoder owns the error
+        reporting AND the telemetry for these, exactly as on the fallback
+        path.  Returns None when the frame is undecodable (logged)."""
+        from bluefog_tpu.utils import telemetry
+        try:
+            sub = _decode_batch(payload)
+        except Exception:  # noqa: BLE001 — drain must survive
+            import logging
+            logging.getLogger("bluefog_tpu").exception(
+                "window transport batch decode failed")
+            return None
+        if telemetry.enabled():
+            telemetry.inc("bf_win_rx_batches_total")
+            telemetry.inc("bf_win_rx_bytes_total", float(len(payload)))
+            telemetry.observe("bf_win_rx_batch_size", float(len(sub)))
+            for m in sub:
+                telemetry.inc("bf_win_rx_msgs_total", op=_op_label(m[0]))
+        return sub
+
+    def _apply_native_items(self, n: int) -> int:
+        """Apply one native drain result in order; returns the number of
+        wire messages it carried.  No per-message telemetry here: natively
+        decoded frames are tallied in the C++ counters pumped by
+        :meth:`_pump_native_rx_stats` (fallback whole frames excepted —
+        their Python decode owns the counting)."""
+        raw_mv = memoryview(self._raw_buf)
+        if self._apply_items is not None:
+            items = []
+            msgs = 0
+            for i in range(n):
+                it = self._items[i]
+                if it.kind:
+                    vals = np.frombuffer(self._val_buf, np.float32,
+                                         count=it.len, offset=it.off * 4)
+                    items.append((1, (it.name.decode(), bool(it.replace),
+                                      int(it.src), int(it.dst),
+                                      float(it.p_weight), int(it.puts),
+                                      int(it.accs), vals,
+                                      int(it.wire_bytes))))
+                    msgs += it.puts + it.accs
+                    continue
+                if int(it.op) == OP_BATCH:
+                    sub = self._fallback_batch_frame(
+                        raw_mv[it.off:it.off + it.len])
+                    if sub is not None:
+                        # Splice in place: stream order vs surrounding
+                        # items is exactly arrival order.
+                        items.extend((0, m) for m in sub)
+                        msgs += len(sub)
+                    continue
+                items.append((0, self._raw_item_msg(it, raw_mv)))
+                msgs += 1
+            try:
+                self._apply_items(items)
+            except Exception:  # noqa: BLE001 — drain thread must survive
+                import logging
+                logging.getLogger("bluefog_tpu").exception(
+                    "window transport apply failed")
+            return msgs
+        # Legacy-callback consumer (no apply_items): regroup raw items by
+        # their frame tag so each decoded OP_BATCH frame is delivered as
+        # ONE apply_batch call — the PR-4 contract, preserved for
+        # consumers that only supply apply/apply_batch.  Commits cannot
+        # occur here (nothing registered windows), but are drop-logged
+        # defensively.
+        import logging
+        msgs = 0
+        i = 0
+        while i < n:
+            it = self._items[i]
+            if it.kind:
+                logging.getLogger("bluefog_tpu").warning(
+                    "window transport: folded commit for %r dropped (no "
+                    "apply_items consumer)", it.name.decode())
+                i += 1
+                continue
+            if int(it.op) == OP_BATCH:
+                sub = self._fallback_batch_frame(
+                    raw_mv[it.off:it.off + it.len])
+                i += 1
+                if sub is None:
+                    continue
+                msgs += len(sub)
+                group = sub
+            elif it.frame:
+                group = []
+                f = it.frame
+                while (i < n and self._items[i].kind == 0
+                       and self._items[i].frame == f):
+                    group.append(self._raw_item_msg(self._items[i], raw_mv))
+                    i += 1
+                msgs += len(group)
+            else:
+                group = None  # singleton: per-message apply
+                msg = self._raw_item_msg(it, raw_mv)
+                i += 1
+                msgs += 1
+            try:
+                if group is None:
+                    self._apply(*msg)
+                elif self._apply_batch is not None:
+                    self._apply_batch(group)
+                else:
+                    for m in group:
+                        self._apply(*m)
+            except Exception:  # noqa: BLE001 — drain thread must survive
+                logging.getLogger("bluefog_tpu").exception(
+                    "window transport apply failed")
+        return msgs
+
+    def _drain_python(self):
         from bluefog_tpu.utils import telemetry
         msg = native.WinMsg()
         burst = 0  # consecutive non-empty recvs: inbound-queue depth proxy
@@ -754,6 +1246,13 @@ class WindowTransport:
                 self._apply(*m)
 
     def stop(self):
+        if self._tx is not None:
+            try:
+                self._pump_native_tx_stats()
+            except Exception:  # noqa: BLE001 — telemetry must not block stop
+                pass
+            self._lib.bf_wintx_stop(self._tx)
+            self._tx = None
         with self._senders_lock:
             senders = list(self._senders.values())
             self._senders.clear()
@@ -762,5 +1261,10 @@ class WindowTransport:
         self._stop.set()
         self._drainer.join(timeout=5)
         if self._svc:
+            if self.native_path:
+                try:
+                    self._pump_native_rx_stats()
+                except Exception:  # noqa: BLE001
+                    pass
             self._lib.bf_winsvc_stop(self._svc)
             self._svc = None

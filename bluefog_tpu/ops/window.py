@@ -249,10 +249,16 @@ def _drain_handles(timeout: float = 60.0) -> bool:
 
 
 def _free_all_windows() -> None:
+    d = _store.distrib
+    unreg = getattr(d.transport, "unregister_window", None) \
+        if d is not None else None
     with _store.lock:
         for f in _store.handles.values():
             f.cancel()
         _store.handles.clear()
+        if unreg is not None:
+            for n in _store.windows:
+                unreg(n)
         _store.windows.clear()
     _drop_ef_residuals()
 
@@ -383,7 +389,8 @@ def init_transport() -> bool:
         return False
     from bluefog_tpu.ops.transport import WindowTransport
     transport = WindowTransport(_apply_inbound,
-                                apply_batch=_apply_inbound_batch)
+                                apply_batch=_apply_inbound_batch,
+                                apply_items=_apply_inbound_items)
     me = f"{_local_host_addr()}:{transport.port}"
     addrs = _exchange_endpoints(me, jax.process_count(),
                                 jax.process_index())
@@ -882,6 +889,101 @@ def _apply_inbound_batch(msgs) -> None:
         i = j
 
 
+def _apply_inbound_items(items) -> None:
+    """Drain-thread entry for the NATIVE transport path: an ordered list of
+    ``(0, msg)`` raw messages and ``(1, commit)`` folded commit entries
+    (``ops/transport.WindowTransport`` docs).  Decode, codec work and
+    same-slot folding already happened in C++; what remains per run is one
+    ``win.lock`` hold committing the folded slots — the Python structural
+    twin of :func:`_apply_inbound_batch`, with the per-message work gone.
+
+    Exception isolation matches the batched path: one bad run or control
+    message loses only itself, never the rest of the drain result."""
+    import logging
+    i, n = 0, len(items)
+    while i < n:
+        kind, payload = items[i]
+        if kind == 0:
+            try:
+                _apply_inbound(*payload)
+            except Exception:  # noqa: BLE001 — isolate per message
+                logging.getLogger("bluefog_tpu").exception(
+                    "window transport apply failed (native raw msg)")
+            i += 1
+            continue
+        name = payload[0]
+        j = i + 1
+        while j < n and items[j][0] == 1 and items[j][1][0] == name:
+            j += 1
+        try:
+            _commit_native_run(name, [it[1] for it in items[i:j]])
+        except Exception:  # noqa: BLE001 — isolate per run
+            logging.getLogger("bluefog_tpu").exception(
+                "window transport apply failed (native commit run)")
+        i = j
+
+
+def _commit_native_run(name: str, entries) -> None:
+    """Commit one window's run of natively-folded entries under ONE
+    ``win.lock`` hold.  Each entry is ``(name, replace, src, dst, p_mass,
+    puts, accs, values, wire_bytes)`` with ``values`` a zero-copy f32 view
+    into the transport's drain buffer (valid only for this call): replace
+    entries copy it into a fresh staging array, accumulate entries fold it
+    in with ``+=`` — numerically IDENTICAL to what the Python batched
+    apply computes for the same frames, since the C++ fold replicates its
+    decode/scale/fold order bit-for-bit."""
+    d = _store.distrib
+    with _store.lock:
+        win = _store.windows.get(name) if d is not None else None
+    if win is None or d is None:
+        # Pre-init or SPMD-skew parking: re-materialize each folded entry
+        # as ONE equivalent message (the fold already collapsed the run:
+        # a put with the folded row at weight 1 carries the same state)
+        # and let the per-message path own the parking bookkeeping.  The
+        # folded version ticks collapse to one per entry in this narrow
+        # race — the replayed STATE is exact.
+        for (nm, replace, src, dst, p_mass, _puts, _accs, vals, _wb) \
+                in entries:
+            _apply_inbound(OP_PUT if replace else OP_ACCUMULATE, nm, src,
+                           dst, 1.0, p_mass, np.asarray(vals).tobytes())
+        return
+    from bluefog_tpu.utils import telemetry
+    if telemetry.enabled():
+        for (_nm, _r, src, _d2, _pm, _p, _a, _v, wire_bytes) in entries:
+            telemetry.inc("bf_win_proc_rx_bytes_total", float(wire_bytes),
+                          proc=d.rank_owner.get(src, -1))
+    expected = int(np.prod(win.shape, dtype=np.int64))
+    from bluefog_tpu.utils.timeline import op_span
+    with op_span(f"win_apply_batch.{name}", "COMMUNICATE"):
+        with win.lock:
+            for (_nm, replace, src, dst, p_mass, puts, accs, vals, _wb) \
+                    in entries:
+                key = (dst, src)
+                if key not in win.staging:
+                    continue
+                if vals.size != expected:
+                    # A window freed+recreated with a different shape while
+                    # this entry was in flight: drop it, as the Python
+                    # path's _payload_row validation would.
+                    import logging
+                    logging.getLogger("bluefog_tpu").warning(
+                        "window %r: folded entry of %d elements does not "
+                        "match the %d-element row — dropped", name,
+                        vals.size, expected)
+                    continue
+                row = vals.reshape(win.shape)
+                if replace:
+                    win.staging[key] = row.copy()  # own it: buffer is reused
+                else:
+                    win.staging[key] += row
+                win.versions[key] += puts + accs
+                if _store.associated_p_enabled:
+                    if replace:
+                        win.p_staging[key] = p_mass
+                    else:
+                        win.p_staging[key] += p_mass
+
+
 def _apply_data_run(name: str, group) -> None:
     """Apply a run of put/accumulate messages for one window, vectorized:
     decode + scale outside the lock, fold consecutive same-slot
@@ -1034,20 +1136,39 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
     with _store.lock:
         if name in _store.windows:
             return False
-        _store.windows[name] = _Window(name, t, in_nbrs, out_nbrs,
-                                       zero_init, owned, layout)
+        win = _store.windows[name] = _Window(name, t, in_nbrs, out_nbrs,
+                                             zero_init, owned, layout)
         if d is not None:
             for msg in d.parked.pop(name, []):
                 _apply_inbound(*msg)
+    if d is not None and win.dtype == np.float32:
+        # Opt the window into the native drain fold path (f32 rows only —
+        # the C++ fold is f32 arithmetic; other dtypes keep the raw
+        # per-message path).  After creation: a commit can never precede
+        # the window it targets.
+        reg = getattr(d.transport, "register_window", None)
+        if reg is not None:
+            reg(name, int(np.prod(win.shape, dtype=np.int64)))
     return True
 
 
 def win_free(name: Optional[str] = None) -> bool:
+    # Unregister from the native drain BEFORE removing the window, so the
+    # freed-window race window (in-flight folded commits for a window that
+    # no longer exists) is as narrow as the frame already being decoded.
+    d = _store.distrib
+    unreg = getattr(d.transport, "unregister_window", None) \
+        if d is not None else None
     try:
         with _store.lock:
             if name is None:
+                if unreg is not None:
+                    for n in _store.windows:
+                        unreg(n)
                 _store.windows.clear()
             elif name in _store.windows:
+                if unreg is not None:
+                    unreg(name)
                 del _store.windows[name]
             else:
                 return False

@@ -158,6 +158,11 @@ class ChurnSupervisor:
         1. Retire the dead peers' transport sender queues (their in-flight
            gossip has nowhere to go; the per-peer error-epoch tokens
            already scoped any overlapped op failures to exactly them).
+           ``drop_peer`` covers BOTH transport hot paths: with
+           ``BLUEFOG_TPU_WIN_NATIVE`` on it retires the C++ per-peer
+           queue too, so the dead peer's native sender worker exits
+           instead of retrying into a closed socket — discarded messages
+           counted in ``bf_win_tx_dropped_msgs_total`` as always.
         2. Snapshot every window's OWNED rows + push-sum mass — each
            process is authoritative for its own ranks, the same ownership
            contract ``elastic.py`` stitches checkpoints by.

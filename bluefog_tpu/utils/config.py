@@ -17,6 +17,7 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_MAX_PENDING   | 4096  | inbound window-message queue bound |
 | BLUEFOG_TPU_WIN_COMPRESSION   | none  | bf16 (halve cross-host window payloads) or sparse:<frac> (top-|magnitude| + sender error feedback) |
 | BLUEFOG_TPU_WIN_COALESCE      | 1     | 0: legacy per-message transport sends |
+| BLUEFOG_TPU_WIN_NATIVE        | 1     | 0: keep the transport hot loop (batch/drain/fold) in Python; 1 auto-falls back when the native core is missing/stale |
 | BLUEFOG_TPU_WIN_COALESCE_LINGER_MS | 1.0 | sender-worker linger before flushing a partial batch |
 | BLUEFOG_TPU_WIN_COALESCE_BYTES | 1 MiB | queued bytes that force an immediate batch flush |
 | BLUEFOG_TPU_WIN_TX_QUEUE      | 1024  | per-peer outbound queue bound (messages); full blocks the producer |
@@ -157,6 +158,14 @@ class Config:
     win_coalesce_linger_ms: float
     win_coalesce_bytes: int
     win_tx_queue: int
+    # Native window-transport hot path (native/src/winsvc.cc bf_wintx_* +
+    # bf_winsvc_drain): per-peer coalescing send queues, OP_BATCH frame
+    # encode/decode and same-slot drain folding run in C++ instead of
+    # Python threads under the GIL.  On by default but AUTO-falls back to
+    # the (bit-identical) Python hot loop whenever the native core is
+    # missing, stale, or predates these symbols; 0 pins the Python path
+    # (the equivalence oracle) unconditionally.
+    win_native: bool
     # Transient-send retry policy of the DCN transport (ops/transport.py):
     # how many times a failed native send is retried with jittered
     # exponential backoff (base win_retry_backoff_ms, doubling per
@@ -270,6 +279,7 @@ class Config:
                 "BLUEFOG_TPU_WIN_COALESCE_BYTES", str(1 << 20))),
             win_tx_queue=int(os.environ.get(
                 "BLUEFOG_TPU_WIN_TX_QUEUE", "1024")),
+            win_native=_flag("BLUEFOG_TPU_WIN_NATIVE", default=True),
             win_retries=int(os.environ.get(
                 "BLUEFOG_TPU_WIN_RETRIES", "1")),
             win_retry_backoff_ms=float(os.environ.get(

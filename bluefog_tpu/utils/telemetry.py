@@ -59,6 +59,7 @@ __all__ = [
     "inc",
     "set_gauge",
     "observe",
+    "observe_bucket_counts",
     "start_timer",
     "observe_since",
     "histogram_percentiles",
@@ -169,6 +170,34 @@ def observe(name: str, value_seconds: float, **labels) -> None:
             h = _registry.hists[key] = [[0] * (len(_HIST_BUCKETS) + 1), 0.0]
         h[0][i] += 1
         h[1] += value_seconds
+
+
+def observe_bucket_counts(name, counts, total_sum: float, **labels) -> None:
+    """Merge pre-bucketed observations into a histogram series (no-op when
+    disabled).
+
+    ``counts`` must be per-bucket counts against the SHARED boundary table
+    (``len(_HIST_BUCKETS) + 1`` entries, last = overflow) — the native core
+    (``winsvc.cc``) hardcodes the same 1µs–50s ladder, so its cumulative
+    histograms merge into the registry by elementwise addition, exactly
+    like the cross-rank :func:`aggregate_snapshot` merge."""
+    if not config.get().telemetry:
+        return
+    n = len(_HIST_BUCKETS) + 1
+    if len(counts) != n:
+        raise ValueError(
+            f"observe_bucket_counts({name!r}): {len(counts)} buckets do not "
+            f"match the shared boundary table ({n})")
+    if not any(counts):
+        return
+    key = _key(name, labels)
+    with _registry.lock:
+        h = _registry.hists.get(key)
+        if h is None:
+            h = _registry.hists[key] = [[0] * n, 0.0]
+        for i, c in enumerate(counts):
+            h[0][i] += int(c)
+        h[1] += float(total_sum)
 
 
 def start_timer() -> Optional[float]:

@@ -1,6 +1,14 @@
 """Coalesced DCN window transport (PR 4): OP_BATCH wire framing, per-peer
 sender workers, ordering under coalescing, the vectorized batched apply,
-and the transient-send retry."""
+and the transient-send retry.
+
+Since the native hot path (BLUEFOG_TPU_WIN_NATIVE, winsvc.cc bf_wintx_* +
+bf_winsvc_drain) moved batching/encode/decode/fold into C++, this file is
+also the cross-path ORACLE: the loopback tests run under whichever path
+the environment selects, and the dedicated tests at the bottom assert
+that native-encoded frames decode bit-identically through the Python
+decoder (and vice versa) and that the folded native drain produces
+bit-identical window state to the Python batched apply."""
 
 import os
 import threading
@@ -571,11 +579,17 @@ def test_drop_peer_discards_queue_and_allows_lazy_recreate(coalesce_env):
                np.zeros(4, np.float32))
         t.drop_peer("127.0.0.1", port)
         t.flush(timeout=5)  # dead peer's queue is gone: nothing to wait on
-        # A fresh send lazily recreates the sender (restart path).
+        # A fresh send lazily recreates the sender (restart path) on BOTH
+        # hot paths — and the fresh sender really processes the message:
+        # the still-dead endpoint surfaces again at the next flush.
         t.send("127.0.0.1", port, T.OP_PUT, "w", 0, 1, 1.0,
                np.zeros(4, np.float32))
-        with t._senders_lock:
-            assert ("127.0.0.1", port) in t._senders
+        if t.native_path:
+            with pytest.raises(ConnectionError):
+                t.flush(timeout=10)
+        else:
+            with t._senders_lock:
+                assert ("127.0.0.1", port) in t._senders
     finally:
         t.stop()
         dead.close()
@@ -650,3 +664,283 @@ def test_drop_peer_fails_blocked_flusher_immediately(coalesce_env):
     finally:
         t.stop()
         dead.close()
+
+
+# ---------------------------------------------------------------------------
+# Native hot path (BLUEFOG_TPU_WIN_NATIVE): cross-codec equivalence oracle
+# ---------------------------------------------------------------------------
+
+needs_win_native = pytest.mark.skipif(
+    not native.available() or not native.has_win_native(),
+    reason="native window hot path not built")
+
+
+def _mixed_stream(seed, count):
+    """A deterministic mixed-op message stream: dense/bf16/sparse data
+    payloads, zero-length fence/mutex control ops, awkward names."""
+    rng = np.random.RandomState(seed)
+    names = ["w", "a.b/c:d", "x" * 127]
+    msgs = []
+    for _ in range(count):
+        roll = rng.rand()
+        if roll < 0.5:
+            op = T.OP_PUT if rng.rand() < 0.4 else T.OP_ACCUMULATE
+            row = rng.randn(6).astype(np.float32)
+            kind = rng.rand()
+            if kind < 0.2:
+                op |= T.OP_BF16_FLAG
+                import jax.numpy as jnp
+                payload = np.asarray(row, dtype=np.dtype(jnp.bfloat16))
+            elif kind < 0.4:
+                op |= T.OP_SPARSE_FLAG
+                idx = np.sort(rng.choice(6, size=3, replace=False))
+                payload = T.sparse_encode(row[idx].astype(np.float32),
+                                          idx.astype(np.int32))
+            else:
+                payload = row
+            msgs.append((op, str(rng.choice(names)), int(rng.randint(8)),
+                         int(rng.randint(8)), float(rng.rand() + 0.1),
+                         float(rng.rand()), np.ascontiguousarray(payload)))
+        else:
+            op = int(rng.choice([T.OP_FENCE_REQ, T.OP_MUTEX_ACQ,
+                                 T.OP_MUTEX_REL, T.OP_GET_REQ]))
+            msgs.append((op, str(rng.choice(names)), int(rng.randint(8)),
+                         int(rng.randint(8)), 0.0, 0.0,
+                         np.zeros(0, np.float32)))
+    return msgs
+
+
+def _loopback_capture(coalesce_env, client_native, server_native, msgs):
+    """Ship ``msgs`` through a loopback pair with the requested path on
+    each side; returns the (op, name, src, dst, w, pw, payload-bytes)
+    tuples the server decoded, in arrival order."""
+    rec = _Recorder()
+
+    def apply_items(items):
+        for kind, payload in items:
+            assert kind == 0, "no windows registered: commits impossible"
+            rec.apply(*payload)
+
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=200,
+                 BLUEFOG_TPU_WIN_NATIVE=1 if server_native else 0)
+    server = T.WindowTransport(rec.apply, apply_batch=rec.apply_batch,
+                               apply_items=apply_items)
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=200,
+                 BLUEFOG_TPU_WIN_NATIVE=1 if client_native else 0)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        assert server.native_path == server_native
+        assert client.native_path == client_native
+        for (op, name, src, dst, w, pw, payload) in msgs:
+            client.send("127.0.0.1", server.port, op, name, src, dst, w,
+                        payload, p_weight=pw)
+        client.flush()
+        rec.wait_for(len(msgs))
+        return list(rec.msgs)
+    finally:
+        client.stop()
+        server.stop()
+
+
+@needs_win_native
+def test_native_encoder_decodes_bit_identically_by_python_and_vice_versa(
+        coalesce_env):
+    """Cross-codec property test: every frame the NATIVE encoder ships is
+    decoded bit-identically by the PYTHON decoder, and every frame the
+    Python encoder ships is decoded bit-identically by the NATIVE drain
+    (unregistered windows -> raw items) — mixed ops, OP_BF16_FLAG,
+    OP_SPARSE_FLAG, zero-length fence/mutex payloads, order preserved."""
+    msgs = _mixed_stream(seed=7, count=120)
+    for client_native, server_native in ((True, False), (False, True),
+                                         (True, True)):
+        got = _loopback_capture(coalesce_env, client_native, server_native,
+                                msgs)
+        assert len(got) == len(msgs), (client_native, server_native)
+        for sent, rx in zip(msgs, got):
+            assert sent[:6] == rx[:6], (client_native, server_native)
+            assert np.ascontiguousarray(sent[6]).tobytes() == rx[6], \
+                (client_native, server_native)
+
+
+@needs_win_native
+def test_native_send_rejects_long_name_with_valueerror(coalesce_env):
+    """bf_wintx_send rc=-4 (name over the receiver's 128-byte field)
+    surfaces as a ValueError naming the limit — a deterministic caller
+    bug, not a ConnectionError to retry."""
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1, BLUEFOG_TPU_WIN_NATIVE=1)
+    t = T.WindowTransport(lambda *a: None)
+    try:
+        assert t.native_path
+        with pytest.raises(ValueError, match="128"):
+            t.send("127.0.0.1", t.port, T.OP_PUT, "n" * 200, 0, 1, 1.0,
+                   np.zeros(4, np.float32))
+    finally:
+        t.stop()
+
+
+def _drive_store_stream(coalesce_env, use_native, with_p):
+    """Run one deterministic put/accumulate stream through a REAL loopback
+    transport into the window store (batched frames, controlled framing:
+    one flush per message group so both paths fold identical groups) and
+    snapshot the resulting window state."""
+    import bluefog_tpu as bf
+    from bluefog_tpu import topology as topo
+
+    bf.init(lambda: topo.RingGraph(8))
+    rng = np.random.RandomState(11)
+    x = rng.randn(8, 5).astype(np.float32)
+    if with_p:
+        bf.turn_on_win_ops_with_associated_p()
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=500,
+                 BLUEFOG_TPU_WIN_NATIVE=1 if use_native else 0)
+    applied = [0]
+    cv = threading.Condition()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        W._apply_inbound(op, name, src, dst, weight, p_weight, payload)
+        with cv:
+            applied[0] += 1
+            cv.notify_all()
+
+    def apply_batch(msgs):
+        W._apply_inbound_batch(msgs)
+        with cv:
+            applied[0] += len(msgs)
+            cv.notify_all()
+
+    def apply_items(items):
+        W._apply_inbound_items(items)
+        with cv:
+            applied[0] += sum((p[5] + p[6]) if k else 1 for k, p in items)
+            cv.notify_all()
+
+    server = T.WindowTransport(apply, apply_batch=apply_batch,
+                               apply_items=apply_items)
+    client = T.WindowTransport(lambda *a: None)
+    saved = W._store.distrib
+    W._store.distrib = _fake_distrib()
+    try:
+        assert server.native_path == use_native
+        assert bf.win_create(x, "eqa", zero_init=True)
+        assert bf.win_create(x, "eqb", zero_init=True)
+        for n in ("eqa", "eqb"):
+            server.register_window(n, 5)
+        # Deterministic stream: groups of puts/accumulates (same-slot
+        # folds, window switches, bf16 + sparse codec edges), one flush
+        # per group => identical frame boundaries on both paths.
+        total = 0
+        for g in range(12):
+            grng = np.random.RandomState(100 + g)
+            for k in range(6):
+                name = "eqa" if (g + k) % 3 else "eqb"
+                dst = int(grng.randint(8))
+                src = (dst + 1) % 8 if grng.rand() < 0.5 else (dst - 1) % 8
+                op = T.OP_PUT if grng.rand() < 0.3 else T.OP_ACCUMULATE
+                row = grng.randn(5).astype(np.float32)
+                payload = row
+                roll = grng.rand()
+                if roll < 0.25 and op == T.OP_ACCUMULATE:
+                    idx = np.sort(grng.choice(5, size=2, replace=False))
+                    payload = T.sparse_encode(
+                        row[idx].astype(np.float32), idx.astype(np.int32))
+                    op |= T.OP_SPARSE_FLAG
+                elif roll < 0.5:
+                    import jax.numpy as jnp
+                    payload = np.asarray(row,
+                                         dtype=np.dtype(jnp.bfloat16))
+                    op |= T.OP_BF16_FLAG
+                client.send("127.0.0.1", server.port, op, name, src, dst,
+                            float(grng.rand() + 0.1), payload,
+                            p_weight=float(grng.rand()))
+                total += 1
+            client.flush()
+        with cv:
+            assert cv.wait_for(lambda: applied[0] >= total, timeout=30), \
+                (applied[0], total)
+        return {n: bf.win_state_dict(n) for n in ("eqa", "eqb")}
+    finally:
+        W._store.distrib = saved
+        client.stop()
+        server.stop()
+        bf.win_free("eqa")
+        bf.win_free("eqb")
+        if with_p:
+            bf.turn_off_win_ops_with_associated_p()
+
+
+@needs_win_native
+@pytest.mark.parametrize("with_p", [False, True])
+def test_native_vs_python_drain_state_equivalence_bitwise(coalesce_env,
+                                                          with_p):
+    """The BLUEFOG_TPU_WIN_NATIVE=0/1 end-to-end oracle: the SAME wire
+    stream (real loopback frames, controlled framing) lands BIT-IDENTICAL
+    window state — staging rows, version counters, associated-P — whether
+    the drain decode+fold ran in C++ or in Python."""
+    nat = _drive_store_stream(coalesce_env, use_native=True, with_p=with_p)
+    py = _drive_store_stream(coalesce_env, use_native=False, with_p=with_p)
+    for n in ("eqa", "eqb"):
+        for part in ("staging", "versions", "p_staging"):
+            assert set(py[n][part]) == set(nat[n][part]), (n, part)
+            for k, v in py[n][part].items():
+                np.testing.assert_array_equal(
+                    np.asarray(nat[n][part][k]), np.asarray(v),
+                    err_msg=f"{n}.{part}[{k}] (bitwise)")
+
+
+@needs_win_native
+def test_native_fold_counts_versions_and_batches(coalesce_env):
+    """Folded runs keep the per-message version ticks (3 accumulates into
+    one slot = one commit entry, +3 on the version counter) and the
+    native counters flow into the telemetry registry."""
+    import bluefog_tpu as bf
+    from bluefog_tpu import topology as topo
+    bf.init(lambda: topo.RingGraph(8))
+    coalesce_env(BLUEFOG_TPU_WIN_COALESCE=1,
+                 BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=500,
+                 BLUEFOG_TPU_WIN_NATIVE=1)
+    telemetry.reset()
+    x = np.zeros((8, 4), np.float32)
+    done = threading.Event()
+
+    def apply_items(items):
+        W._apply_inbound_items(items)
+        done.set()
+
+    server = T.WindowTransport(W._apply_inbound,
+                               apply_batch=W._apply_inbound_batch,
+                               apply_items=apply_items)
+    client = T.WindowTransport(lambda *a: None)
+    saved = W._store.distrib
+    W._store.distrib = _fake_distrib()
+    try:
+        assert server.native_path
+        assert bf.win_create(x, "fold", zero_init=True)
+        server.register_window("fold", 4)
+        row = np.arange(4, dtype=np.float32)
+        for _ in range(3):
+            client.send("127.0.0.1", server.port, T.OP_ACCUMULATE, "fold",
+                        1, 0, 2.0, row)
+        client.flush()
+        assert done.wait(timeout=20)
+        win = W._store.get("fold")
+        assert win.versions[(0, 1)] == 3
+        np.testing.assert_array_equal(win.staging[(0, 1)], 6 * row)
+        client.stop()
+        server.stop()
+        snap = telemetry.snapshot()
+        assert snap.get("bf_win_native_tx_frames_total", 0) > 0
+        assert snap.get("bf_win_native_rx_frames_total", 0) > 0
+        assert snap.get("bf_win_native_rx_commits_total", 0) >= 1
+        assert snap.get("bf_win_native_rx_folded_msgs_total", 0) >= 3
+    finally:
+        W._store.distrib = saved
+        try:
+            client.stop()
+            server.stop()
+        except Exception:
+            pass
+        import bluefog_tpu as bf2
+        bf2.win_free("fold")
