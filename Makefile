@@ -7,7 +7,7 @@ PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
-        placement-smoke synth-smoke hier-smoke chaos-smoke chaos
+        ffi-smoke placement-smoke synth-smoke hier-smoke chaos-smoke chaos
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -17,7 +17,7 @@ PYTEST = python -m pytest -q
 # window-transport hot path is fresh (graceful skip without a toolchain —
 # every native consumer has a Python fallback).
 test: native test-fast bench-comm-smoke prof-smoke transport-smoke \
-      placement-smoke synth-smoke hier-smoke chaos-smoke
+      ffi-smoke placement-smoke synth-smoke hier-smoke chaos-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -101,6 +101,17 @@ hier-smoke:
 transport-smoke:
 	python bench_comm.py --transport-smoke
 	env BLUEFOG_TPU_WIN_NATIVE=0 python bench_comm.py --transport-smoke
+
+# Zero-copy XLA put-path CI gate: loopback window-store puts of DEVICE
+# arrays through the BLUEFOG_TPU_WIN_XLA plan dispatch — asserts the FFI
+# path engaged and bf_win_host_copy_bytes_total reports ZERO put-side
+# staging bytes for dense f32 rows.  Graceful skip (not a failure) when
+# jax.ffi, the bf_xla native symbols, or the toolchain are absent — the
+# documented degraded mode.  No timing assertion here;
+# `python bench_comm.py --ffi` full runs gate the >= 2x dispatch-overhead
+# win over the PR-9 native put path for rows >= 4 KiB.
+ffi-smoke:
+	env JAX_PLATFORMS=cpu python bench_comm.py --ffi-smoke
 
 # Churn-controller CI gate: a real 4-process `bfrun --chaos` gang on the
 # CPU backend, one rank SIGKILLed mid-gossip — asserts the survivors reach

@@ -23,7 +23,19 @@ counts, asserts batched delivery happened, the native path actually
 engaged when available, and the batch + native telemetry series exist —
 no timing assertion (shared CI boxes jitter); the full variant asserts
 the >= 5x native messages/s win over the Python coalesced path for
-<= 256 B rows (10x target).
+<= 256 B rows (10x target), and additionally runs the ``ffi`` leg
+(below) when the capability is present.
+
+``--ffi`` / ``--ffi-smoke`` run the zero-copy XLA put-path microbench
+(``make ffi-smoke``): window puts of DEVICE arrays through a loopback
+store in three modes — ``legacy`` (Python coalesced sender), ``native``
+(the PR-9 host-staged put feeding the C++ sender) and ``ffi``
+(``BLUEFOG_TPU_WIN_XLA``: the XLA buffer pointer handed straight to the
+native put-plan executor) — reporting put-side dispatch us/row (flush
+factored out of the clock) and end-to-end msgs/s.  The smoke asserts
+the FFI path engaged and ``bf_win_host_copy_bytes_total`` reports ZERO
+put-side staging bytes for dense f32 rows; the full variant also
+asserts the >= 2x dispatch win over the native path for rows >= 4 KiB.
 
 ``--hier`` / ``--hier-smoke`` run the hierarchical-gossip report
 (``make hier-smoke``): flat static Exp2 vs the two-level mode (dense ICI
@@ -80,6 +92,18 @@ def _parse_args():
     p.add_argument("--transport-smoke", action="store_true",
                    help="tiny CI variant of --transport: asserts batched "
                         "delivery + metric presence, no timing assertion")
+    p.add_argument("--ffi", action="store_true",
+                   help="run the zero-copy XLA put-path microbench "
+                        "(BLUEFOG_TPU_WIN_XLA): put-side dispatch us/row "
+                        "and msgs/s of the legacy / PR-9 native / FFI "
+                        "window put paths through a loopback store; "
+                        "asserts the >= 2x dispatch win for rows >= 4 KiB "
+                        "and zero staging-copy bytes on the FFI leg")
+    p.add_argument("--ffi-smoke", action="store_true",
+                   help="tiny CI variant of --ffi (`make ffi-smoke`): "
+                        "asserts the FFI path engaged + zero staging-copy "
+                        "bytes, no timing assertion; graceful skip when "
+                        "jax.ffi or the native bf_xla symbols are absent")
     p.add_argument("--rows", type=int, default=5000,
                    help="transport bench: messages per mode (default 5000)")
     p.add_argument("--row-bytes", type=int, default=4096,
@@ -346,6 +370,35 @@ def transport_main(args) -> int:
     small_ratio = max((v for k, v in ratios.items() if k <= 256),
                       default=None)
 
+    # FFI leg (full runs only — it needs jax + the loopback store): the
+    # zero-copy XLA put path vs the PR-9 native and legacy Python put
+    # paths, folded into this report's detail.  Capability-gated with a
+    # graceful skip, like every other degraded mode here.
+    ffi_detail = None
+    ffi_value = None
+    if not smoke and native_ok:
+        from bluefog_tpu import _compat
+        from bluefog_tpu import native as _native
+        if _native.has_win_xla() and _compat.jax_ffi() is not None \
+                and os.environ.get("BLUEFOG_TPU_WIN_XLA") != "0":
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8")
+            # armed() is the full capability check (it also catches a
+            # non-CPU jax backend, where auto-disarm is the documented
+            # degraded mode): skip, never fail, when it says no.
+            from bluefog_tpu.ops import xlaffi as _xlaffi
+            if _xlaffi.armed():
+                ffi_value, ffi_detail, ffi_failures = _ffi_report(
+                    smoke=False)
+                failures.extend(f"ffi leg: {f}" for f in ffi_failures)
+            else:
+                ffi_detail = {"skipped": _xlaffi.disarm_reason()}
+        else:
+            ffi_detail = {"skipped": "jax.ffi or bf_xla symbols absent"}
+
     rc = 0
     for f in failures:
         print(f"bench_comm --transport: {f}", file=sys.stderr)
@@ -367,9 +420,240 @@ def transport_main(args) -> int:
             "legacy": legacy,
             "sweep": sweep,
             "peers": peers_tbl,
+            "ffi_dispatch_speedup": ffi_value,
+            "ffi": ffi_detail,
         },
     }))
     return rc
+
+
+def _ffi_one_mode(mode: str, elems: int, bursts: int, per_burst: int):
+    """Put-side microbench of one window put path through a loopback
+    store: ``legacy`` (Python coalesced sender, WIN_NATIVE=0), ``native``
+    (the PR-9 C++ sender fed by the host-staged put loop) and ``ffi``
+    (the zero-copy XLA plan dispatch, WIN_XLA=1).
+
+    Two numbers per mode:
+      * ``dispatch_us_per_row`` — the put-side HOST overhead: min over
+        bursts of the per-put dispatch wall time with the op-boundary
+        flush factored OUT of the clock (queued frames ship once per
+        burst outside it), so wire + drain time — identical across
+        modes — cannot mask the host-path difference the tentpole
+        targets;
+      * ``msgs_per_s`` — end-to-end blocking-put throughput (clock stops
+        at the last receiver apply), reported for context (no assertion:
+        on a 2-core CI box it measures scheduler contention as much as
+        the path).
+    """
+    import threading
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import transport as T
+    from bluefog_tpu.ops import window as W
+    from bluefog_tpu.ops import xlaffi
+    from bluefog_tpu.utils import config, telemetry
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("BLUEFOG_TPU_WIN_COALESCE",
+                  "BLUEFOG_TPU_WIN_COALESCE_LINGER_MS",
+                  "BLUEFOG_TPU_WIN_NATIVE", "BLUEFOG_TPU_WIN_XLA",
+                  "BLUEFOG_TPU_WIN_COMPRESSION")}
+    os.environ.update(
+        BLUEFOG_TPU_WIN_COALESCE="1",
+        # Long linger: nothing ships inside the timed dispatch region;
+        # the per-burst flush (outside the clock) puts it on the wire.
+        BLUEFOG_TPU_WIN_COALESCE_LINGER_MS="2000",
+        BLUEFOG_TPU_WIN_NATIVE="0" if mode == "legacy" else "1",
+        BLUEFOG_TPU_WIN_XLA="1" if mode == "ffi" else "0",
+        BLUEFOG_TPU_WIN_COMPRESSION="none")
+    config.reload()
+    xlaffi._reset_for_tests()
+    telemetry.reset()
+    bf.init(lambda: topo.RingGraph(8))
+    applied = [0]
+    cv = threading.Condition()
+
+    def bump(k):
+        with cv:
+            applied[0] += k
+            cv.notify_all()
+
+    server = T.WindowTransport(
+        lambda *a: bump(1),
+        apply_batch=lambda m: bump(len(m)),
+        apply_items=lambda it: bump(
+            sum((p[5] + p[6]) if k else 1 for k, p in it)))
+    client = T.WindowTransport(lambda *a: None)
+    saved_distrib = W._store.distrib
+    real_flush = W._flush_transport
+    x = np.zeros((8, elems), np.float32)
+    try:
+        assert bf.win_create(x, "ffibench", zero_init=True)
+        server.register_window("ffibench", elems)
+        # Even ranks owned here; odd ranks' owner is the loopback server
+        # feeding the same store — the ring's 8 even->odd out-edges all
+        # travel the wire.
+        W._store.distrib = W._Distrib(
+            client, {r: r % 2 for r in range(8)},
+            {0: ("127.0.0.1", 1), 1: ("127.0.0.1", server.port)}, 0)
+        t = jnp.asarray(np.random.RandomState(0)
+                        .randn(8, elems).astype(np.float32))
+        t.block_until_ready()
+        win = W._store.get("ffibench")
+        edges = W._resolve_edge_weights(None, win.out_nbrs, 1.0,
+                                        ranks=win.owned)
+        W._do_put("ffibench", t, edges, False, False)  # warm plan/keys
+        total_puts = 1
+        times = []
+        for _ in range(bursts):
+            W._flush_transport = lambda *a, **k: None
+            t0 = time.perf_counter()
+            for _ in range(per_burst):
+                W._do_put("ffibench", t, edges, False, False)
+            times.append((time.perf_counter() - t0) / per_burst)
+            W._flush_transport = real_flush
+            W.win_flush()
+            total_puts += per_burst
+            with cv:
+                assert cv.wait_for(
+                    lambda: applied[0] >= total_puts * 8, timeout=120), \
+                    (applied[0], total_puts * 8)
+        # End-to-end throughput: blocking puts, clock to the last apply.
+        e2e_puts = max(per_burst // 2, 20)
+        before = applied[0]
+        t0 = time.perf_counter()
+        for _ in range(e2e_puts):
+            bf.win_put(t, "ffibench", require_mutex=False)
+        with cv:
+            assert cv.wait_for(
+                lambda: applied[0] >= before + e2e_puts * 8, timeout=120)
+        e2e_dt = time.perf_counter() - t0
+        snap = telemetry.snapshot()
+        copies = {p: snap.get(
+            f'bf_win_host_copy_bytes_total{{path="{p}"}}', 0)
+            for p in ("device_get", "edge_temp", "enqueue")}
+        return {
+            "mode": mode,
+            "row_bytes": elems * 4,
+            "dispatch_us_per_put": round(min(times) * 1e6, 2),
+            "dispatch_us_per_row": round(min(times) * 1e6 / 8, 3),
+            "msgs_per_s": round(e2e_puts * 8 / e2e_dt, 1),
+            "ffi_engaged": snap.get("bf_win_xla_puts_total", 0) > 0,
+            "host_copy_bytes": copies,
+        }
+    finally:
+        W._flush_transport = real_flush
+        W._store.distrib = saved_distrib
+        bf.win_free("ffibench")
+        client.stop()
+        server.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        config.reload()
+        xlaffi._reset_for_tests()
+
+
+def ffi_main(args) -> int:
+    """The zero-copy XLA put-path report (and the `make ffi-smoke` CI
+    gate).  Graceful skip — not a failure — when jax.ffi or the native
+    ``bf_xla`` symbols are absent: that is the documented degraded mode
+    (the host-staged PR-9 path serves every put)."""
+    import sys
+
+    smoke = args.ffi_smoke
+    # The loopback store runs on the CPU backend's virtual mesh; size it
+    # BEFORE jax initializes (same rule as the schedule bench).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+
+    from bluefog_tpu import _compat, native
+
+    if not (native.available() and native.has_win_xla()
+            and _compat.jax_ffi() is not None):
+        reason = ("native core lacks bf_xla symbols"
+                  if native.available() else "native core not built")
+        if _compat.jax_ffi() is None:
+            reason = "jax has no ffi module"
+        print(json.dumps({
+            "metric": "win_put_ffi_dispatch_speedup", "value": None,
+            "unit": "x", "status": "skipped",
+            "detail": {"reason": reason}}))
+        return 0
+    from bluefog_tpu.ops import xlaffi
+    if not xlaffi.armed():
+        print(json.dumps({
+            "metric": "win_put_ffi_dispatch_speedup", "value": None,
+            "unit": "x", "status": "skipped",
+            "detail": {"reason": xlaffi.disarm_reason()}}))
+        return 0
+
+    value, detail, failures = _ffi_report(smoke)
+    rc = 0
+    for f in failures:
+        print(f"bench_comm --ffi: {f}", file=sys.stderr)
+        rc = 1
+    print(json.dumps({
+        "metric": "win_put_ffi_dispatch_speedup",
+        "value": value,
+        "unit": "x",
+        "detail": detail,
+    }))
+    return rc
+
+
+def _ffi_report(smoke: bool):
+    """Run the FFI put-path sweep; returns ``(speedup, detail,
+    failures)``.  Shared by ``--ffi[-smoke]`` and the full
+    ``--transport`` run's ffi leg."""
+    bursts, per_burst = (3, 30) if smoke else (10, 100)
+    sizes = [1024] if smoke else [256, 1024, 16384]  # f32 elems per row
+    sweep, failures = [], []
+    for elems in sizes:
+        for mode in (["native", "ffi"] if smoke
+                     else ["legacy", "native", "ffi"]):
+            res = _ffi_one_mode(mode, elems, bursts, per_burst)
+            sweep.append(res)
+            if mode == "ffi":
+                if not res["ffi_engaged"]:
+                    failures.append(
+                        f"FFI path armed but did not engage ({elems} elems)")
+                bad = {p: b for p, b in res["host_copy_bytes"].items()
+                       if b > 0}
+                if bad:
+                    failures.append(
+                        f"FFI leg reported staging copies {bad} "
+                        f"({elems} elems) — the zero-copy contract broke")
+
+    def _us(mode, elems):
+        for r in sweep:
+            if r["mode"] == mode and r["row_bytes"] == elems * 4:
+                return r["dispatch_us_per_row"]
+        return None
+
+    ratios = {}
+    for elems in sizes:
+        nat, ffi = _us("native", elems), _us("ffi", elems)
+        if nat and ffi:
+            ratios[elems * 4] = round(nat / ffi, 2)
+    big_ratio = min((v for k, v in ratios.items() if k >= 4096),
+                    default=None)
+    if not smoke and (big_ratio is None or big_ratio < 2.0):
+        failures.append(
+            f"FFI put dispatch speedup {big_ratio}x < 2x vs the PR-9 "
+            "native path for rows >= 4 KiB")
+    detail = {"smoke": smoke, "ratios_by_row_bytes": ratios,
+              "sweep": sweep}
+    return big_ratio, detail, failures
 
 
 def _effective_w(sched, n):
@@ -972,6 +1256,8 @@ def synth_main(args) -> int:
 
 def main():
     args = _parse_args()
+    if args.ffi or args.ffi_smoke:
+        return ffi_main(args)
     if args.transport or args.transport_smoke:
         return transport_main(args)
     if args.placement or args.placement_smoke:

@@ -120,6 +120,10 @@ from bluefog_tpu.ops.window import (  # noqa: F401
     turn_off_win_ops_with_associated_p,
 )
 
+# Zero-copy XLA window put path (BLUEFOG_TPU_WIN_XLA) diagnostics:
+# armed/disarm-reason/handler capability, for operators and the bench.
+from bluefog_tpu.ops.xlaffi import info as win_xla_info  # noqa: F401
+
 from bluefog_tpu import data  # noqa: F401  (DistributedSampler, ShardedLoader)
 from bluefog_tpu import optim  # noqa: F401  (Distributed*Optimizer family)
 

@@ -84,6 +84,25 @@ def tpu_compiler_params(**kwargs):
     return cls(**kwargs)
 
 
+def jax_ffi():
+    """The jax FFI module across the namespace move, or None.
+
+    jax >= 0.5 spells it ``jax.ffi``; 0.4.x carried it as
+    ``jax.extend.ffi`` (same surface: ``ffi_call`` /
+    ``register_ffi_target`` / ``pycapsule``).  Returns None on releases
+    with neither — consumers (the zero-copy window put path,
+    ``ops/xlaffi.py``) must treat that as "capability absent" and keep
+    their host-path fallback, never raise."""
+    mod = getattr(jax, "ffi", None)
+    if mod is not None and hasattr(mod, "ffi_call"):
+        return mod
+    try:
+        from jax.extend import ffi as _xffi
+    except ImportError:
+        return None
+    return _xffi if hasattr(_xffi, "ffi_call") else None
+
+
 def checkpoint_tree_metadata(checkpointer, path):
     """Tree metadata of a saved orbax checkpoint, across the metadata-API
     move: modern orbax returns a ``CheckpointMetadata`` wrapper exposing

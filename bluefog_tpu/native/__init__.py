@@ -152,12 +152,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bf_wintx_start.restype = ctypes.c_void_p
         lib.bf_wintx_start.argtypes = [u64, u64, i32, i32, dbl]
         lib.bf_wintx_send.restype = i32
-        # payload rides as c_char_p: the producer fast path passes BYTES
-        # (ndarray.tobytes()), and bytes->char* is ctypes' cheapest
-        # pointer conversion by a wide margin.
+        # payload rides as c_void_p, which ctypes accepts as EITHER bytes
+        # (small rows: tobytes() + the cheapest pointer conversion) or a
+        # raw int address (large rows: the .ctypes pointer path — past
+        # ~64 KiB the byte copy dwarfs the ~µs pointer extraction it was
+        # avoiding; see transport._ctypes_payload).
         lib.bf_wintx_send.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, i32, ctypes.c_uint8,
-            ctypes.c_char_p, i32, i32, dbl, dbl, ctypes.c_char_p, u64, i32]
+            ctypes.c_char_p, i32, i32, dbl, dbl, ctypes.c_void_p, u64, i32]
         lib.bf_wintx_flush.restype = i32
         lib.bf_wintx_flush.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                        i32, dbl]
@@ -177,6 +179,37 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                        i32, ptr(WinTxStats)]
         lib.bf_wintx_stop.restype = None
         lib.bf_wintx_stop.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        pass
+    # Zero-copy XLA put plans (xlacall.cc, this PR's symbols) — bound in
+    # their own try so an older .so missing them degrades to the PR-9
+    # path alone (has_win_xla() reports the capability).
+    try:
+        lib.bf_xla_plan_new.restype = i64
+        lib.bf_xla_plan_new.argtypes = [ctypes.c_char_p, i64, i32, i32, dbl]
+        lib.bf_xla_plan_edge.restype = i32
+        lib.bf_xla_plan_edge.argtypes = [
+            i64, i32, ctypes.c_char_p, i32, ctypes.c_uint8, i32, i32, dbl,
+            i64]
+        lib.bf_xla_plan_set_p.restype = i32
+        lib.bf_xla_plan_set_p.argtypes = [i64, ptr(dbl), i32]
+        # data rides as c_void_p: the dispatcher passes the RAW XLA buffer
+        # pointer (an int) — the zero-copy contract of the whole path.
+        lib.bf_xla_plan_run.restype = i32
+        lib.bf_xla_plan_run.argtypes = [i64, ctypes.c_void_p,
+                                        ctypes.c_void_p, u64]
+        lib.bf_xla_plan_free.restype = i32
+        lib.bf_xla_plan_free.argtypes = [i64]
+        lib.bf_xla_drop_residuals.restype = None
+        lib.bf_xla_drop_residuals.argtypes = [ctypes.c_char_p]
+        lib.bf_xla_take_residual.restype = i64
+        lib.bf_xla_take_residual.argtypes = [ctypes.c_char_p, i32, i32,
+                                             ptr(ctypes.c_float), i64]
+        lib.bf_xla_add_residual.restype = i32
+        lib.bf_xla_add_residual.argtypes = [ctypes.c_char_p, i32, i32,
+                                            ptr(ctypes.c_float), i64]
+        lib.bf_xla_has_handler.restype = i32
+        lib.bf_xla_has_handler.argtypes = []
     except AttributeError:
         pass
     return lib
@@ -301,6 +334,26 @@ def has_win_native() -> bool:
     return (handle is not None and not _stale
             and hasattr(handle, "bf_wintx_start")
             and hasattr(handle, "bf_winsvc_drain"))
+
+
+def has_win_xla() -> bool:
+    """True when the loaded library carries the zero-copy XLA put plans
+    (``bf_xla_plan_*``, xlacall.cc) and is not stale.  The in-program
+    ``bf_xla_win_put`` FFI handler is a further capability on top —
+    :func:`has_xla_handler` — absent when the jaxlib FFI headers were
+    missing at build time."""
+    handle = lib()
+    return (handle is not None and not _stale
+            and hasattr(handle, "bf_xla_plan_new")
+            and hasattr(handle, "bf_xla_plan_run"))
+
+
+def has_xla_handler() -> bool:
+    """True when the build also carries the ``bf_xla_win_put`` XLA FFI
+    custom-call handler (compiled against the jaxlib FFI headers)."""
+    handle = lib()
+    return (has_win_xla() and hasattr(handle, "bf_xla_has_handler")
+            and bool(handle.bf_xla_has_handler()))
 
 
 _FASTCALL_ABI = 1

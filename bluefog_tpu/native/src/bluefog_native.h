@@ -248,6 +248,68 @@ void bf_wintx_stats(bf_wintx_t* t, const char* host, int32_t port,
  * fast), join every worker, free the transport. */
 void bf_wintx_stop(bf_wintx_t* t);
 
+/* -------- xlacall.cc: zero-copy device->wire put plans (XLA FFI) --------
+ *
+ * A "put plan" is the routing metadata of one window put/accumulate
+ * dispatch: per remote edge, the peer endpoint, wire op, (src, dst),
+ * weight, and the ROW offset into the caller's device buffer.  Executing
+ * a plan hands each row pointer straight from the buffer into the
+ * bf_wintx_* per-peer arenas (one arena copy, zero host staging copies):
+ * the eager window put path drives it through bf_xla_plan_run with the
+ * XLA buffer pointer (CPU backend: device memory IS host memory), and
+ * the `bf_xla_win_put` XLA FFI handler (registered via jax.ffi) runs the
+ * SAME executor from inside a compiled program.  Codecs (bf16 round-to-
+ * nearest-even, sparse top-|magnitude| with sender error-feedback
+ * residuals keyed by (window, src, dst) exactly like ops/window.py's
+ * Python residuals) are applied during the encode. */
+
+/* codec: 0 dense f32, 1 bf16, 2 sparse(frac).  Returns a plan id > 0,
+ * or -4 if the window name exceeds the receiver's 128-byte field. */
+int64_t bf_xla_plan_new(const char* name, int64_t elems, int32_t n_edges,
+                        int32_t codec, double sparse_frac);
+
+/* Fill edge slot i (0-based).  op carries the BASE wire code (codec flag
+ * bits are applied by the encoder).  row is the row index into the
+ * (rows, elems) input buffer.  Returns 0, -9 unknown plan / bad index. */
+int32_t bf_xla_plan_edge(int64_t plan, int32_t i, const char* host,
+                         int32_t port, uint8_t op, int32_t src, int32_t dst,
+                         double weight, int64_t row);
+
+/* Refresh every edge's associated-P mass before a dispatch (push-sum
+ * runs; n must equal n_edges).  Returns 0, -9 unknown plan / size. */
+int32_t bf_xla_plan_set_p(int64_t plan, const double* p, int32_t n);
+
+/* Execute a plan against a raw f32 buffer of total_elems elements,
+ * enqueueing every edge's encoded row onto tx's per-peer queues (the
+ * eager entry; the XLA FFI handler calls the same executor with the
+ * buffer XLA hands it).  Returns 0, -9 unknown plan, -10 a row offset
+ * falls outside the buffer, -5/-7/... any bf_wintx_send error (stops at
+ * the first failing edge, like the Python per-edge loop). */
+int32_t bf_xla_plan_run(int64_t plan, const void* tx, const float* data,
+                        uint64_t total_elems);
+
+int32_t bf_xla_plan_free(int64_t plan);
+
+/* Purge sparse error-feedback residuals (one window's, or all when name
+ * is NULL) — the native twin of ops/window._drop_ef_residuals. */
+void bf_xla_drop_residuals(const char* name);
+
+/* Cross-store residual hand-off, so a put stream that mixes the FFI and
+ * host paths on one (window, src, dst) edge never strands mass in
+ * whichever store the other path cannot see (residuals are additive:
+ * merging is exact).  take: copy-and-erase the native residual into out
+ * (returns the element count, 0 if none, -1 if cap is too small — the
+ * residual stays).  add: fold data into (or create) the native
+ * residual. */
+int64_t bf_xla_take_residual(const char* name, int32_t src, int32_t dst,
+                             float* out, int64_t cap);
+int32_t bf_xla_add_residual(const char* name, int32_t src, int32_t dst,
+                            const float* data, int64_t n);
+
+/* 1 when this build carries the `bf_xla_win_put` XLA FFI handler (the
+ * jaxlib FFI headers were present at compile time), else 0. */
+int32_t bf_xla_has_handler(void);
+
 #ifdef __cplusplus
 }
 #endif

@@ -123,6 +123,30 @@ def _op_label(op: int) -> str:
     return _OP_NAMES.get(op & ~OP_FLAG_MASK, str(op))
 
 
+# Payload size above which the ctypes-fallback send passes the RAW data
+# pointer instead of tobytes(): below it, bytes→char* is ctypes' cheapest
+# conversion and the copy is ~free; above it the byte copy dwarfs the ~µs
+# pointer-extraction cost it was avoiding (the copy scales with the row,
+# the pointer does not).  Covered by a unit test in tests/test_win_xla.py.
+CTYPES_PTR_BYTES = 64 * 1024
+
+
+def _ctypes_payload(tensor: np.ndarray):
+    """Payload argument for ``bf_wintx_send``'s ctypes binding (declared
+    ``c_void_p``, which accepts both forms): ``(arg, nbytes, keepalive)``
+    — bytes below :data:`CTYPES_PTR_BYTES`, the raw ``.ctypes`` address
+    above it.  ``keepalive`` must stay referenced until the call returns
+    (the native side copies into its arena synchronously)."""
+    t = tensor if (tensor.__class__ is np.ndarray
+                   and tensor.flags.c_contiguous) \
+        else np.ascontiguousarray(tensor)
+    if t.nbytes >= CTYPES_PTR_BYTES:
+        return t.ctypes.data, t.nbytes, t
+    from bluefog_tpu.ops import xlaffi
+    xlaffi.count_host_copy(t.nbytes, "enqueue")
+    return t.tobytes(), t.nbytes, t
+
+
 # ---------------------------------------------------------------------------
 # sparse:<frac> payload codec (OP_SPARSE_FLAG)
 # ---------------------------------------------------------------------------
@@ -495,6 +519,7 @@ class WindowTransport:
             self._nameb: Dict[str, bytes] = {}
             self._peer_addrs: set = set()
             self._tx_last = native.WinTxStats()
+            self._tx_pump_last = 0.0  # rate-limits the stats pump
             self._rx_last = native.WinRxStats()
             self._peer_last: Dict[Tuple[str, int], Tuple] = {}
             # Drain buffers (grown on demand): ordered item array, raw
@@ -554,22 +579,22 @@ class WindowTransport:
                                        dst, float(weight), float(p_weight),
                                        tensor, urgent)
                 except (BufferError, TypeError):
+                    blob = np.ascontiguousarray(tensor).tobytes()
+                    from bluefog_tpu.ops import xlaffi
+                    xlaffi.count_host_copy(len(blob), "enqueue")
                     rc = self._fc_send(
                         self._tx, hb, port, op, nb, src, dst,
-                        float(weight), float(p_weight),
-                        np.ascontiguousarray(tensor).tobytes(), urgent)
+                        float(weight), float(p_weight), blob, urgent)
             else:
-                # ctypes fallback.  tobytes() is deliberate: extracting a
-                # raw data POINTER from an ndarray via .ctypes costs ~4x
-                # the small-row byte copy.
-                if tensor.__class__ is np.ndarray \
-                        and tensor.flags.c_contiguous:
-                    payload = tensor.tobytes()
-                else:
-                    payload = np.ascontiguousarray(tensor).tobytes()
+                # ctypes fallback: tobytes() for small rows (bytes→char*
+                # is ctypes' cheapest conversion and the copy is ~free at
+                # gossip-row sizes); past CTYPES_PTR_BYTES the raw data
+                # pointer ships instead — above ~64 KiB the byte copy
+                # dwarfs the ~µs pointer-extraction cost it was avoiding.
+                arg, nbytes, keepalive = _ctypes_payload(tensor)
                 rc = self._tx_send(self._tx, hb, port, op, nb, src, dst,
-                                   weight, p_weight, payload, len(payload),
-                                   urgent)
+                                   weight, p_weight, arg, nbytes, urgent)
+                del keepalive  # native enqueue copied before returning
             if rc == 0:
                 return
             if rc == -4:
@@ -612,6 +637,8 @@ class WindowTransport:
             return
         # Coalesced path: own a copy (the caller may free/reuse the array
         # the moment we return) and enqueue; the peer's worker ships it.
+        from bluefog_tpu.ops import xlaffi
+        xlaffi.count_host_copy(payload.size, "enqueue")
         msg: Msg = (op, name, src, dst, float(weight), float(p_weight),
                     payload.tobytes())
         self._sender(host, port).enqueue(
@@ -792,18 +819,29 @@ class WindowTransport:
                 "message(s) failed on a sender worker (see the "
                 "bluefog_tpu log for the peer and cause)")
 
-    def _pump_native_tx_stats(self) -> None:
+    def _pump_native_tx_stats(self, tx=None, force: bool = False) -> None:
         """Diff the cumulative native sender counters into the telemetry
         registry — the SAME series the Python path maintains per message,
         observed from the native counters at flush boundaries instead
         (plus the ``bf_win_native_*`` markers).  Histogram buckets merge
-        directly: the C++ core uses the shared boundary table."""
+        directly: the C++ core uses the shared boundary table.
+
+        Rate-limited (≥50 ms between pumps unless ``force``): every
+        window op flushes at its boundary, and ~1 ctypes stats call per
+        peer per op would cost a meaningful slice of the zero-copy
+        dispatch budget for series that only need scrape-rate freshness.
+        ``stop()`` forces a final pump so nothing is lost."""
         from bluefog_tpu.utils import telemetry
-        if self._tx is None or not telemetry.enabled():
+        tx = self._tx if tx is None else tx
+        if tx is None or not telemetry.enabled():
             return
+        now = time.monotonic()
+        if not force and now - self._tx_pump_last < 0.05:
+            return
+        self._tx_pump_last = now
         with self._stats_lock:
             cur = native.WinTxStats()
-            self._lib.bf_wintx_stats(self._tx, None, 0, ctypes.byref(cur))
+            self._lib.bf_wintx_stats(tx, None, 0, ctypes.byref(cur))
             last, self._tx_last = self._tx_last, cur
             for i in range(16):
                 d = cur.by_op[i] - last.by_op[i]
@@ -835,7 +873,7 @@ class WindowTransport:
             # Per-peer series (bytes, errors, retries, queue depth).
             for (h, p) in list(self._peer_addrs):
                 ps = native.WinTxStats()
-                self._lib.bf_wintx_stats(self._tx, h.encode(), p,
+                self._lib.bf_wintx_stats(tx, h.encode(), p,
                                          ctypes.byref(ps))
                 peer = f"{h}:{p}"
                 lb, le, lr = self._peer_last.get((h, p), (0, 0, 0))
@@ -1246,13 +1284,19 @@ class WindowTransport:
                 self._apply(*m)
 
     def stop(self):
-        if self._tx is not None:
+        # Unpublish the native sender handle FIRST: concurrent senders
+        # (heartbeat thread, overlapped puts, the XLA plan dispatch) gate
+        # on `self._tx`; nulling it before bf_wintx_stop frees the
+        # struct shrinks the use-after-free window to callers already
+        # past the read (whom the C++ inflight guard + stopping flag
+        # then handle).
+        tx, self._tx = self._tx, None
+        if tx is not None:
             try:
-                self._pump_native_tx_stats()
+                self._pump_native_tx_stats(tx, force=True)
             except Exception:  # noqa: BLE001 — telemetry must not block stop
                 pass
-            self._lib.bf_wintx_stop(self._tx)
-            self._tx = None
+            self._lib.bf_wintx_stop(tx)
         with self._senders_lock:
             senders = list(self._senders.values())
             self._senders.clear()

@@ -72,6 +72,10 @@ from bluefog_tpu.ops.transport import (  # noqa: E402
     OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL, OP_MEMBER,
     OP_BF16_FLAG, OP_SPARSE_FLAG, OP_FLAG_MASK, sparse_encode,
     sparse_decode)
+# Zero-copy XLA put path (BLUEFOG_TPU_WIN_XLA): plan-compiled dispatch of
+# remote put edges straight from the device buffer into the native
+# per-peer arenas, plus the host-staging-copy accounting helpers.
+from bluefog_tpu.ops import xlaffi  # noqa: E402
 
 # Hard cap on waiting for a peer's reply.  Env-overridable so fault-injection
 # tests (and impatient deployments) can bound partition detection; the
@@ -269,12 +273,17 @@ def _shutdown_transport() -> None:
     if d is not None:
         from bluefog_tpu.utils import stall
         stall.set_peer_probe(None)
+        # Cached XLA put plans route onto this transport's native sender;
+        # they must die before it does (a later re-init builds fresh ones
+        # keyed on the new directory).
+        xlaffi.invalidate()
         d.transport.stop()
 
 
 def _to_numpy(x) -> np.ndarray:
+    from bluefog_tpu.utils import telemetry
     try:
-        return np.asarray(jax.device_get(x))
+        out = np.asarray(jax.device_get(x))
     except RuntimeError:
         # Multi-host sharded array: assemble the addressable rows; rows of
         # ranks owned elsewhere are zero-filled and never read (only owned
@@ -283,7 +292,14 @@ def _to_numpy(x) -> np.ndarray:
         out = np.zeros(x.shape, dtype=np.dtype(x.dtype.name))
         for shard in x.addressable_shards:
             out[shard.index] = np.asarray(shard.data)
+        xlaffi.count_host_copy(out.nbytes, "device_get")
         return out
+    # Host-staging accounting (verified by pointer identity: CPU-backend
+    # jax aliases the buffer and counts nothing): the device_get copy is
+    # the first of the staging copies the XLA put path eliminates.
+    if telemetry.enabled() and xlaffi._materialize_copied(x, out):
+        xlaffi.count_host_copy(out.nbytes, "device_get")
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -463,13 +479,16 @@ _ef_lock = threading.Lock()
 
 
 def _drop_ef_residuals(name: Optional[str] = None) -> None:
-    """Forget sender residuals (all windows, or one freed window's)."""
+    """Forget sender residuals (all windows, or one freed window's) —
+    Python dict AND the native XLA-put twin (plus that path's cached
+    plans, whose edge routing dies with the window)."""
     with _ef_lock:
         if name is None:
             _ef_residuals.clear()
         else:
             for k in [k for k in _ef_residuals if k[0] == name]:
                 _ef_residuals.pop(k, None)
+    xlaffi.invalidate(name)
 
 
 def _sparse_payload(name: str, src: int, dst: int,
@@ -482,10 +501,18 @@ def _sparse_payload(name: str, src: int, dst: int,
     the new residual — classic EF-SGD compression applied at the wire."""
     flat = payload.reshape(-1)
     key = (name, src, dst)
+    # A put stream that switched from the XLA plan path to this host
+    # path would otherwise strand mass in the NATIVE residual store:
+    # take it (copy-and-erase) and fold it in — residuals are additive,
+    # so the merge is exact.  None on pure-host runs (no native store
+    # entry) and pure-FFI runs (this encoder never runs).
+    nat = xlaffi.take_native_residual(name, src, dst, flat.size)
     with _ef_lock:
         res = _ef_residuals.get(key)
         v = flat + res if res is not None and res.shape == flat.shape \
             else flat.copy()
+        if nat is not None:
+            v += nat
         k = max(1, int(np.ceil(frac * v.size)))
         if k >= v.size:
             idx = np.arange(v.size, dtype=np.int64)
@@ -1234,7 +1261,7 @@ def _validate_payload(win: _Window, t: np.ndarray, op: str) -> None:
             f"this window uses the {kind} layout")
 
 
-def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
+def _do_put(name: str, tensor, edges: Dict[tuple, float],
             require_mutex: bool, accumulate: bool, self_weight=None) -> None:
     from bluefog_tpu.utils.timeline import op_span
     try:
@@ -1251,16 +1278,37 @@ def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
     # any enqueue): failures on other peers' senders never fail this op.
     tok = (d.transport.error_token({d.proc_addr[p] for p in remote_procs})
            if remote_procs else None)
-    for (src, dst), w in edges.items():
-        if not _owns(src):
-            continue  # src's owner performs this edge
-        row = win.row_of[src]  # caller-side row index of the source rank
-        # Per-edge span: the host-side path can show what one fused XLA
-        # program cannot — each (src, dst) transfer individually (the
-        # reference's per-phase timeline granularity, applied per edge).
-        with op_span(f"{kind}.{name}.{src}->{dst}", "COMMUNICATE"):
-            _do_put_edge(win, name, tensor, row, src, dst, w, op,
-                         accumulate, require_mutex)
+    # Zero-copy XLA put path (BLUEFOG_TPU_WIN_XLA): when the payload is a
+    # committed device array, the remote edges dispatch as ONE native
+    # plan run straight off the XLA buffer — no device_get, no per-edge
+    # temp, no tobytes.  Plan build failure (and =0) falls back to the
+    # host-staged per-edge loop below, which stays byte-identical on the
+    # wire (the oracle contract).
+    plan = None
+    if remote_procs and xlaffi.keep_device_ok(tensor, win):
+        remote_edges = tuple(
+            ((src, dst), w) for (src, dst), w in edges.items()
+            if _owns(src) and not _owns(dst))
+        plan = xlaffi.prepare_put(d, win, name, op, remote_edges,
+                                  per_edge=require_mutex)
+    if plan is not None:
+        _ffi_put(win, name, tensor, edges, plan, op, accumulate,
+                 require_mutex, kind)
+    else:
+        if not isinstance(tensor, np.ndarray):
+            # FFI-armed dispatch fell through: materialize once and take
+            # the host-staged path for this put.
+            tensor = _to_numpy(tensor)
+        for (src, dst), w in edges.items():
+            if not _owns(src):
+                continue  # src's owner performs this edge
+            row = win.row_of[src]  # caller-side row index of the src rank
+            # Per-edge span: the host-side path can show what one fused
+            # XLA program cannot — each (src, dst) transfer individually
+            # (the reference's per-phase timeline granularity, per edge).
+            with op_span(f"{kind}.{name}.{src}->{dst}", "COMMUNICATE"):
+                _do_put_edge(win, name, tensor, row, src, dst, w, op,
+                             accumulate, require_mutex)
     # Op boundary: every remote edge enqueued above must be handed to TCP
     # (and any sender-worker error surfaced on THIS op's future) before the
     # op reports complete — win_wait keeps its local-completion meaning.
@@ -1269,7 +1317,98 @@ def _do_put(name: str, tensor: np.ndarray, edges: Dict[tuple, float],
     if remote_procs:
         _flush_transport(remote_procs, since=tok)
     if self_weight is not None:
-        _publish_self(win, tensor, self_weight)
+        host_t = tensor if isinstance(tensor, np.ndarray) \
+            else xlaffi.host_view(tensor)
+        _publish_self(win, host_t, self_weight)
+
+
+def _ffi_put(win, name, tensor, edges, plan, op, accumulate,
+             require_mutex, kind) -> None:
+    """Dispatch one put through the compiled XLA plan: local edges keep
+    the legacy in-store write (through a zero-copy host view), remote
+    edges hand the device buffer pointer to the native plan executor —
+    under each edge's distributed mutex when the caller asked for writer
+    exclusion (per-edge plans preserve the one-hold-at-a-time rule)."""
+    from bluefog_tpu.utils.timeline import op_span
+    d = _store.distrib
+    local = [((src, dst), w) for (src, dst), w in edges.items()
+             if _owns(src) and _owns(dst)]
+    if local:
+        host_t = xlaffi.host_view(tensor)
+        for (src, dst), w in local:
+            with op_span(f"{kind}.{name}.{src}->{dst}", "COMMUNICATE"):
+                _do_put_edge(win, name, host_t, win.row_of[src], src, dst,
+                             w, op, accumulate, require_mutex)
+    tx = getattr(d.transport, "_tx", None)
+    if not tx:
+        raise ConnectionError(
+            f"{kind}({name!r}): window transport is stopping")
+    if plan.codec == 2:
+        # Sparse error feedback: residuals a previous HOST-path send left
+        # in the Python dict must ride this native dispatch — push them
+        # into the native store (additive merge, exact) so a mixed-path
+        # stream never strands mass on either side.
+        with _ef_lock:
+            taken = []
+            for _pid, grp in plan.groups:
+                for (src, dst), _w in grp:
+                    r = _ef_residuals.pop((name, src, dst), None)
+                    if r is not None:
+                        taken.append((src, dst, r))
+        for src, dst, r in taken:
+            xlaffi.push_native_residual(name, src, dst, r)
+    # dispatch_lock serializes the P refresh + run per cached plan:
+    # concurrent puts sharing the plan must each ship their OWN mass.
+    with plan.dispatch_lock, op_span(f"{kind}.{name}.xla", "COMMUNICATE"):
+        if _store.associated_p_enabled:
+            # One snapshot of the P masses for every remote edge — the
+            # same values the per-edge loop reads under win.lock (self-
+            # publish only happens after the sends, so nothing can
+            # interleave).
+            with win.lock:
+                for pid, grp in plan.groups:
+                    xlaffi.set_group_p(
+                        pid, [w * float(win.p_main[src])
+                              for (src, _dst), w in grp])
+            plan.p_set = True
+        elif plan.p_set:
+            # Associated-P was turned OFF since this plan last shipped:
+            # re-zero the cached masses or the wire would carry stale P
+            # (the host-path oracle sends 0.0).
+            for pid, grp in plan.groups:
+                xlaffi.set_group_p(pid, [0.0] * len(grp))
+            plan.p_set = False
+        for pid, grp in plan.groups:  # one group (one mutex hold) per
+            if require_mutex:         # edge in the require_mutex form
+                (src, dst), _w = grp[0]
+                with _remote_mutex(name, dst, src):
+                    _ffi_run_group(win, name, plan, pid, grp, tx, tensor,
+                                   require_mutex)
+            else:
+                _ffi_run_group(win, name, plan, pid, grp, tx, tensor,
+                               require_mutex)
+    xlaffi.record_dispatch(plan)
+
+
+def _ffi_run_group(win, name, plan, pid, grp, tx, tensor,
+                   require_mutex) -> None:
+    """Run one plan group, rebuilding once if the native plan was evicted
+    or invalidated between the cache fetch and this dispatch (nothing was
+    sent in that case — the executor validates the plan id first)."""
+    d = _store.distrib
+    try:
+        xlaffi.run_group(pid, tx, tensor)
+    except xlaffi.PlanVanished:
+        fresh = xlaffi.prepare_put(d, win, name, plan.op, tuple(grp),
+                                   per_edge=False)
+        if fresh is None:
+            raise
+        if _store.associated_p_enabled:
+            with win.lock:
+                xlaffi.set_group_p(
+                    fresh.groups[0][0],
+                    [w * float(win.p_main[src]) for (src, _dst), w in grp])
+        xlaffi.run_group(fresh.groups[0][0], tx, tensor)
 
 
 def _do_put_edge(win, name, tensor, row, src, dst, w, op, accumulate,
@@ -1288,6 +1427,10 @@ def _do_put_edge(win, name, tensor, row, src, dst, w, op, accumulate,
         # with frombuffer(win.dtype), so a mismatched payload would be
         # dropped on exactly the cross-process edges.
         payload = np.ascontiguousarray(tensor[row], dtype=win.dtype)
+        if payload.base is None and payload is not tensor:
+            # ascontiguousarray materialized (dtype cast or a strided
+            # input): a real host staging copy, not a view.
+            xlaffi.count_host_copy(payload.nbytes, "edge_temp")
         if require_mutex:
             with _remote_mutex(name, dst, src):
                 _send_to_rank_owner(dst, op, name, src, dst, w, p_w,
@@ -1298,6 +1441,7 @@ def _do_put_edge(win, name, tensor, row, src, dst, w, op, accumulate,
     # Cast once: a float64 input on a float32 window must not widen the
     # staging slot (same invariant as _publish_self and the remote path).
     payload = np.asarray(tensor[row] * w, dtype=win.dtype)
+    xlaffi.count_host_copy(payload.nbytes, "edge_temp")  # scaled temp
     mutex = win.mutexes[dst] if require_mutex else None
     if mutex:
         mutex.acquire()
@@ -1366,8 +1510,13 @@ def win_put_nonblocking(tensor, name: str, *, self_weight=None,
     caller should pass ``dst_weights``/``self_weight`` summing to 1 per source
     (reference ``_DistributedPushSumOptimizer``,
     ``torch/optimizers.py:1026-1178``)."""
-    t = _to_numpy(tensor)
     win = _store.get(name)  # raise early on unknown window
+    # Zero-copy XLA put path: a committed device array stays on device —
+    # the worker hands its buffer pointer to the native plan executor
+    # (remote edges) and takes a zero-copy host view only if local edges
+    # or a self-publish need it.  Everything else converts here, exactly
+    # as before.
+    t = tensor if xlaffi.keep_device_ok(tensor, win) else _to_numpy(tensor)
     _validate_payload(win, t, "win_put")
     _validate_self_weight(win, self_weight)
     edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0,
@@ -1398,8 +1547,8 @@ def win_accumulate_nonblocking(tensor, name: str, *, self_weight=None,
 
     ``self_weight`` semantics as in ``win_put_nonblocking`` (scalar or (n,)
     vector, applied after the sends so P mass is conserved)."""
-    t = _to_numpy(tensor)
     win = _store.get(name)  # raise early on unknown window
+    t = tensor if xlaffi.keep_device_ok(tensor, win) else _to_numpy(tensor)
     _validate_payload(win, t, "win_accumulate")
     _validate_self_weight(win, self_weight)
     edges = _resolve_edge_weights(dst_weights, win.out_nbrs, 1.0,
@@ -1712,7 +1861,12 @@ def win_update(name: str, *, self_weight=None, neighbor_weights=None,
                 ret = np.zeros((win.n,) + win.shape, win.dtype)
                 for r in owned:
                     ret[r] = out[r]
-            return jnp.asarray(ret)
+            # Commit re-entry: ``ret`` is fresh and uniquely owned, so it
+            # re-enters jax as a zero-copy view where the runtime allows
+            # (CPU backend aliases; else dlpack) instead of a host→device
+            # re-upload — a verified copy counts into
+            # bf_win_host_copy_bytes_total{path="commit"}.
+            return xlaffi.commit_to_jax(ret)
     finally:
         for m in acquired:
             m.release()

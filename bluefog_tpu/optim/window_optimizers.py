@@ -101,13 +101,45 @@ class _WindowOptimizerBase:
         self._splits = None   # np.cumsum of per-leaf flat sizes, fused mode
 
     # -- payload layout ----------------------------------------------------
-    def _payloads(self, tree) -> List[np.ndarray]:
-        """Row-major arrays to ship, one per window (1 when fused)."""
+    def _payloads(self, tree) -> List:
+        """Row-major arrays to ship, one per window (1 when fused).
+
+        With the zero-copy XLA put path armed (``BLUEFOG_TPU_WIN_XLA``,
+        multi-process, all-f32 trees) the payloads STAY on device: the
+        fused concatenate compiles into the step's program instead of a
+        host ``np.concatenate``, and each window's put hands its device
+        buffer straight to the native transport — the put worker blocks
+        on that payload alone, so per-window (per-leaf with
+        ``fuse=False``) puts are issued as the step's compiled program
+        delivers each output, overlapping the remaining bucket math,
+        instead of after a whole-tree host materialization.  Bitwise
+        equivalent to the host path (same f32 rows, same wire frames);
+        any other configuration takes the legacy numpy path."""
+        if self._device_payloads_ok(tree):
+            leaves = jax.tree_util.tree_leaves(tree)
+            if not self.fuse:
+                return list(leaves)
+            return [jnp.concatenate(
+                [jnp.reshape(x, (self._rows, -1)) for x in leaves],
+                axis=1)]
         leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
         if not self.fuse:
             return leaves
         return [np.concatenate([x.reshape(self._rows, -1) for x in leaves],
                                axis=1)]
+
+    def _device_payloads_ok(self, tree) -> bool:
+        """Can this tree ship as device payloads through the XLA put
+        path?  All-f32 ``jax.Array`` leaves only — the fused device
+        concatenate must not change the wire dtype a mixed tree would
+        get from numpy's promotion rules."""
+        if W._store.distrib is None:
+            return False
+        from bluefog_tpu.ops import xlaffi
+        if not xlaffi.armed():
+            return False
+        return all(isinstance(x, jax.Array) and x.dtype == jnp.float32
+                   for x in jax.tree_util.tree_leaves(tree))
 
     def _rebuild(self, arrays: List, like):
         """Inverse of :meth:`_payloads` — back to the pytree structure."""

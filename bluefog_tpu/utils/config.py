@@ -18,6 +18,7 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_COMPRESSION   | none  | bf16 (halve cross-host window payloads) or sparse:<frac> (top-|magnitude| + sender error feedback) |
 | BLUEFOG_TPU_WIN_COALESCE      | 1     | 0: legacy per-message transport sends |
 | BLUEFOG_TPU_WIN_NATIVE        | 1     | 0: keep the transport hot loop (batch/drain/fold) in Python; 1 auto-falls back when the native core is missing/stale |
+| BLUEFOG_TPU_WIN_XLA           | 1     | 0: pin the host-staged put path (the bitwise oracle); 1 auto-disarms (one warning) without jax.ffi, the bf_xla native symbols, or host-addressable device buffers |
 | BLUEFOG_TPU_WIN_COALESCE_LINGER_MS | 1.0 | sender-worker linger before flushing a partial batch |
 | BLUEFOG_TPU_WIN_COALESCE_BYTES | 1 MiB | queued bytes that force an immediate batch flush |
 | BLUEFOG_TPU_WIN_TX_QUEUE      | 1024  | per-peer outbound queue bound (messages); full blocks the producer |
@@ -166,6 +167,15 @@ class Config:
     # missing, stale, or predates these symbols; 0 pins the Python path
     # (the equivalence oracle) unconditionally.
     win_native: bool
+    # Zero-copy XLA window put path (ops/xlaffi.py + native/src/xlacall.cc):
+    # puts whose payload is a committed f32 jax.Array hand the XLA buffer
+    # pointer straight to the native per-peer arenas — no device_get, no
+    # per-edge temp, no tobytes.  On by default but AUTO-disarms (one
+    # logged warning) when jax has no FFI module, the native core lacks
+    # the bf_xla symbols, or device buffers are not host-addressable
+    # (non-CPU backends, pending the TPU lowering); 0 pins the host-staged
+    # PR-9 path unconditionally — the bitwise equivalence oracle.
+    win_xla: bool
     # Transient-send retry policy of the DCN transport (ops/transport.py):
     # how many times a failed native send is retried with jittered
     # exponential backoff (base win_retry_backoff_ms, doubling per
@@ -280,6 +290,7 @@ class Config:
             win_tx_queue=int(os.environ.get(
                 "BLUEFOG_TPU_WIN_TX_QUEUE", "1024")),
             win_native=_flag("BLUEFOG_TPU_WIN_NATIVE", default=True),
+            win_xla=_flag("BLUEFOG_TPU_WIN_XLA", default=True),
             win_retries=int(os.environ.get(
                 "BLUEFOG_TPU_WIN_RETRIES", "1")),
             win_retry_backoff_ms=float(os.environ.get(

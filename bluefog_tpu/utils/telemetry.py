@@ -489,6 +489,15 @@ def health() -> dict:
     if depths:
         peer, depth = max(depths, key=lambda kv: kv[1])
         body["win_tx_deepest_queue"] = {"peer": peer, "depth": depth}
+    # Host-side staging copies on the window put/drain path, by site
+    # (device_get / edge_temp / enqueue / commit) — the oracle proving
+    # which copies the zero-copy XLA put path (BLUEFOG_TPU_WIN_XLA)
+    # eliminated: all-zero (or absent) on a pure FFI-fed dense-f32 run.
+    with _registry.lock:
+        copies = {k[1][0][1]: v for k, v in _registry.counters.items()
+                  if k[0] == "bf_win_host_copy_bytes_total" and k[1]}
+    if copies:
+        body["win_host_copy_bytes"] = copies
     # Churn-controller membership (ops/membership.py): which ranks are in
     # the gang, the committed epoch, and any live suspicion.  Absent
     # entirely when BLUEFOG_TPU_CHURN is off — no block, no key, nothing.
