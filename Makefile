@@ -7,7 +7,8 @@ PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
-        ffi-smoke placement-smoke synth-smoke hier-smoke chaos-smoke chaos
+        stripe-smoke ffi-smoke placement-smoke synth-smoke hier-smoke \
+        chaos-smoke chaos
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -17,7 +18,8 @@ PYTEST = python -m pytest -q
 # window-transport hot path is fresh (graceful skip without a toolchain —
 # every native consumer has a Python fallback).
 test: native test-fast bench-comm-smoke prof-smoke transport-smoke \
-      ffi-smoke placement-smoke synth-smoke hier-smoke chaos-smoke
+      stripe-smoke ffi-smoke placement-smoke synth-smoke hier-smoke \
+      chaos-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -101,6 +103,19 @@ hier-smoke:
 transport-smoke:
 	python bench_comm.py --transport-smoke
 	env BLUEFOG_TPU_WIN_NATIVE=0 python bench_comm.py --transport-smoke
+
+# Multi-stream striped transport CI gate: asserts >= 2 stripes engage on
+# the loopback rig (independent sockets/workers/arenas per peer, frames
+# sharded by (window, row)) with the per-stripe telemetry series present
+# (bf_win_tx_stripe_bytes_total, (peer, stripe)-labeled queue-depth
+# gauges, the decode-pool busy gauge), and that a pinned
+# BLUEFOG_TPU_WIN_STRIPES=1 leg reproduces the pre-stripe wire exactly
+# (one sender, send-order delivery, fence weight 0.0).  No timing
+# assertion; `python bench_comm.py --transport` full runs carry the
+# 1/2/4-stripe x row-size x concurrent-peers sweep.
+stripe-smoke:
+	python bench_comm.py --stripe-smoke
+	env BLUEFOG_TPU_WIN_NATIVE=0 python bench_comm.py --stripe-smoke
 
 # Zero-copy XLA put-path CI gate: loopback window-store puts of DEVICE
 # arrays through the BLUEFOG_TPU_WIN_XLA plan dispatch — asserts the FFI

@@ -104,6 +104,13 @@ def _parse_args():
                         "asserts the FFI path engaged + zero staging-copy "
                         "bytes, no timing assertion; graceful skip when "
                         "jax.ffi or the native bf_xla symbols are absent")
+    p.add_argument("--stripe-smoke", action="store_true",
+                   help="CI gate of the multi-stream striped transport "
+                        "(`make stripe-smoke`): asserts >= 2 stripes "
+                        "engage on the loopback rig with per-stripe "
+                        "telemetry present, and that a pinned "
+                        "BLUEFOG_TPU_WIN_STRIPES=1 leg reproduces the "
+                        "pre-stripe wire behavior exactly")
     p.add_argument("--rows", type=int, default=5000,
                    help="transport bench: messages per mode (default 5000)")
     p.add_argument("--row-bytes", type=int, default=4096,
@@ -148,7 +155,8 @@ def _parse_args():
 
 
 def _transport_one_mode(mode: str, rows: int, row_bytes: int,
-                        peers: int = 1) -> dict:
+                        peers: int = 1, stripes: int = 1,
+                        windows: int = 8) -> dict:
     """Loopback exchange of ``peers x rows`` messages in one mode.
 
     Modes: ``legacy`` (per-message blocking sends, coalescing off),
@@ -175,10 +183,12 @@ def _transport_one_mode(mode: str, rows: int, row_bytes: int,
 
     prev_native = os.environ.get("BLUEFOG_TPU_WIN_NATIVE")
     prev_coalesce = os.environ.get("BLUEFOG_TPU_WIN_COALESCE")
+    prev_stripes = os.environ.get("BLUEFOG_TPU_WIN_STRIPES")
     os.environ["BLUEFOG_TPU_WIN_COALESCE"] = \
         "0" if mode == "legacy" else "1"
     os.environ["BLUEFOG_TPU_WIN_NATIVE"] = \
         "1" if mode == "native" else "0"
+    os.environ["BLUEFOG_TPU_WIN_STRIPES"] = str(max(1, stripes))
     # Long linger: the bench flushes explicitly (as window ops do at op
     # boundaries), so batch sizes reflect the queue, not the clock.
     os.environ.setdefault("BLUEFOG_TPU_WIN_COALESCE_LINGER_MS", "5")
@@ -211,11 +221,17 @@ def _transport_one_mode(mode: str, rows: int, row_bytes: int,
 
     server = WindowTransport(apply, apply_batch=apply_batch,
                              apply_items=apply_items, drain_interval=0.0005)
-    server.register_window("bench", row_bytes // 4)
+    # Several windows + rotating src ranks so the (window, row) shard
+    # actually spreads across stripes (one window/one row would pin a
+    # single stripe and measure nothing).
+    names = [f"bench{w}" for w in range(max(1, windows))]
+    for nm in names:
+        server.register_window(nm, row_bytes // 4)
     clients = [WindowTransport(lambda *a: None) for _ in range(peers)]
     try:
         row = np.arange(row_bytes // 4, dtype=np.float32)
         host, port = "127.0.0.1", server.port
+        nw = len(names)
 
         def exchange(count_per_client):
             done.clear()
@@ -226,14 +242,14 @@ def _transport_one_mode(mode: str, rows: int, row_bytes: int,
             t0 = time.perf_counter()
             if peers == 1:
                 send = clients[0].send
-                for _ in range(count_per_client):
-                    send(host, port, OP_ACCUMULATE, "bench", 0, 1, 1.0,
-                         row)
+                for i in range(count_per_client):
+                    send(host, port, OP_ACCUMULATE, names[i % nw],
+                         i % 8, 1, 1.0, row)
             else:
                 sends = [c.send for c in clients]
                 for i in range(total):
-                    sends[i % peers](host, port, OP_ACCUMULATE, "bench",
-                                     0, 1, 1.0, row)
+                    sends[i % peers](host, port, OP_ACCUMULATE,
+                                     names[i % nw], i % 8, 1, 1.0, row)
             for c in clients:
                 c.flush()
             assert done.wait(timeout=300), \
@@ -249,11 +265,18 @@ def _transport_one_mode(mode: str, rows: int, row_bytes: int,
         clients.clear()
         burst = telemetry.histogram_percentiles(
             "bf_win_drain_burst_seconds", qs=(50.0, 99.0)) or {}
+        snap = telemetry.snapshot() if telemetry.enabled() else {}
+        engaged = {k.split('stripe="', 1)[1].split('"', 1)[0]
+                   for k in snap
+                   if k.startswith("bf_win_tx_stripe_bytes_total")}
         return {
             "mode": mode,
             "peers": peers,
+            "stripes": stripes,
+            "stripes_engaged": len(engaged),
             "row_bytes": row_bytes,
             "native_engaged": bool(server.native_path),
+            "decode_threads": int(getattr(server, "decode_threads", 0)),
             "msgs_per_s": round(total / dt, 1),
             "mb_per_s": round(total * row_bytes / dt / 1e6, 2),
             "batches_seen": state["batches"],
@@ -268,7 +291,8 @@ def _transport_one_mode(mode: str, rows: int, row_bytes: int,
         except Exception:  # noqa: BLE001 — double-stop after success path
             pass
         for var, prev in (("BLUEFOG_TPU_WIN_NATIVE", prev_native),
-                          ("BLUEFOG_TPU_WIN_COALESCE", prev_coalesce)):
+                          ("BLUEFOG_TPU_WIN_COALESCE", prev_coalesce),
+                          ("BLUEFOG_TPU_WIN_STRIPES", prev_stripes)):
             if prev is None:
                 os.environ.pop(var, None)
             else:
@@ -356,6 +380,42 @@ def transport_main(args) -> int:
             peers_tbl.append(_transport_one_mode(
                 "native", max(rows // p, 50), 256, peers=p))
 
+    # Stripe axis (multi-stream transport): 1/2/4 stripes x 4 KiB/64 KiB/
+    # 256 KiB rows x 1/8 concurrent peers on the native path — the
+    # regime where a single fat link is bounded by one stream.  Reported
+    # as msgs/s + drain p99 per cell; the headline ratio is best-striped
+    # vs single-stream at >= 64 KiB rows under 8 peers.
+    stripe_tbl = []
+    stripe_speedup = None
+    if native_ok and not smoke:
+        for row_bytes in (4096, 65536, 262144):
+            # Scale the message count down with the row so every cell
+            # moves a comparable byte volume.
+            per = max(80, int(rows * 4096 / max(row_bytes, 4096)))
+            for p in (1, 8):
+                for st in (1, 2, 4):
+                    stripe_tbl.append(_transport_one_mode(
+                        "native", max(per // p, 40), row_bytes, peers=p,
+                        stripes=st))
+
+        def _cell(row_bytes, p, st):
+            for r in stripe_tbl:
+                if (r["row_bytes"], r["peers"], r["stripes"]) == \
+                        (row_bytes, p, st):
+                    return r
+            return None
+
+        ratios_sp = []
+        for row_bytes in (65536, 262144):
+            base = _cell(row_bytes, 8, 1)
+            cands = [c for c in (_cell(row_bytes, 8, s) for s in (2, 4))
+                     if c]
+            if base and cands:
+                best = max(cands, key=lambda c: c["msgs_per_s"])
+                ratios_sp.append(best["msgs_per_s"] / base["msgs_per_s"])
+        if ratios_sp:
+            stripe_speedup = round(max(ratios_sp), 2)
+
     def _rate(mode, row_bytes):
         for r in sweep:
             if r["mode"] == mode and r["row_bytes"] == row_bytes:
@@ -420,8 +480,141 @@ def transport_main(args) -> int:
             "legacy": legacy,
             "sweep": sweep,
             "peers": peers_tbl,
+            "stripes": stripe_tbl,
+            "stripe_speedup_64k_plus_8p": stripe_speedup,
             "ffi_dispatch_speedup": ffi_value,
             "ffi": ffi_detail,
+        },
+    }))
+    return rc
+
+
+def stripe_main(args) -> int:
+    """`make stripe-smoke`: the multi-stream striped transport CI gate.
+
+    Three structural assertions, no timing (shared CI boxes jitter):
+      1. a 2-stripe loopback run actually engages >= 2 stripes (distinct
+         per-stripe telemetry series carried bytes) and, on the native
+         path, the drain decode pool is live with its busy gauge present;
+      2. per-stripe series exist: `bf_win_tx_stripe_bytes_total` and the
+         (peer, stripe)-labeled `bf_win_tx_queue_depth` gauges;
+      3. a pinned BLUEFOG_TPU_WIN_STRIPES=1 leg reproduces the pre-stripe
+         wire exactly — one sender, send-order delivery with identical
+         fields and payload bytes, fence weight 0.0.
+    """
+    import sys
+    import threading
+
+    import numpy as np
+
+    from bluefog_tpu import native
+    from bluefog_tpu.utils import telemetry
+
+    if not native.available():
+        print(json.dumps({
+            "metric": "win_transport_stripes_engaged",
+            "value": None, "unit": "stripes", "status": "no_native",
+            "detail": {"reason": "native core not built"}}))
+        return 0
+    native_ok = (native.has_win_native()
+                 and os.environ.get("BLUEFOG_TPU_WIN_NATIVE") != "0")
+    failures = []
+
+    # -- leg 1: striped run, >= 2 stripes engaged + telemetry ---------------
+    mode = "native" if native_ok else "python"
+    res = _transport_one_mode(mode, 300, 4096, peers=2, stripes=2)
+    if res["stripes_engaged"] < 2:
+        failures.append(
+            f"only {res['stripes_engaged']} stripe(s) engaged with "
+            "BLUEFOG_TPU_WIN_STRIPES=2")
+    if native_ok and not res["native_engaged"]:
+        failures.append("native path available but did not engage")
+    snap = telemetry.snapshot() if telemetry.enabled() else {}
+    for series in ("bf_win_tx_stripe_bytes_total",):
+        stripes_seen = {k.split('stripe="', 1)[1].split('"', 1)[0]
+                        for k in snap if k.startswith(series)}
+        if len(stripes_seen) < 2:
+            failures.append(
+                f"expected >= 2 stripe labels on {series!r}, "
+                f"got {sorted(stripes_seen)}")
+    if not any(k.startswith("bf_win_tx_queue_depth") and 'stripe="' in k
+               for k in snap):
+        failures.append("per-stripe bf_win_tx_queue_depth gauges missing")
+    if native_ok and res["decode_threads"] > 0 and not any(
+            k.startswith("bf_win_rx_decode_pool_busy") for k in snap):
+        failures.append("bf_win_rx_decode_pool_busy gauge missing with a "
+                        "live decode pool")
+
+    # -- leg 2: STRIPES=1 pinned — the pre-stripe wire, exactly -------------
+    from bluefog_tpu.ops import transport as T
+    from bluefog_tpu.ops import window as W
+    from bluefog_tpu.utils import config as _config
+    prev = {v: os.environ.get(v) for v in
+            ("BLUEFOG_TPU_WIN_STRIPES", "BLUEFOG_TPU_WIN_NATIVE",
+             "BLUEFOG_TPU_WIN_COALESCE_LINGER_MS")}
+    os.environ["BLUEFOG_TPU_WIN_STRIPES"] = "1"
+    os.environ["BLUEFOG_TPU_WIN_NATIVE"] = "0"
+    os.environ["BLUEFOG_TPU_WIN_COALESCE_LINGER_MS"] = "2"
+    _config.reload()
+    got = []
+    cv = threading.Condition()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        with cv:
+            got.append((op, name, src, dst, weight, bytes(payload)))
+            cv.notify_all()
+
+    def apply_batch(msgs):
+        for m in msgs:
+            apply(*m)
+
+    server = T.WindowTransport(apply, apply_batch=apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        if client.n_stripes != 1:
+            failures.append(
+                f"STRIPES=1 leg resolved {client.n_stripes} stripes")
+        host, port = "127.0.0.1", server.port
+        expect = []
+        for i in range(8):
+            row = np.arange(16, dtype=np.float32) * (i + 1)
+            client.send(host, port, T.OP_PUT, "w", i, 1, 0.5, row)
+            expect.append((T.OP_PUT, "w", i, 1, 0.5, row.tobytes()))
+        client.send(host, port, T.OP_FENCE_REQ, "", 0, -1,
+                    W._fanout_weight(1), np.zeros(0, np.float32))
+        expect.append((T.OP_FENCE_REQ, "", 0, -1, 0.0, b""))
+        client.flush()
+        with cv:
+            ok = cv.wait_for(lambda: len(got) >= len(expect), timeout=30)
+        if not ok or got != expect:
+            failures.append(
+                "STRIPES=1 wire differs from the pre-stripe transport "
+                f"(got {len(got)} messages)")
+        if sorted(k[2] for k in client._senders) not in ([], [0]):
+            failures.append("STRIPES=1 leg created stripe senders > 0")
+    finally:
+        client.stop()
+        server.stop()
+        for var, val in prev.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+        _config.reload()
+
+    rc = 0
+    for f in failures:
+        print(f"bench_comm --stripe-smoke: {f}", file=sys.stderr)
+        rc = 1
+    print(json.dumps({
+        "metric": "win_transport_stripes_engaged",
+        "value": res["stripes_engaged"],
+        "unit": "stripes",
+        "detail": {
+            "native_available": native_ok,
+            "striped_cell": res,
+            "single_stripe_wire_ok": all(
+                "STRIPES=1" not in f for f in failures),
         },
     }))
     return rc
@@ -1258,6 +1451,8 @@ def main():
     args = _parse_args()
     if args.ffi or args.ffi_smoke:
         return ffi_main(args)
+    if args.stripe_smoke:
+        return stripe_main(args)
     if args.transport or args.transport_smoke:
         return transport_main(args)
     if args.placement or args.placement_smoke:

@@ -76,6 +76,9 @@ class WinRxStats(ctypes.Structure):
         ("by_op", ctypes.c_uint64 * 16),
         ("batch_size_hist", ctypes.c_uint64 * 25),
         ("batch_size_sum", ctypes.c_double),
+        ("decode_busy", ctypes.c_uint64),
+        ("decode_threads", ctypes.c_uint64),
+        ("decoded_frames", ctypes.c_uint64),
     ]
 
 
@@ -148,9 +151,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ptr(ctypes.c_float), u64, i32, i32]
         lib.bf_winsvc_rx_stats.restype = None
         lib.bf_winsvc_rx_stats.argtypes = [ctypes.c_void_p, ptr(WinRxStats)]
+        lib.bf_winsvc_set_decode.restype = i32
+        lib.bf_winsvc_set_decode.argtypes = [ctypes.c_void_p, i32]
 
         lib.bf_wintx_start.restype = ctypes.c_void_p
-        lib.bf_wintx_start.argtypes = [u64, u64, i32, i32, dbl]
+        lib.bf_wintx_start.argtypes = [u64, u64, i32, i32, dbl, i32]
         lib.bf_wintx_send.restype = i32
         # payload rides as c_void_p, which ctypes accepts as EITHER bytes
         # (small rows: tobytes() + the cheapest pointer conversion) or a
@@ -159,7 +164,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         # avoiding; see transport._ctypes_payload).
         lib.bf_wintx_send.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, i32, ctypes.c_uint8,
-            ctypes.c_char_p, i32, i32, dbl, dbl, ctypes.c_void_p, u64, i32]
+            ctypes.c_char_p, i32, i32, dbl, dbl, ctypes.c_void_p, u64, i32,
+            i32]
         lib.bf_wintx_flush.restype = i32
         lib.bf_wintx_flush.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                        i32, dbl]
@@ -177,6 +183,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bf_wintx_stats.restype = None
         lib.bf_wintx_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                        i32, ptr(WinTxStats)]
+        lib.bf_wintx_stripe_stats.restype = None
+        lib.bf_wintx_stripe_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, i32, i32, ptr(WinTxStats)]
+        lib.bf_wintx_stripes.restype = i32
+        lib.bf_wintx_stripes.argtypes = [ctypes.c_void_p]
         lib.bf_wintx_stop.restype = None
         lib.bf_wintx_stop.argtypes = [ctypes.c_void_p]
     except AttributeError:
@@ -190,7 +201,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bf_xla_plan_edge.restype = i32
         lib.bf_xla_plan_edge.argtypes = [
             i64, i32, ctypes.c_char_p, i32, ctypes.c_uint8, i32, i32, dbl,
-            i64]
+            i64, i32]
         lib.bf_xla_plan_set_p.restype = i32
         lib.bf_xla_plan_set_p.argtypes = [i64, ptr(dbl), i32]
         # data rides as c_void_p: the dispatcher passes the RAW XLA buffer
@@ -329,11 +340,15 @@ def is_stale() -> bool:
 
 def has_win_native() -> bool:
     """True when the loaded library carries the window-transport native
-    hot path (``bf_wintx_*`` / ``bf_winsvc_drain``) and is not stale."""
+    hot path (``bf_wintx_*`` / ``bf_winsvc_drain``) — including the
+    multi-stream stripe surface (``bf_wintx_stripe_stats``, whose absence
+    marks a pre-stripe build with the OLD ``bf_wintx_start``/``send``
+    signatures) — and is not stale."""
     handle = lib()
     return (handle is not None and not _stale
             and hasattr(handle, "bf_wintx_start")
-            and hasattr(handle, "bf_winsvc_drain"))
+            and hasattr(handle, "bf_winsvc_drain")
+            and hasattr(handle, "bf_wintx_stripe_stats"))
 
 
 def has_win_xla() -> bool:
@@ -356,7 +371,7 @@ def has_xla_handler() -> bool:
             and bool(handle.bf_xla_has_handler()))
 
 
-_FASTCALL_ABI = 1
+_FASTCALL_ABI = 2
 _fastcall = None
 _fastcall_tried = False
 

@@ -143,6 +143,9 @@ typedef struct {
   uint64_t by_op[16];      /* sub-message counts by base op code */
   uint64_t batch_size_hist[25];
   double batch_size_sum;
+  uint64_t decode_busy;    /* decode-pool workers busy RIGHT NOW (gauge) */
+  uint64_t decode_threads; /* decode-pool size (0 = inline decode) */
+  uint64_t decoded_frames; /* frames decoded BY THE POOL (0 inline) */
 } bf_winrx_stats_t;
 
 /* Register (elems > 0) or unregister (elems <= 0) a window for the native
@@ -166,6 +169,18 @@ int32_t bf_winsvc_drain(bf_winsvc_t* s, bf_win_item_t* items,
                         int32_t wait_ms);
 
 void bf_winsvc_rx_stats(bf_winsvc_t* s, bf_winrx_stats_t* out);
+
+/* Start a drain-side decode thread pool of `threads` workers: inbound
+ * frames are decoded/scaled/folded IN PARALLEL (per-frame buffers) and
+ * bf_winsvc_drain emits the results in exact arrival order, so per-
+ * connection FIFO — the fence/mutex ordering contract — is preserved
+ * while decode of different connections (and different stripes of one
+ * peer) overlaps.  Call once, BEFORE the first drain, and only on a
+ * service consumed via bf_winsvc_drain (bf_winsvc_recv bypasses the
+ * pool and must not be mixed with it).  threads <= 0 keeps the inline
+ * single-thread decode (bit-identical; the pool changes scheduling,
+ * never bytes).  Returns the pool size actually started. */
+int32_t bf_winsvc_set_decode(bf_winsvc_t* s, int32_t threads);
 
 /* -------- native transmit path: per-peer coalescing send queues --------
  *
@@ -199,39 +214,51 @@ typedef struct {
 
 /* Start the native sender.  flush_bytes/linger_us/queue_max mirror the
  * BLUEFOG_TPU_WIN_COALESCE_* knobs; retries/backoff_sec the transient-
- * retry policy (jittered exponential, as in the Python path). */
+ * retry policy (jittered exponential, as in the Python path).  stripes
+ * (>= 1) is the multi-stream width: every (host, port) peer is driven by
+ * `stripes` independent sockets + sender workers + send arenas, each an
+ * independent FIFO — the caller shards frames deterministically by
+ * (window, row) onto a stripe, so same-slot ordering is preserved per
+ * stripe while a fat DCN link is saturated by N parallel streams. */
 bf_wintx_t* bf_wintx_start(uint64_t flush_bytes, uint64_t linger_us,
                            int32_t queue_max, int32_t retries,
-                           double backoff_sec);
+                           double backoff_sec, int32_t stripes);
 
-/* Enqueue one message onto (host, port)'s queue; blocking backpressure
- * when full.  urgent != 0 cuts the linger (and drags queued data onto the
- * wire ahead of it).  Returns 0, -4 name >= 128 bytes (deterministic),
- * -5 transport/peer stopping, or a stored negative send-error code from a
- * previously failed batch to this peer (consumed, as the Python sender's
- * stored error is). */
+/* Enqueue one message onto (host, port)'s stripe queue; blocking
+ * backpressure when full.  stripe is clamped into [0, stripes); each
+ * stripe owns its socket, worker and send arena, so producers writing
+ * different stripes never contend on one queue mutex.  urgent != 0 cuts
+ * the linger (and drags THAT STRIPE's queued data onto the wire ahead of
+ * it).  Returns 0, -4 name >= 128 bytes (deterministic), -5
+ * transport/peer stopping, or a stored negative send-error code from a
+ * previously failed batch on this stripe (consumed, as the Python
+ * sender's stored error is). */
 int32_t bf_wintx_send(bf_wintx_t* t, const char* host, int32_t port,
                       uint8_t op, const char* name, int32_t src, int32_t dst,
                       double weight, double p_weight, const uint8_t* payload,
-                      uint64_t payload_len, int32_t urgent);
+                      uint64_t payload_len, int32_t urgent, int32_t stripe);
 
 /* Block until everything enqueued to (host, port) BEFORE this call has
- * been handed to TCP.  host == NULL drains every peer.  Returns 0, a
- * stored send-error code (consumed), -6 on timeout, -5 stopped with
- * messages unsent. */
+ * been handed to TCP — across ALL of the peer's stripes.  host == NULL
+ * drains every peer.  Returns 0, a stored send-error code (consumed),
+ * -6 on timeout, -5 stopped with messages unsent. */
 int32_t bf_wintx_flush(bf_wintx_t* t, const char* host, int32_t port,
                        double timeout_sec);
 
-/* Monotonic failed-batch count for (host, port) (0 if unknown/retired);
- * host == NULL sums the active peers — the error-epoch token. */
+/* Monotonic failed-batch count for (host, port), summed over its stripes
+ * (0 if unknown/retired); host == NULL sums the active peers — the
+ * error-epoch token.  The token scopes per (peer, stripe): a failure on
+ * any stripe of an addressed peer trips every op that overlapped it. */
 int64_t bf_wintx_err_count(bf_wintx_t* t, const char* host, int32_t port);
 
 /* Non-blocking: wake every sender with a pending queue (pacing). */
 void bf_wintx_kick(bf_wintx_t* t);
 
-/* Retire a peer: discard its queue (returns the count, recorded in
- * dropped_msgs), fail any blocked flusher, let the worker exit.  A later
- * send to the same address lazily creates a fresh sender. */
+/* Retire a peer: discard the queues of EVERY stripe (returns the summed
+ * count, recorded in dropped_msgs), fail any blocked flusher, let all
+ * stripe workers exit — a dead peer must never leave N-1 orphan workers
+ * retrying into closed sockets.  A later send to the same address lazily
+ * creates fresh stripe senders. */
 int64_t bf_wintx_drop_peer(bf_wintx_t* t, const char* host, int32_t port);
 
 /* Declare "host:port,host:port" peers unreachable (chaos fault
@@ -240,9 +267,19 @@ int64_t bf_wintx_drop_peer(bf_wintx_t* t, const char* host, int32_t port);
 void bf_wintx_set_partition(bf_wintx_t* t, const char* csv);
 
 /* Counter snapshot: host == NULL aggregates every peer ever created;
- * otherwise the named active peer (zeroed if unknown). */
+ * otherwise the named active peer, summed over ALL its stripes (zeroed
+ * if unknown). */
 void bf_wintx_stats(bf_wintx_t* t, const char* host, int32_t port,
                     bf_wintx_stats_t* out);
+
+/* Counter snapshot of ONE stripe of (host, port) — the per-stripe
+ * telemetry series (bytes, queue depth, errors per stripe).  Zeroed when
+ * the peer/stripe is unknown or retired. */
+void bf_wintx_stripe_stats(bf_wintx_t* t, const char* host, int32_t port,
+                           int32_t stripe, bf_wintx_stats_t* out);
+
+/* The configured stripe width (>= 1). */
+int32_t bf_wintx_stripes(bf_wintx_t* t);
 
 /* Drain queues (workers finish in-flight batches; unreachable peers fail
  * fast), join every worker, free the transport. */
@@ -270,10 +307,14 @@ int64_t bf_xla_plan_new(const char* name, int64_t elems, int32_t n_edges,
 
 /* Fill edge slot i (0-based).  op carries the BASE wire code (codec flag
  * bits are applied by the encoder).  row is the row index into the
- * (rows, elems) input buffer.  Returns 0, -9 unknown plan / bad index. */
+ * (rows, elems) input buffer.  stripe pins the edge's transport stripe
+ * AT COMPILE TIME (the same deterministic (window, row) shard the eager
+ * sender computes, so plan-dispatched and host-dispatched frames for one
+ * edge always ride the same FIFO).  Returns 0, -9 unknown plan / bad
+ * index. */
 int32_t bf_xla_plan_edge(int64_t plan, int32_t i, const char* host,
                          int32_t port, uint8_t op, int32_t src, int32_t dst,
-                         double weight, int64_t row);
+                         double weight, int64_t row, int32_t stripe);
 
 /* Refresh every edge's associated-P mass before a dispatch (push-sum
  * runs; n must equal n_edges).  Returns 0, -9 unknown plan / size. */

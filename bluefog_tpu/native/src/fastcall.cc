@@ -23,15 +23,15 @@
 // Bumped when the argument contract below changes; native/__init__.py
 // refuses a module whose ABI does not match (a stale build must fall back
 // to ctypes, never misparse arguments).
-#define BF_FASTCALL_ABI 1
+#define BF_FASTCALL_ABI 2
 
 namespace {
 
 // wintx_send(tx, host, port, op, name, src, dst, weight, p_weight,
-//            payload, urgent) -> rc
+//            payload, urgent, stripe) -> rc
 PyObject* py_wintx_send(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
-  if (nargs != 11) {
-    PyErr_SetString(PyExc_TypeError, "wintx_send expects 11 arguments");
+  if (nargs != 12) {
+    PyErr_SetString(PyExc_TypeError, "wintx_send expects 12 arguments");
     return nullptr;
   }
   if (!PyBytes_Check(args[1]) || !PyBytes_Check(args[4])) {
@@ -48,6 +48,7 @@ PyObject* py_wintx_send(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
   double weight = PyFloat_AsDouble(args[7]);
   double p_weight = PyFloat_AsDouble(args[8]);
   long urgent = PyLong_AsLong(args[10]);
+  long stripe = PyLong_AsLong(args[11]);
   if (PyErr_Occurred()) return nullptr;
   Py_buffer view;
   if (PyObject_GetBuffer(args[9], &view, PyBUF_SIMPLE) != 0) return nullptr;
@@ -56,7 +57,7 @@ PyObject* py_wintx_send(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
   rc = bf_wintx_send((bf_wintx_t*)tx, host, (int32_t)port, (uint8_t)op,
                      name, (int32_t)src, (int32_t)dst, weight, p_weight,
                      (const uint8_t*)view.buf, (uint64_t)view.len,
-                     (int32_t)urgent);
+                     (int32_t)urgent, (int32_t)stripe);
   Py_END_ALLOW_THREADS
   PyBuffer_Release(&view);
   return PyLong_FromLong(rc);

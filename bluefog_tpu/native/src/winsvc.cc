@@ -68,6 +68,7 @@
 #include <cmath>
 #include <cstring>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -78,6 +79,7 @@
 #include <mutex>
 #include <random>
 #include <set>
+#include <shared_mutex>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -196,6 +198,18 @@ size_t BuildHeader(uint8_t* hdr, uint8_t op, int32_t src, int32_t dst,
 
 }  // namespace
 
+// One frame decoded by the drain-side pool into its OWN buffers (so
+// decode of different connections/stripes runs in parallel); the drain
+// call copies the result into the caller's arrays in arrival order.
+struct DecodedFrame {
+  std::vector<bf_win_item_t> items;
+  std::vector<uint8_t> raw;
+  std::vector<float> vals;
+  uint64_t raw_len = 0;  // used bytes / elements of the vectors
+  uint64_t val_len = 0;
+  int32_t n_items = 0;
+};
+
 struct bf_winsvc {
   int listen_fd = -1;
   int32_t port = 0;
@@ -211,10 +225,28 @@ struct bf_winsvc {
   std::mutex conn_m;
   // Native drain path: registered f32 windows (name -> flat element
   // count) and the cumulative decode counters.  win_m orders
-  // registration against frame decode; rx is guarded by m.
-  std::mutex win_m;
+  // registration against frame decode — shared (read) side taken by the
+  // decoders, so POOL WORKERS DECODE CONCURRENTLY and only the rare
+  // win_set registration excludes them; rx is guarded by m.
+  std::shared_mutex win_m;
   std::unordered_map<std::string, int64_t> wins;
   bf_winrx_stats_t rx{};
+  // Drain-side decode pool (bf_winsvc_set_decode).  Workers pop frames
+  // off q, stamping each with a sequence ticket under m (= arrival
+  // order), decode into per-frame buffers in parallel, and park the
+  // result in `decoded`; the drain call emits strictly in ticket order —
+  // per-connection FIFO (the fence/mutex contract) is preserved exactly,
+  // only the decode WORK overlaps.  All guarded by m except decode_busy.
+  int32_t decode_threads = 0;
+  std::vector<std::thread> dpool;
+  std::condition_variable cv_decoded;
+  std::map<uint64_t, DecodedFrame> decoded;
+  uint64_t seq_assign = 0;  // next ticket to hand a worker
+  uint64_t seq_emit = 0;    // next ticket the drain will emit
+  std::atomic<int64_t> decode_busy{0};
+  uint64_t decoded_frames = 0;
+
+  void DecodeWorker();
   struct Slot {
     std::thread t;
     int fd = -1;
@@ -489,8 +521,10 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
   uint64_t off = 5;
   int last_commit = -1;  // item index an ACCUMULATE may fold into
   // One registry lookup per name change (consecutive sub-messages are
-  // overwhelmingly same-window), under win_m for the whole frame.
-  std::unique_lock<std::mutex> wlk(s->win_m);
+  // overwhelmingly same-window), under a SHARED win_m hold for the whole
+  // frame: concurrent decode workers read the registry in parallel and
+  // only bf_winsvc_win_set takes the exclusive side.
+  std::shared_lock<std::shared_mutex> wlk(s->win_m);
   const char* cached_name = nullptr;
   size_t cached_len = 0;
   int64_t cached_elems = -1;
@@ -637,14 +671,109 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
   return 0;
 }
 
+// Decode one frame into a DecodedFrame's OWN buffers, growing them on
+// demand (the caller-buffer grow codes -1/-2/-3 become retries here).
+// The fold arithmetic is the SAME DecodeFrame the inline path runs —
+// the pool changes scheduling, never bytes.
+void DecodeOwned(bf_winsvc* s, const Inbound& in, DecodedFrame* df,
+                 RxTally* tally) {
+  df->items.resize(64);
+  df->raw.resize(in.payload.size() + 64);
+  df->vals.resize(4096);
+  for (;;) {
+    DrainCursor c{df->items.data(), (int32_t)df->items.size(), 0,
+                  df->raw.data(), (uint64_t)df->raw.size(), 0,
+                  df->vals.data(), (uint64_t)df->vals.size(), 0};
+    RxTally local{};
+    // frame_tag 1: a placeholder the drain remaps to its cycling
+    // per-frame ordinal at emit time (one frame per DecodedFrame, so a
+    // constant is unambiguous).
+    int rc = DecodeFrame(s, in, &c, &local, /*frame_tag=*/1);
+    if (rc == 0) {
+      df->n_items = c.n_items;
+      df->raw_len = c.raw_off;
+      df->val_len = c.val_off;
+      *tally = local;
+      return;
+    }
+    if (rc == -1)
+      df->raw.resize(df->raw.size() * 2);
+    else if (rc == -2)
+      df->vals.resize(df->vals.size() * 2);
+    else
+      df->items.resize(df->items.size() * 2);
+  }
+}
+
+// Copy one decoded frame into the caller's drain buffers (arrival-order
+// emit).  Returns 0, or the -1/-2/-3 grow code when the caller's buffers
+// cannot take it (nothing partially written).
+int EmitDecoded(const DecodedFrame& df, DrainCursor* c, uint8_t frame_tag) {
+  if (c->n_items + df.n_items > c->max_items) return -3;
+  const uint64_t raw_base = (c->raw_off + 7) & ~7ull;  // keep items 8-aligned
+  if (raw_base + df.raw_len > c->raw_cap) return -1;
+  if (c->val_off + df.val_len > c->val_cap) return -2;
+  if (df.raw_len) std::memcpy(c->raw_buf + raw_base, df.raw.data(), df.raw_len);
+  if (df.val_len)
+    std::memcpy(c->val_buf + c->val_off, df.vals.data(), df.val_len * 4);
+  for (int32_t i = 0; i < df.n_items; ++i) {
+    bf_win_item_t& it = c->items[c->n_items + i];
+    it = df.items[(size_t)i];
+    it.off += it.kind ? c->val_off : raw_base;
+    if (it.frame) it.frame = frame_tag;
+  }
+  c->n_items += df.n_items;
+  c->raw_off = raw_base + df.raw_len;
+  c->val_off += df.val_len;
+  return 0;
+}
+
 }  // namespace
+
+void bf_winsvc::DecodeWorker() {
+  for (;;) {
+    Inbound in;
+    uint64_t seq;
+    {
+      std::unique_lock<std::mutex> lk(m);
+      cv_data.wait(lk, [this] {
+        return stopping ||
+               (!q.empty() && seq_assign - seq_emit < (uint64_t)max_pending);
+      });
+      if (stopping) return;
+      in = std::move(q.front());
+      q.pop_front();
+      seq = seq_assign++;
+      cv_space.notify_one();  // q space freed: unblock a reader
+    }
+    decode_busy.fetch_add(1, std::memory_order_acq_rel);
+    DecodedFrame df;
+    RxTally tally{};
+    DecodeOwned(this, in, &df, &tally);
+    decode_busy.fetch_sub(1, std::memory_order_acq_rel);
+    {
+      std::lock_guard<std::mutex> lk(m);
+      rx.batch_frames += tally.batch_frames;
+      rx.msgs += tally.msgs;
+      rx.folded_msgs += tally.folded;
+      rx.commits += tally.commits;
+      rx.bytes += tally.bytes;
+      for (int i = 0; i < 16; ++i) rx.by_op[i] += tally.by_op[i];
+      for (int i = 0; i < 25; ++i) rx.batch_size_hist[i] += tally.bs_hist[i];
+      rx.batch_size_sum += tally.bs_sum;
+      decoded_frames++;
+      decoded[seq] = std::move(df);
+      cv_decoded.notify_all();
+    }
+  }
+}
 
 extern "C" {
 
 int32_t bf_winsvc_win_set(bf_winsvc_t* s, const char* name, int64_t elems) {
   if (!s || !name) return -1;
   if (std::strlen(name) >= 128) return -4;
-  std::lock_guard<std::mutex> lk(s->win_m);
+  std::lock_guard<std::shared_mutex> lk(s->win_m);
   if (elems > 0)
     s->wins[name] = elems;
   else
@@ -652,12 +781,64 @@ int32_t bf_winsvc_win_set(bf_winsvc_t* s, const char* name, int64_t elems) {
   return 0;
 }
 
+namespace {
+
+// Pooled drain: emit already-decoded frames strictly in arrival order.
+// The decode work happened on the pool; what remains here is bounded
+// memcpys into the caller's buffers.
+int32_t DrainPooled(bf_winsvc* s, DrainCursor* c, int32_t max_frames,
+                    int32_t wait_ms) {
+  int frames = 0;
+  int grow_rc = 0;
+  uint8_t frame_tag = 0;
+  while (frames < max_frames) {
+    DecodedFrame df;
+    uint64_t seq;
+    {
+      std::unique_lock<std::mutex> lk(s->m);
+      seq = s->seq_emit;
+      if (!s->decoded.count(seq)) {
+        // Only the FIRST frame is worth waiting for (same rule as the
+        // inline path): once something was emitted, return it.
+        if (frames > 0 || c->n_items > 0 || wait_ms <= 0) break;
+        s->cv_decoded.wait_for(lk, std::chrono::milliseconds(wait_ms),
+                               [&] {
+                                 return s->decoded.count(seq) || s->stopping;
+                               });
+        if (!s->decoded.count(seq)) break;
+      }
+      df = std::move(s->decoded[seq]);
+      s->decoded.erase(seq);
+    }
+    frame_tag = (uint8_t)(frame_tag == 255 ? 1 : frame_tag + 1);
+    int rc = EmitDecoded(df, c, frame_tag);
+    std::lock_guard<std::mutex> lk(s->m);
+    if (rc != 0) {
+      // Caller buffers too small: park the frame back at its ticket
+      // (order preserved) and report what was emitted so far — or, with
+      // nothing emitted, the grow request itself.
+      s->decoded[seq] = std::move(df);
+      grow_rc = rc;
+      break;
+    }
+    s->seq_emit = seq + 1;
+    s->cv_data.notify_all();  // in-flight shrank: wake bounded workers
+    frames++;
+  }
+  if (c->n_items == 0 && grow_rc != 0) return grow_rc;
+  return c->n_items;
+}
+
+}  // namespace
+
 int32_t bf_winsvc_drain(bf_winsvc_t* s, bf_win_item_t* items,
                         int32_t max_items, uint8_t* raw_buf, uint64_t raw_cap,
                         float* val_buf, uint64_t val_cap, int32_t max_frames,
                         int32_t wait_ms) {
   if (!s || max_items <= 0) return 0;
   DrainCursor c{items, max_items, 0, raw_buf, raw_cap, 0, val_buf, val_cap, 0};
+  if (s->decode_threads > 0)
+    return DrainPooled(s, &c, max_frames, wait_ms);
   RxTally tally;
   int frames = 0;
   int grow_rc = 0;
@@ -713,6 +894,22 @@ void bf_winsvc_rx_stats(bf_winsvc_t* s, bf_winrx_stats_t* out) {
   if (!s || !out) return;
   std::lock_guard<std::mutex> lk(s->m);
   *out = s->rx;
+  out->decode_busy =
+      (uint64_t)std::max<int64_t>(0, s->decode_busy.load(
+                                         std::memory_order_acquire));
+  out->decode_threads = (uint64_t)s->decode_threads;
+  out->decoded_frames = s->decoded_frames;
+}
+
+int32_t bf_winsvc_set_decode(bf_winsvc_t* s, int32_t threads) {
+  if (!s) return 0;
+  std::lock_guard<std::mutex> lk(s->m);
+  if (s->decode_threads > 0 || threads <= 0 || s->stopping)
+    return s->decode_threads;  // once-only; <= 0 keeps the inline decode
+  s->decode_threads = threads;
+  for (int32_t i = 0; i < threads; ++i)
+    s->dpool.emplace_back([s] { s->DecodeWorker(); });
+  return s->decode_threads;
 }
 
 }  // extern "C"
@@ -800,6 +997,7 @@ void bf_winsvc_stop(bf_winsvc_t* s) {
   }
   s->cv_space.notify_all();
   s->cv_data.notify_all();  // wake a drain call blocked on an empty queue
+  s->cv_decoded.notify_all();
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   s->acceptor.join();  // after this, no new slots can appear
@@ -810,6 +1008,7 @@ void bf_winsvc_stop(bf_winsvc_t* s) {
   }
   // Join without conn_m: exiting readers need it to close their fds.
   for (auto& sl : s->slots) sl.t.join();
+  for (auto& t : s->dpool) t.join();
   delete s;
 }
 
@@ -833,7 +1032,9 @@ struct TxSeg {
 struct TxPeer {
   std::string host;
   int32_t port = 0;
-  std::string key;  // "host:port"
+  int32_t stripe = 0;
+  std::string addr;  // "host:port" (partition match, per-peer aggregation)
+  std::string key;   // "host:port#stripe" (peer-map key)
   std::mutex m;
   std::condition_variable cv;
   std::vector<uint8_t> arena;     // encoded sub-message stream (guarded by m)
@@ -868,6 +1069,7 @@ struct bf_wintx {
   int32_t queue_max = 1024;
   int32_t retries = 1;
   double backoff_sec = 0.05;
+  int32_t stripes = 1;  // sockets/workers/arenas per peer endpoint
   std::mutex m;  // guards peers/all/partition
   std::map<std::string, TxPeer*> peers;      // active senders
   std::vector<std::unique_ptr<TxPeer>> all;  // every peer ever (joined at stop)
@@ -1013,7 +1215,7 @@ int SendFrameWithRetries(bf_wintx* t, TxPeer* p, const uint8_t* hdr,
                          size_t hlen, const uint8_t* body, size_t blen) {
   {
     std::lock_guard<std::mutex> lk(t->m);
-    if (t->partition.count(p->key)) return -7;  // chaos partition: no wire
+    if (t->partition.count(p->addr)) return -7;  // chaos partition: no wire
   }
   int attempt = 0;
   for (;;) {
@@ -1160,8 +1362,14 @@ void TxWorker(bf_wintx* t, TxPeer* p) {
   }
 }
 
-TxPeer* GetOrCreatePeer(bf_wintx* t, const char* host, int32_t port) {
-  std::string key = std::string(host) + ":" + std::to_string(port);
+std::string PeerAddr(const char* host, int32_t port) {
+  return std::string(host) + ":" + std::to_string(port);
+}
+
+TxPeer* GetOrCreatePeer(bf_wintx* t, const char* host, int32_t port,
+                        int32_t stripe) {
+  std::string addr = PeerAddr(host, port);
+  std::string key = addr + "#" + std::to_string(stripe);
   std::lock_guard<std::mutex> lk(t->m);
   // Checked under t->m: stop() sets the flag before taking this lock, so
   // once its join loop runs no new peer/worker can ever be appended.
@@ -1172,6 +1380,8 @@ TxPeer* GetOrCreatePeer(bf_wintx* t, const char* host, int32_t port) {
   TxPeer* p = owned.get();
   p->host = host;
   p->port = port;
+  p->stripe = stripe;
+  p->addr = std::move(addr);
   p->key = std::move(key);
   t->all.push_back(std::move(owned));
   t->peers[p->key] = p;
@@ -1179,11 +1389,15 @@ TxPeer* GetOrCreatePeer(bf_wintx* t, const char* host, int32_t port) {
   return p;
 }
 
-TxPeer* FindPeer(bf_wintx* t, const char* host, int32_t port) {
-  const std::string key = std::string(host) + ":" + std::to_string(port);
+// Every ACTIVE stripe sender of (host, port) — flush/err/stats/drop
+// operate on the whole peer, never one stripe.
+std::vector<TxPeer*> AddrPeers(bf_wintx* t, const char* host, int32_t port) {
+  const std::string addr = PeerAddr(host, port);
+  std::vector<TxPeer*> out;
   std::lock_guard<std::mutex> lk(t->m);
-  auto it = t->peers.find(key);
-  return it == t->peers.end() ? nullptr : it->second;
+  for (auto& kv : t->peers)
+    if (kv.second->addr == addr) out.push_back(kv.second);
+  return out;
 }
 
 int FlushPeer(TxPeer* p, double timeout_sec) {
@@ -1246,26 +1460,30 @@ extern "C" {
 
 bf_wintx_t* bf_wintx_start(uint64_t flush_bytes, uint64_t linger_us,
                            int32_t queue_max, int32_t retries,
-                           double backoff_sec) {
+                           double backoff_sec, int32_t stripes) {
   auto* t = new bf_wintx;
   if (flush_bytes > 0) t->flush_bytes = flush_bytes;
   t->linger_us = linger_us;
   if (queue_max > 0) t->queue_max = queue_max;
   t->retries = retries < 0 ? 0 : retries;
   t->backoff_sec = backoff_sec < 0.0 ? 0.0 : backoff_sec;
+  t->stripes = stripes < 1 ? 1 : stripes;
   return t;
 }
+
+int32_t bf_wintx_stripes(bf_wintx_t* t) { return t ? t->stripes : 1; }
 
 int32_t bf_wintx_send(bf_wintx_t* t, const char* host, int32_t port,
                       uint8_t op, const char* name, int32_t src, int32_t dst,
                       double weight, double p_weight, const uint8_t* payload,
-                      uint64_t payload_len, int32_t urgent) {
+                      uint64_t payload_len, int32_t urgent, int32_t stripe) {
   if (!t) return -5;
   InflightGuard guard(t->inflight);
   if (t->stopping.load(std::memory_order_acquire)) return -5;
   const size_t nlen = name ? std::strlen(name) : 0;
   if (nlen >= 128) return -4;  // deterministic, path-independent rejection
-  TxPeer* p = GetOrCreatePeer(t, host, port);
+  if (stripe < 0 || stripe >= t->stripes) stripe = 0;
+  TxPeer* p = GetOrCreatePeer(t, host, port, stripe);
   if (p == nullptr) return -5;  // raced a stop(): transport is closing
   std::unique_lock<std::mutex> lk(p->m);
   if (p->err_code != 0) {  // surface a stored async error at the producer
@@ -1333,9 +1551,8 @@ int32_t bf_wintx_flush(bf_wintx_t* t, const char* host, int32_t port,
   InflightGuard guard(t->inflight);
   std::vector<TxPeer*> targets;
   if (host != nullptr) {
-    TxPeer* p = FindPeer(t, host, port);
-    if (p == nullptr) return 0;  // unknown/retired peer: nothing queued
-    targets.push_back(p);
+    targets = AddrPeers(t, host, port);  // every stripe of the peer
+    if (targets.empty()) return 0;  // unknown/retired peer: nothing queued
   } else {
     std::lock_guard<std::mutex> lk(t->m);
     for (auto& kv : t->peers) targets.push_back(kv.second);
@@ -1343,7 +1560,7 @@ int32_t bf_wintx_flush(bf_wintx_t* t, const char* host, int32_t port,
   int first_err = 0;
   for (TxPeer* p : targets) {
     int rc = FlushPeer(p, timeout_sec);
-    if (rc != 0 && first_err == 0) first_err = rc;  // drain ALL peers
+    if (rc != 0 && first_err == 0) first_err = rc;  // drain ALL stripes
   }
   return first_err;
 }
@@ -1353,10 +1570,11 @@ int64_t bf_wintx_err_count(bf_wintx_t* t, const char* host, int32_t port) {
   InflightGuard guard(t->inflight);
   int64_t total = 0;
   if (host != nullptr) {
-    TxPeer* p = FindPeer(t, host, port);
-    if (p == nullptr) return 0;
-    std::lock_guard<std::mutex> lk(p->m);
-    return (int64_t)p->err_events;
+    for (TxPeer* p : AddrPeers(t, host, port)) {
+      std::lock_guard<std::mutex> lk(p->m);
+      total += (int64_t)p->err_events;
+    }
+    return total;
   }
   std::lock_guard<std::mutex> lk(t->m);
   for (auto& kv : t->peers) {
@@ -1386,19 +1604,26 @@ void bf_wintx_kick(bf_wintx_t* t) {
 int64_t bf_wintx_drop_peer(bf_wintx_t* t, const char* host, int32_t port) {
   if (!t) return 0;
   InflightGuard guard(t->inflight);
-  TxPeer* p;
+  std::vector<TxPeer*> peers;
   {
-    const std::string key = std::string(host) + ":" + std::to_string(port);
+    // Retire EVERY stripe of the peer under one map lock: a dead peer
+    // must never leave N-1 orphan stripe workers retrying into closed
+    // sockets while stripe 0 alone was torn down.
+    const std::string addr = PeerAddr(host, port);
     std::lock_guard<std::mutex> lk(t->m);
-    auto it = t->peers.find(key);
-    if (it == t->peers.end()) return 0;
-    p = it->second;
-    t->peers.erase(it);  // a later send lazily creates a fresh sender
+    for (auto it = t->peers.begin(); it != t->peers.end();) {
+      if (it->second->addr == addr) {
+        peers.push_back(it->second);
+        it = t->peers.erase(it);  // later sends lazily re-create stripes
+      } else {
+        ++it;
+      }
+    }
   }
-  int64_t dropped;
-  {
+  int64_t total = 0;
+  for (TxPeer* p : peers) {
     std::lock_guard<std::mutex> lk(p->m);
-    dropped = (int64_t)p->segs.size();
+    int64_t dropped = (int64_t)p->segs.size();
     p->segs.clear();
     p->arena.clear();
     p->bytes_pending = 0;
@@ -1412,8 +1637,9 @@ int64_t bf_wintx_drop_peer(bf_wintx_t* t, const char* host, int32_t port) {
     }
     p->closing.store(true, std::memory_order_release);
     p->cv.notify_all();
+    total += dropped;
   }
-  return dropped;
+  return total;
 }
 
 void bf_wintx_set_partition(bf_wintx_t* t, const char* csv) {
@@ -1440,14 +1666,26 @@ void bf_wintx_stats(bf_wintx_t* t, const char* host, int32_t port,
   if (!t) return;
   InflightGuard guard(t->inflight);
   if (host != nullptr) {
-    TxPeer* p = FindPeer(t, host, port);
-    if (p != nullptr) AddPeerStats(p, out);
+    for (TxPeer* p : AddrPeers(t, host, port)) AddPeerStats(p, out);
     return;
   }
   // Aggregate over every peer ever created (retired ones included) so
   // totals stay monotonic across drop_peer/recreate cycles.
   std::lock_guard<std::mutex> lk(t->m);
   for (auto& p : t->all) AddPeerStats(p.get(), out);
+}
+
+void bf_wintx_stripe_stats(bf_wintx_t* t, const char* host, int32_t port,
+                           int32_t stripe, bf_wintx_stats_t* out) {
+  if (!out) return;
+  std::memset(out, 0, sizeof(*out));
+  if (!t || host == nullptr) return;
+  InflightGuard guard(t->inflight);
+  const std::string key =
+      PeerAddr(host, port) + "#" + std::to_string(stripe);
+  std::lock_guard<std::mutex> lk(t->m);
+  auto it = t->peers.find(key);
+  if (it != t->peers.end()) AddPeerStats(it->second, out);
 }
 
 void bf_wintx_stop(bf_wintx_t* t) {

@@ -70,6 +70,7 @@ struct XEdge {
   double weight = 0.0;
   double p_weight = 0.0;
   int64_t row = 0;
+  int32_t stripe = 0;  // transport stripe, pinned at plan-compile time
 };
 
 struct XPlan {
@@ -168,7 +169,7 @@ int32_t SendSparse(bf_wintx_t* tx, const XPlan& p, const XEdge& e,
   return bf_wintx_send(tx, e.host.c_str(), e.port,
                        (uint8_t)(e.op | kXFlagSparse), p.name.c_str(),
                        e.src, e.dst, e.weight, e.p_weight, payload.data(),
-                       payload.size(), 0);
+                       payload.size(), 0, e.stripe);
 }
 
 int32_t PlanRun(int64_t plan, const void* txp, const float* data,
@@ -192,14 +193,15 @@ int32_t PlanRun(int64_t plan, const void* txp, const float* data,
                          (uint8_t)(e.op | kXFlagBf16), p->name.c_str(),
                          e.src, e.dst, e.weight, e.p_weight,
                          (const uint8_t*)half.data(),
-                         (uint64_t)p->elems * 2, 0);
+                         (uint64_t)p->elems * 2, 0, e.stripe);
     } else {
       // Dense: the row pointer goes straight into the arena copy — the
       // zero-staging-copy fast path (the weight rides the wire header;
       // the receiver scales, exactly like the Python remote-edge path).
       rc = bf_wintx_send(tx, e.host.c_str(), e.port, e.op, p->name.c_str(),
                          e.src, e.dst, e.weight, e.p_weight,
-                         (const uint8_t*)row, (uint64_t)p->elems * 4, 0);
+                         (const uint8_t*)row, (uint64_t)p->elems * 4, 0,
+                         e.stripe);
     }
     if (rc != 0) return rc;  // first failing edge stops the dispatch
   }
@@ -228,7 +230,7 @@ int64_t bf_xla_plan_new(const char* name, int64_t elems, int32_t n_edges,
 
 int32_t bf_xla_plan_edge(int64_t plan, int32_t i, const char* host,
                          int32_t port, uint8_t op, int32_t src, int32_t dst,
-                         double weight, int64_t row) {
+                         double weight, int64_t row, int32_t stripe) {
   auto p = FindPlan(plan);
   if (!p || !host || i < 0 || (size_t)i >= p->edges.size()) return -9;
   XEdge& e = p->edges[(size_t)i];
@@ -239,6 +241,7 @@ int32_t bf_xla_plan_edge(int64_t plan, int32_t i, const char* host,
   e.dst = dst;
   e.weight = weight;
   e.row = row;
+  e.stripe = stripe < 0 ? 0 : stripe;
   return 0;
 }
 

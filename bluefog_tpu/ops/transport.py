@@ -41,6 +41,20 @@ fallback AND the equivalence oracle (same wire frames, bit-identical
 folded state — ``tests/test_transport_batch.py``); the native path
 auto-falls back to it whenever the ``.so`` is missing, stale, or predates
 the ``bf_wintx`` symbols.
+
+Multi-stream striping (``BLUEFOG_TPU_WIN_STRIPES``, default auto): every
+peer endpoint is driven by N independent sockets + sender workers + send
+arenas (both hot paths), with frames sharded deterministically by
+(window, row) — each stripe is an independent FIFO, so same-slot ordering
+is preserved per stripe while a single fat DCN link is saturated by N
+parallel streams instead of one.  Fences and mutex releases fan out
+across all stripes of the addressed peer and complete only when every
+stripe has drained (``ops/window.py`` counts the copies); ``auto`` sizes
+N from the placement model's ``dcn_link_cost`` and stays at 1 — the
+bitwise single-stream wire behavior — on flat hosts.  The drain side
+gains a small decode pool (``BLUEFOG_TPU_WIN_DECODE_THREADS``): inbound
+frames from different connections decode/scale/fold in parallel C++
+workers while the drain emits in exact arrival order.
 """
 
 from __future__ import annotations
@@ -49,6 +63,7 @@ import ctypes
 import struct
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -98,7 +113,7 @@ __all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
            "OP_GET_REPLY", "OP_FENCE_REQ", "OP_FENCE_ACK", "OP_MUTEX_ACQ",
            "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BATCH", "OP_MEMBER",
            "OP_BF16_FLAG", "OP_SPARSE_FLAG", "OP_FLAG_MASK",
-           "sparse_encode", "sparse_decode"]
+           "sparse_encode", "sparse_decode", "stripe_for", "resolve_stripes"]
 
 _OP_NAMES = {OP_PUT: "put", OP_ACCUMULATE: "accumulate",
              OP_GET_REQ: "get_req", OP_GET_REPLY: "get_reply",
@@ -121,6 +136,69 @@ _URGENT_OPS = frozenset((OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
 def _op_label(op: int) -> str:
     """Telemetry label for a wire op code (compression flags stripped)."""
     return _OP_NAMES.get(op & ~OP_FLAG_MASK, str(op))
+
+
+# ---------------------------------------------------------------------------
+# Multi-stream striping (BLUEFOG_TPU_WIN_STRIPES)
+# ---------------------------------------------------------------------------
+# Every peer endpoint is driven by N independent sockets + sender workers
+# + send arenas; wire frames shard DETERMINISTICALLY by (window, row) so
+# each stripe is an independent FIFO.  Same-slot ordering (consecutive
+# puts/accumulates into one (window, src) row) is preserved because the
+# shard key pins an edge's messages to one stripe; fences and mutex
+# releases fan out across all stripes of the addressed peer and complete
+# only when every stripe has drained (ops/window.py owns that counting).
+# Data ops shard; control singles (GET traffic, mutex ACQ/GRANT, fence
+# ACKs, membership heartbeats) ride stripe 0, whose FIFO they never
+# needed relative to data anyway.
+
+_DATA_OPS = frozenset((OP_PUT, OP_ACCUMULATE, OP_GET_REPLY))
+_crc_cache: Dict[str, int] = {}
+
+
+def stripe_for(name: str, src: int, op: int, n_stripes: int) -> int:
+    """Deterministic transport stripe of one wire message: data ops shard
+    by (window, row = src rank), everything else pins stripe 0.  Pure
+    function of its arguments (crc32, not ``hash``) so every dispatch
+    path — Python sender, native sender, compiled XLA put plans — routes
+    one edge's traffic onto the same FIFO."""
+    if n_stripes <= 1 or (op & ~OP_FLAG_MASK) not in _DATA_OPS:
+        return 0
+    crc = _crc_cache.get(name)
+    if crc is None:
+        crc = _crc_cache[name] = zlib.crc32(name.encode())
+    return (crc + (src if src > 0 else 0)) % n_stripes
+
+
+def resolve_stripes() -> int:
+    """The effective stripe count: an explicit ``BLUEFOG_TPU_WIN_STRIPES``
+    wins; ``auto`` derives it from the placement model's ``dcn_link_cost``
+    (a DCN crossing modeled k× an ICI hop gets ~k parallel streams,
+    capped at 8 — the HiCCL sizing argument), and flat hosts / no model
+    stay at 1, the bitwise single-stream wire behavior."""
+    cfg = config.get()
+    if cfg.win_stripes >= 1:
+        return cfg.win_stripes
+    try:
+        from bluefog_tpu import basics
+        model = basics._ctx._placement_state[0]
+    except Exception:  # noqa: BLE001 — pre-init transports (chaos gangs)
+        model = None
+    if model is None:
+        return 1
+    return max(1, min(8, int(round(float(model.dcn_link_cost)))))
+
+
+def _resolve_decode_threads() -> int:
+    """Drain-decode pool size: explicit knob wins; ``auto`` leaves one
+    core for the drain/apply thread and floors at 1 — even a single
+    worker pipelines decode ahead of the Python apply — capped at 4
+    (decode is memory-bound well before that)."""
+    cfg = config.get()
+    if cfg.win_decode_threads >= 0:
+        return cfg.win_decode_threads
+    import os
+    return max(1, min(4, (os.cpu_count() or 2) - 1))
 
 
 # Payload size above which the ctypes-fallback send passes the RAW data
@@ -260,17 +338,20 @@ def _decode_batch(buf) -> List[Msg]:
 # ---------------------------------------------------------------------------
 
 class _PeerSender:
-    """One bounded queue + one worker thread per peer endpoint.
+    """One bounded queue + one worker thread per (peer endpoint, stripe).
 
-    Parallel across peers (a slow neighbor only stalls its own queue),
-    FIFO within a peer (one worker, one pooled native connection).  The
-    worker flushes on: queue bytes >= the coalesce threshold, an urgent
-    control op, an explicit flush(), or the linger timeout — whichever
-    comes first."""
+    Parallel across peers (a slow neighbor only stalls its own queue) AND
+    across stripes of one peer (N independent streams drive one fat DCN
+    link), FIFO within a stripe (one worker, one pooled native
+    connection).  The worker flushes on: queue bytes >= the coalesce
+    threshold, an urgent control op, an explicit flush(), or the linger
+    timeout — whichever comes first."""
 
-    def __init__(self, transport: "WindowTransport", host: str, port: int):
+    def __init__(self, transport: "WindowTransport", host: str, port: int,
+                 stripe: int = 0):
         self._t = transport
         self.host, self.port = host, port
+        self.stripe = stripe
         self.peer = f"{host}:{port}"
         self.cond = threading.Condition()
         self.q: deque = deque()           # of Msg; guarded by cond
@@ -294,7 +375,8 @@ class _PeerSender:
         self.seq_enq = 0
         self.seq_done = 0
         self.thread = threading.Thread(
-            target=self._run, daemon=True, name=f"bf-win-tx-{self.peer}")
+            target=self._run, daemon=True,
+            name=f"bf-win-tx-{self.peer}#{stripe}")
         self.thread.start()
 
     def enqueue(self, msg: Msg, urgent: bool) -> None:
@@ -410,7 +492,8 @@ class _PeerSender:
                 self.flush_now = bool(self.q)  # keep draining a backlog
                 self.cond.notify_all()  # wake backpressured producers
             try:
-                self._t._send_frames(self.host, self.port, batch)
+                self._t._send_frames(self.host, self.port, batch,
+                                     stripe=self.stripe)
             except Exception as e:  # noqa: BLE001 — surfaced to callers
                 import logging
                 logging.getLogger("bluefog_tpu").warning(
@@ -429,9 +512,11 @@ class _PeerSender:
                     if telemetry.enabled():
                         # Residual backlog AFTER the drain: 0 when the
                         # sender keeps up, pinned near the queue bound
-                        # when this peer backpressures us.
+                        # when this peer backpressures us.  Per-stripe:
+                        # an imbalanced shard shows up as one hot stripe.
                         telemetry.set_gauge("bf_win_tx_queue_depth",
-                                            len(self.q), peer=self.peer)
+                                            len(self.q), peer=self.peer,
+                                            stripe=str(self.stripe))
                     self.cond.notify_all()
 
 
@@ -478,10 +563,14 @@ class WindowTransport:
         self._tx_queue_max = max(1, cfg.win_tx_queue)
         self._retries = max(0, cfg.win_retries)
         self._retry_backoff = max(0.0, cfg.win_retry_backoff_ms) / 1e3
+        # Multi-stream striping: N sockets + sender workers + send arenas
+        # per peer, frames sharded by (window, row).  1 (the no-model
+        # auto default) is the bitwise single-stream wire behavior.
+        self.n_stripes = resolve_stripes()
         # Peers declared unreachable by chaos fault injection: sends fail
         # immediately, nothing rides the wire (set_partition).
         self._partitioned: frozenset = frozenset()
-        self._senders: Dict[Tuple[str, int], _PeerSender] = {}
+        self._senders: Dict[Tuple[str, int, int], _PeerSender] = {}
         self._senders_lock = threading.Lock()
         # Cumulative coalescing stats behind one lock: sender workers on
         # several threads update them, and a racy read-modify-write would
@@ -499,10 +588,12 @@ class WindowTransport:
         self.native_path = (self.coalesce and bool(cfg.win_native)
                             and native.has_win_native())
         self._tx = None
+        self.decode_threads = 0
         if self.native_path:
             self._tx = self._lib.bf_wintx_start(
                 self._flush_bytes, int(self._linger * 1e6),
-                self._tx_queue_max, self._retries, self._retry_backoff)
+                self._tx_queue_max, self._retries, self._retry_backoff,
+                self.n_stripes)
             if not self._tx:
                 self.native_path = False
         if self.native_path:
@@ -522,12 +613,20 @@ class WindowTransport:
             self._tx_pump_last = 0.0  # rate-limits the stats pump
             self._rx_last = native.WinRxStats()
             self._peer_last: Dict[Tuple[str, int], Tuple] = {}
+            self._stripe_last: Dict[Tuple[str, int, int], int] = {}
             # Drain buffers (grown on demand): ordered item array, raw
             # payload bytes, folded f32 values.
             self._items_cap = 512
             self._items = (native.WinItem * self._items_cap)()
             self._raw_buf = np.empty(1 << 20, dtype=np.uint8)
             self._val_buf = np.empty(1 << 18, dtype=np.float32)
+            # Drain-side decode pool: inbound frames from different
+            # connections (and different stripes of one peer) decode,
+            # scale and fold in parallel C++ workers; bf_winsvc_drain
+            # emits in exact arrival order, so the fence/mutex FIFO
+            # contract is untouched (0 = inline decode, bit-identical).
+            self.decode_threads = int(self._lib.bf_winsvc_set_decode(
+                self._svc, _resolve_decode_threads()))
             from bluefog_tpu.utils import telemetry
             telemetry.set_gauge("bf_win_native_active", 1)
         self._stop = threading.Event()
@@ -555,7 +654,13 @@ class WindowTransport:
     # -- outbound ----------------------------------------------------------
     def send(self, host: str, port: int, op: int, name: str, src: int,
              dst: int, weight: float, tensor: np.ndarray,
-             p_weight: float = 0.0) -> None:
+             p_weight: float = 0.0, stripe: Optional[int] = None) -> None:
+        if stripe is None:
+            # Deterministic (window, row) shard: an edge's whole message
+            # stream rides ONE stripe FIFO.  Explicit stripes come from
+            # the fence/mutex fan-out (ops/window.py), which must address
+            # every stripe of a peer.
+            stripe = stripe_for(name, src, op, self.n_stripes)
         if self._tx is not None:
             # Native fast path: ONE ctypes call — enqueue onto the C++
             # per-peer queue (blocking backpressure in C, GIL released).
@@ -577,14 +682,15 @@ class WindowTransport:
                 try:
                     rc = self._fc_send(self._tx, hb, port, op, nb, src,
                                        dst, float(weight), float(p_weight),
-                                       tensor, urgent)
+                                       tensor, urgent, stripe)
                 except (BufferError, TypeError):
                     blob = np.ascontiguousarray(tensor).tobytes()
                     from bluefog_tpu.ops import xlaffi
                     xlaffi.count_host_copy(len(blob), "enqueue")
                     rc = self._fc_send(
                         self._tx, hb, port, op, nb, src, dst,
-                        float(weight), float(p_weight), blob, urgent)
+                        float(weight), float(p_weight), blob, urgent,
+                        stripe)
             else:
                 # ctypes fallback: tobytes() for small rows (bytes→char*
                 # is ctypes' cheapest conversion and the copy is ~free at
@@ -593,7 +699,8 @@ class WindowTransport:
                 # dwarfs the ~µs pointer-extraction cost it was avoiding.
                 arg, nbytes, keepalive = _ctypes_payload(tensor)
                 rc = self._tx_send(self._tx, hb, port, op, nb, src, dst,
-                                   weight, p_weight, arg, nbytes, urgent)
+                                   weight, p_weight, arg, nbytes, urgent,
+                                   stripe)
                 del keepalive  # native enqueue copied before returning
             if rc == 0:
                 return
@@ -641,7 +748,7 @@ class WindowTransport:
         xlaffi.count_host_copy(payload.size, "enqueue")
         msg: Msg = (op, name, src, dst, float(weight), float(p_weight),
                     payload.tobytes())
-        self._sender(host, port).enqueue(
+        self._sender(host, port, stripe).enqueue(
             msg, urgent=(op & ~OP_FLAG_MASK) in _URGENT_OPS)
 
     def kick(self) -> None:
@@ -673,16 +780,21 @@ class WindowTransport:
             self._lib.bf_wintx_set_partition(self._tx, csv.encode())
 
     def drop_peer(self, host: str, port: int) -> None:
-        """Retire a peer's sender queue cleanly (churn controller: the peer
-        is dead by consensus).  Queued messages to it are discarded — there
-        is no one left to receive them — and producers blocked in its
-        backpressure wait are released with a ConnectionError.  Idempotent;
-        a later send to the same address would lazily create a fresh
-        sender (peer restart)."""
+        """Retire EVERY stripe of a peer's sender cleanly (churn
+        controller: the peer is dead by consensus).  Queued messages to it
+        are discarded — there is no one left to receive them — producers
+        blocked in any stripe's backpressure wait are released with a
+        ConnectionError, and every per-stripe queue-depth gauge is
+        cleared: a dead peer must never leave N-1 orphan stripe workers
+        retrying into closed sockets or stale gauge series behind.
+        Idempotent; a later send to the same address would lazily create
+        fresh stripe senders (peer restart)."""
+        from bluefog_tpu.utils import telemetry
         if self._tx is not None:
             # Same retirement on the native queues (churn supervisor
-            # follow-up): the C++ worker exits instead of retrying into a
-            # closed socket; discarded messages keep their counter.
+            # follow-up): every stripe's C++ worker exits instead of
+            # retrying into a closed socket; discarded messages keep
+            # their counter (summed over stripes in C).
             dropped = int(self._lib.bf_wintx_drop_peer(
                 self._tx, host.encode(), port))
             # Prune the stats-pump bookkeeping so a long churny job never
@@ -691,38 +803,42 @@ class WindowTransport:
             # send, exactly like the native peer itself).
             self._peer_addrs.discard((host, port))
             self._peer_last.pop((host, port), None)
-            from bluefog_tpu.utils import telemetry
-            telemetry.clear_gauge("bf_win_tx_queue_depth",
-                                  peer=f"{host}:{port}")
+            for k in range(self.n_stripes):
+                telemetry.clear_gauge("bf_win_tx_queue_depth",
+                                      peer=f"{host}:{port}", stripe=str(k))
             if dropped and telemetry.enabled():
                 telemetry.inc("bf_win_tx_dropped_msgs_total", float(dropped),
                               peer=f"{host}:{port}")
             return
         with self._senders_lock:
-            s = self._senders.pop((host, port), None)
-        if s is None:
-            return
-        with s.cond:
-            dropped = len(s.q)
-            s.q.clear()
-            s.bytes_pending = 0
-            # Account the discarded messages as done-with-error so a
-            # producer already blocked in flush() fails IMMEDIATELY (error
-            # checked before seq_done) instead of waiting out the closing
-            # grace for messages that can never be handed to TCP.
-            s.seq_done = s.seq_enq
-            if dropped:
-                s.error = ConnectionError(
-                    f"win transport peer {s.peer} retired by the churn "
-                    f"controller with {dropped} queued message(s) "
-                    "discarded")
-                s.err_count += 1
-            s.closing = True
-            s.cond.notify_all()
+            senders = [self._senders.pop(k)
+                       for k in [k for k in self._senders
+                                 if k[:2] == (host, port)]]
+        dropped = 0
+        for s in senders:
+            with s.cond:
+                n = len(s.q)
+                dropped += n
+                s.q.clear()
+                s.bytes_pending = 0
+                # Account the discarded messages as done-with-error so a
+                # producer already blocked in flush() fails IMMEDIATELY
+                # (error checked before seq_done) instead of waiting out
+                # the closing grace for messages that can never be handed
+                # to TCP.
+                s.seq_done = s.seq_enq
+                if n:
+                    s.error = ConnectionError(
+                        f"win transport peer {s.peer} retired by the churn "
+                        f"controller with {n} queued message(s) discarded")
+                    s.err_count += 1
+                s.closing = True
+                s.cond.notify_all()
+            telemetry.clear_gauge("bf_win_tx_queue_depth", peer=s.peer,
+                                  stripe=str(s.stripe))
         # No join: a worker stuck in a connect to a blackholed host exits
         # on its own when the native call returns (daemon thread, closing
         # set) — recovery must not pay that timeout.
-        from bluefog_tpu.utils import telemetry
         if dropped and telemetry.enabled():
             telemetry.inc("bf_win_tx_dropped_msgs_total", float(dropped),
                           peer=f"{host}:{port}")
@@ -741,11 +857,14 @@ class WindowTransport:
         return sum(s.err_count for s in self._select_senders(addrs))
 
     def _select_senders(self, addrs) -> List[_PeerSender]:
+        """Senders for the given ``(host, port)`` addresses — EVERY stripe
+        of each address (flush/error scoping is per peer, never per
+        stripe: an op's edges may have sharded onto any of them)."""
         with self._senders_lock:
             if addrs is None:
                 return list(self._senders.values())
             want = set(addrs)
-            return [s for k, s in self._senders.items() if k in want]
+            return [s for k, s in self._senders.items() if k[:2] in want]
 
     def flush(self, timeout: float = 300.0, addrs=None,
               since: Optional[int] = None) -> None:
@@ -870,7 +989,9 @@ class WindowTransport:
                 [cur.send_sec_hist[i] - last.send_sec_hist[i]
                  for i in range(25)],
                 cur.send_sec_sum - last.send_sec_sum, op="native")
-            # Per-peer series (bytes, errors, retries, queue depth).
+            # Per-peer series (bytes, errors, retries) + per-STRIPE series
+            # (stripe bytes, stripe queue depth — an imbalanced (window,
+            # row) shard shows up as one hot stripe here).
             for (h, p) in list(self._peer_addrs):
                 ps = native.WinTxStats()
                 self._lib.bf_wintx_stats(tx, h.encode(), p,
@@ -893,9 +1014,20 @@ class WindowTransport:
                 if d:
                     telemetry.inc("bf_win_tx_retries_total", float(d),
                                   peer=peer)
-                telemetry.set_gauge("bf_win_tx_queue_depth",
-                                    float(ps.queue_len), peer=peer)
                 self._peer_last[(h, p)] = (ps.bytes, ps.errors, ps.retries)
+                for k in range(self.n_stripes):
+                    ss = native.WinTxStats()
+                    self._lib.bf_wintx_stripe_stats(tx, h.encode(), p, k,
+                                                    ctypes.byref(ss))
+                    lsb = self._stripe_last.get((h, p, k), 0)
+                    d = max(0, ss.bytes - lsb)
+                    if d:
+                        telemetry.inc("bf_win_tx_stripe_bytes_total",
+                                      float(d), peer=peer, stripe=str(k))
+                    telemetry.set_gauge("bf_win_tx_queue_depth",
+                                        float(ss.queue_len), peer=peer,
+                                        stripe=str(k))
+                    self._stripe_last[(h, p, k)] = ss.bytes
 
     def _pump_native_rx_stats(self) -> None:
         """Diff the cumulative native drain counters into telemetry (same
@@ -924,25 +1056,37 @@ class WindowTransport:
         d = cur.commits - last.commits
         if d > 0:
             telemetry.inc("bf_win_native_rx_commits_total", float(d))
+        if self.decode_threads > 0:
+            # Decode-pool utilization: workers busy at snapshot time.
+            # Pinned at the pool size means inbound decode is the
+            # bottleneck — raise BLUEFOG_TPU_WIN_DECODE_THREADS.
+            telemetry.set_gauge("bf_win_rx_decode_pool_busy",
+                                float(cur.decode_busy))
         telemetry.observe_bucket_counts(
             "bf_win_rx_batch_size",
             [cur.batch_size_hist[i] - last.batch_size_hist[i]
              for i in range(25)],
             cur.batch_size_sum - last.batch_size_sum)
 
-    def _sender(self, host: str, port: int) -> _PeerSender:
-        key = (host, port)
+    def _sender(self, host: str, port: int, stripe: int = 0) -> _PeerSender:
+        key = (host, port, stripe)
         with self._senders_lock:
             s = self._senders.get(key)
             if s is None:
-                s = self._senders[key] = _PeerSender(self, host, port)
+                s = self._senders[key] = _PeerSender(self, host, port,
+                                                     stripe)
             return s
 
-    def _send_frames(self, host: str, port: int, batch: List[Msg]) -> None:
+    def _send_frames(self, host: str, port: int, batch: List[Msg],
+                     stripe: int = 0) -> None:
         """Worker-side: ship a drained queue as ONE native send (an
         OP_BATCH frame), or as the plain single frame when only one message
         coalesced (no container overhead, bit-identical legacy wire)."""
         from bluefog_tpu.utils import telemetry
+        if telemetry.enabled():
+            telemetry.inc("bf_win_tx_stripe_bytes_total",
+                          float(sum(len(m[6]) for m in batch)),
+                          peer=f"{host}:{port}", stripe=str(stripe))
         if len(batch) == 1:
             op, name, src, dst, weight, p_weight, payload = batch[0]
             blob = np.frombuffer(payload, np.uint8)

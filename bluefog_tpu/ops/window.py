@@ -175,6 +175,21 @@ class _Distrib:
         self.cv = threading.Condition()
         self.pending_gets: Dict[tuple, int] = {}   # (name, dst, src) -> n
         self.fence_acks = 0
+        # Striped-transport fan-out counting (guarded by cv): FENCE_REQ
+        # and MUTEX_REL ride EVERY stripe of a peer (each stripe is an
+        # independent FIFO, so only the full set certifies that all data
+        # sent before them has drained); the copies carry their fan-out
+        # width in the wire `weight` field plus a sender-side SERIAL in
+        # `p_weight`, and the receiver acts on the LAST copy of the
+        # NEWEST serial.  The serial makes a partially-delivered fan-out
+        # (one stripe's copy lost to a send failure) harmless: its stale
+        # leftover count can never complete a LATER fan-out early —
+        # copies of an older serial are discarded, a newer serial resets
+        # the count.  Keys: requesting rank (fence) / (name, rank,
+        # requester) (mutex release); values: (serial, copies seen).
+        self.fence_req_seen: Dict[int, tuple] = {}
+        self.rel_seen: Dict[tuple, tuple] = {}
+        self.fanout_serial = 0  # monotonic per process, guarded by cv
         # remote-mutex bookkeeping.  grant_events is safe keyed on
         # (name, rank) because mutex_serial allows one outstanding ACQ per
         # (name, rank) per process; different processes land in distinct
@@ -528,7 +543,8 @@ def _sparse_payload(name: str, src: int, dst: int,
 
 def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
                   weight: float, p_weight: float = 0.0,
-                  payload: Optional[np.ndarray] = None) -> None:
+                  payload: Optional[np.ndarray] = None,
+                  stripe: Optional[int] = None) -> None:
     d = _store.distrib
     host, port = d.proc_addr[proc]
     comp = config.get().win_compression
@@ -566,14 +582,57 @@ def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
         telemetry.inc("bf_comm_level_bytes_total", float(payload.nbytes),
                       level="dcn")
     d.transport.send(host, port, op, name, src, dst, weight, payload,
-                     p_weight)
+                     p_weight, stripe=stripe)
 
 
 def _send_to_rank_owner(rank: int, op: int, name: str, src: int, dst: int,
                         weight: float, p_weight: float = 0.0,
-                        payload: Optional[np.ndarray] = None) -> None:
+                        payload: Optional[np.ndarray] = None,
+                        stripe: Optional[int] = None) -> None:
     _send_to_proc(_store.distrib.rank_owner[rank], op, name, src, dst,
-                  weight, p_weight, payload)
+                  weight, p_weight, payload, stripe=stripe)
+
+
+def _transport_stripes(d) -> int:
+    """The live transport's stripe width (1 when unknown: fakes/tests)."""
+    return int(getattr(d.transport, "n_stripes", 1) or 1)
+
+
+def _fanout_weight(n_stripes: int) -> float:
+    """Wire ``weight`` of a FENCE_REQ / MUTEX_REL fan-out copy: the copy
+    count, carried on the wire so the receiver — whatever its OWN stripe
+    setting — acts on the last copy.  Exactly 0.0 single-stream, keeping
+    the ``BLUEFOG_TPU_WIN_STRIPES=1`` wire bitwise-identical to the
+    pre-stripe transport (receivers treat weight < 2 as one copy)."""
+    return float(n_stripes) if n_stripes > 1 else 0.0
+
+
+def _fanout_serial(d, n_stripes: int) -> float:
+    """Wire ``p_weight`` of a fan-out's copies: a per-process monotonic
+    serial shared by every copy of ONE fan-out, so the receiver's count
+    can never be completed by stale copies of an earlier, partially
+    delivered fan-out.  Exactly 0.0 single-stream (one copy, no counting
+    — the pre-stripe wire, bit for bit)."""
+    if n_stripes <= 1:
+        return 0.0
+    with d.cv:
+        d.fanout_serial += 1
+        return float(d.fanout_serial)
+
+
+def _fanout_count(seen: dict, key, serial: float):
+    """Advance one fan-out counter for an arriving copy (call under
+    ``d.cv``).  Returns the copies seen for ``serial``, or None when the
+    copy belongs to an OLDER fan-out than the one being counted (stale —
+    discard).  The counter entry is ``(serial, count)``; a newer serial
+    resets the count, so a lost copy only strands ITS OWN fan-out (whose
+    sender already surfaced the send failure) and never a later one."""
+    cur = seen.get(key)
+    if cur is not None and cur[0] > serial:
+        return None  # stale copy of an earlier fan-out
+    count = cur[1] + 1 if cur is not None and cur[0] == serial else 1
+    seen[key] = (serial, count)
+    return count
 
 
 def _flush_transport(procs=None, since=None, timeout=None) -> None:
@@ -710,8 +769,18 @@ def _remote_mutex(name: str, rank: int, my_rank: int):
             try:
                 proc = d.rank_owner[rank]
                 tok = d.transport.error_token({d.proc_addr[proc]})
-                _send_to_rank_owner(rank, OP_MUTEX_REL, name, my_rank,
-                                    rank, 0.0)
+                # Striped transport: the REL fans out across EVERY stripe
+                # of the owner (copy count in the wire weight field), so
+                # the owner releases only when each stripe — any of which
+                # may carry this critical section's puts — has drained
+                # past the release.  Single-stream sends exactly one copy
+                # with weight 0.0: the pre-stripe wire, bit for bit.
+                n_str = _transport_stripes(d)
+                w = _fanout_weight(n_str)
+                serial = _fanout_serial(d, n_str)
+                for k in range(n_str):
+                    _send_to_rank_owner(rank, OP_MUTEX_REL, name, my_rank,
+                                        rank, w, p_weight=serial, stripe=k)
                 # As with the legacy blocking send, a REL that cannot
                 # reach the owner raises here (the owner would otherwise
                 # hold the mutex until its own timeout).
@@ -732,10 +801,18 @@ def _hold_mutex_for_remote(name: str, rank: int, requester: int) -> None:
         return
     release = threading.Event()
     key = (name, rank, requester)
-    with d.cv:
-        d.remote_holds[key] = release
     try:
         with win.mutexes[rank]:
+            # Register only AFTER the mutex is ours: with the striped REL
+            # fan-out, a PREDECESSOR hold's late release copies may still
+            # be arriving while this thread blocks on the acquire —
+            # registering early would let that release's completion set
+            # OUR event (a premature release breaking mutual exclusion).
+            # The requester sends its REL only after our GRANT, which
+            # follows this registration, so no release aimed at us can
+            # race it.
+            with d.cv:
+                d.remote_holds[key] = release
             proc = d.rank_owner[requester]
             tok = d.transport.error_token({d.proc_addr[proc]})
             _send_to_rank_owner(requester, OP_MUTEX_GRANT, name, requester,
@@ -788,6 +865,19 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
                 return
             d = _store.distrib
     if op == OP_FENCE_REQ:
+        # Striped fan-out: the requester sent one copy down EVERY stripe
+        # (count in `weight`, serial in `p_weight`; weight < 2 = the
+        # single-stream wire).  Only the LAST copy of the NEWEST serial
+        # is answered — each stripe is FIFO, so the full set arriving
+        # certifies every put sent before the fence has been applied,
+        # whichever stripe it sharded onto.
+        total = int(weight) if weight >= 2.0 else 1
+        if total > 1:
+            with d.cv:
+                seen = _fanout_count(d.fence_req_seen, src, p_weight)
+                if seen is None or seen < total:
+                    return
+                d.fence_req_seen.pop(src, None)
         _store.svc_pool.submit(_send_to_rank_owner, src, OP_FENCE_ACK, "",
                                src, dst, 0.0)
         return
@@ -803,7 +893,21 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
             ev.set()
         return
     if op == OP_MUTEX_REL:
+        # Same fan-out counting as FENCE_REQ: the REL travels every
+        # stripe, and the mutex is released only when ALL copies of the
+        # newest serial arrived — i.e. when every stripe that might
+        # carry the critical section's puts has drained past the
+        # release point.  A stale count left by a PARTIALLY delivered
+        # earlier release (one copy lost to a send failure the requester
+        # already saw) can never complete a later one early.
+        total = int(weight) if weight >= 2.0 else 1
         with d.cv:
+            if total > 1:
+                key = (name, dst, src)
+                seen = _fanout_count(d.rel_seen, key, p_weight)
+                if seen is None or seen < total:
+                    return
+                d.rel_seen.pop(key, None)
             ev = d.remote_holds.get((name, dst, src))
         if ev is not None:
             ev.set()
@@ -1961,7 +2065,10 @@ def win_fence(name: Optional[str] = None) -> None:
     transport message any process sent before its fence has been applied at
     its target, and all processes have reached the fence.  Per-connection
     TCP FIFO makes the ack exact: our FENCE_REQ trails our puts on the same
-    stream, so the peer's ack certifies those puts were applied."""
+    stream, so the peer's ack certifies those puts were applied.  On the
+    striped transport the REQ fans out across every stripe of each peer
+    and the ack answers the LAST copy — the same certificate, per
+    stripe."""
     from bluefog_tpu import basics
     basics._require_active()
     with _store.lock:
@@ -1987,8 +2094,19 @@ def win_fence(name: Optional[str] = None) -> None:
         with d.cv:
             d.fence_acks = 0
         tok = d.transport.error_token()
+        # Striped transport: one FENCE_REQ copy rides EVERY stripe of
+        # each peer (the copy count travels in the wire weight field),
+        # and the peer acks only the last copy — so the ack certifies
+        # that every stripe, any of which may carry this process's puts,
+        # has drained past the fence.  Single-stream sends exactly one
+        # copy with weight 0.0 (the pre-stripe wire, bit for bit).
+        n_str = _transport_stripes(d)
+        w = _fanout_weight(n_str)
+        serial = _fanout_serial(d, n_str)
         for p in peers:
-            _send_to_proc(p, OP_FENCE_REQ, name or "", d.my_rank, -1, 0.0)
+            for k in range(n_str):
+                _send_to_proc(p, OP_FENCE_REQ, name or "", d.my_rank, -1,
+                              w, p_weight=serial, stripe=k)
         # Fence requests always flush the peer's queue first: FENCE_REQ is
         # an urgent op (enqueued BEHIND any still-queued puts, flushed on
         # sight), and this explicit drain surfaces send errors before the

@@ -272,10 +272,19 @@ def prepare_put(d, win, name: str, op: int,
                 lib.bf_xla_plan_free(gpid)
             return None
         ok = True
+        # The per-edge transport stripe is pinned AT COMPILE TIME, with
+        # the same deterministic (window, row) shard the host sender
+        # computes — a plan-dispatched edge and a host-dispatched edge
+        # always ride the same FIFO, so mixing paths on one edge can
+        # never reorder its stream.
+        from bluefog_tpu.ops.transport import stripe_for
+        n_stripes = int(getattr(d.transport, "n_stripes", 1) or 1)
         for i, ((src, dst), w) in enumerate(grp):
             host, port = d.proc_addr[d.rank_owner[dst]]
             if lib.bf_xla_plan_edge(pid, i, host.encode(), port, op, src,
-                                    dst, float(w), win.row_of[src]) != 0:
+                                    dst, float(w), win.row_of[src],
+                                    stripe_for(name, src, op,
+                                               n_stripes)) != 0:
                 ok = False
                 break
         if not ok:

@@ -105,9 +105,21 @@ class ChurnSupervisor:
 
     def _send(self, proc: int, payload: bytes) -> None:
         host, port = self._d.proc_addr[proc]
-        self._d.transport.send(host, port, self._OP_MEMBER, "",
-                               self._d.my_rank, -1, 0.0,
-                               np.frombuffer(payload, np.uint8))
+        # Striped transport: membership traffic fans out across EVERY
+        # stripe, preserving the PR-7 invariant that a peer whose data
+        # path is wedged cannot look healthy through a side channel the
+        # data never takes — with one socket per peer the heartbeat rode
+        # THE data stream; with N, a single wedged stripe must still
+        # wedge the heartbeats that ride it (membership messages are
+        # state-based and idempotent, so the duplicate copies on healthy
+        # stripes are harmless).  Single-stream sends exactly one copy,
+        # the pre-stripe behavior.
+        n = int(getattr(self._d.transport, "n_stripes", 1) or 1)
+        for k in range(n):
+            self._d.transport.send(host, port, self._OP_MEMBER, "",
+                                   self._d.my_rank, -1, 0.0,
+                                   np.frombuffer(payload, np.uint8),
+                                   stripe=k)
 
     def _probe(self, proc: int) -> bool:
         try:
@@ -158,11 +170,13 @@ class ChurnSupervisor:
         1. Retire the dead peers' transport sender queues (their in-flight
            gossip has nowhere to go; the per-peer error-epoch tokens
            already scoped any overlapped op failures to exactly them).
-           ``drop_peer`` covers BOTH transport hot paths: with
-           ``BLUEFOG_TPU_WIN_NATIVE`` on it retires the C++ per-peer
-           queue too, so the dead peer's native sender worker exits
-           instead of retrying into a closed socket — discarded messages
-           counted in ``bf_win_tx_dropped_msgs_total`` as always.
+           ``drop_peer`` covers BOTH transport hot paths AND every
+           transport stripe: with ``BLUEFOG_TPU_WIN_NATIVE`` on it
+           retires all N of the peer's C++ stripe queues in one call, so
+           every stripe worker exits instead of retrying into a closed
+           socket (no N-1 orphan workers) and every per-stripe
+           queue-depth gauge is cleared — discarded messages counted in
+           ``bf_win_tx_dropped_msgs_total`` as always.
         2. Snapshot every window's OWNED rows + push-sum mass — each
            process is authoritative for its own ranks, the same ownership
            contract ``elastic.py`` stitches checkpoints by.

@@ -22,6 +22,8 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_COALESCE_LINGER_MS | 1.0 | sender-worker linger before flushing a partial batch |
 | BLUEFOG_TPU_WIN_COALESCE_BYTES | 1 MiB | queued bytes that force an immediate batch flush |
 | BLUEFOG_TPU_WIN_TX_QUEUE      | 1024  | per-peer outbound queue bound (messages); full blocks the producer |
+| BLUEFOG_TPU_WIN_STRIPES       | auto  | sockets/sender-workers/send-arenas per DCN peer; frames shard by (window, row); auto = placement model's dcn_link_cost (no model: 1) |
+| BLUEFOG_TPU_WIN_DECODE_THREADS | auto | drain-side decode pool size (native path); 0 = inline single-thread decode; auto sizes from the host core count |
 | BLUEFOG_TPU_WIN_RETRIES       | 1     | transient-send retries before ConnectionError (0=none) |
 | BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS | 50 | base of the jittered exponential retry backoff |
 | BLUEFOG_TPU_CHURN             | 0     | 1: enable the elastic-gossip churn controller |
@@ -141,6 +143,24 @@ def _flag(name: str, default: bool = False) -> bool:
                                                              "True", "yes")
 
 
+def _int_or_auto(name: str, floor: int = 0) -> int:
+    """Integer env knob with an ``auto`` sentinel: unset or ``auto``
+    returns -1 (the consumer derives the value), anything else must be an
+    integer >= ``floor`` — a typo fails loudly, never silently pins some
+    default."""
+    raw = os.environ.get(name, "auto").strip().lower()
+    if raw in ("", "auto"):
+        return -1
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer or 'auto'") from None
+    if v < floor:
+        raise ValueError(f"{name}={v} must be >= {floor} (or 'auto')")
+    return v
+
+
 @dataclass(frozen=True)
 class Config:
     timeline_prefix: Optional[str]
@@ -159,6 +179,22 @@ class Config:
     win_coalesce_linger_ms: float
     win_coalesce_bytes: int
     win_tx_queue: int
+    # Multi-stream striped DCN transport (ops/transport.py +
+    # native/src/winsvc.cc): how many sockets + sender workers + send
+    # arenas drive EACH peer endpoint.  Frames shard deterministically by
+    # (window, row) so every stripe is an independent FIFO; fences and
+    # mutex releases fan out across all stripes and complete only when
+    # every stripe has drained.  -1 (the "auto" default) tunes the count
+    # from the placement model's dcn_link_cost — flat hosts / no model
+    # stay at 1, which reproduces the single-stream wire behavior
+    # bitwise.  An explicit integer >= 1 pins it.
+    win_stripes: int
+    # Drain-side decode pool (native path only): how many C++ workers
+    # decode/scale/fold inbound frames in parallel ahead of the ordered
+    # drain emit.  0 pins the inline single-thread decode (bit-identical
+    # — the pool changes scheduling, never bytes); -1 (the "auto"
+    # default) sizes from the host core count.
+    win_decode_threads: int
     # Native window-transport hot path (native/src/winsvc.cc bf_wintx_* +
     # bf_winsvc_drain): per-peer coalescing send queues, OP_BATCH frame
     # encode/decode and same-slot drain folding run in C++ instead of
@@ -289,6 +325,9 @@ class Config:
                 "BLUEFOG_TPU_WIN_COALESCE_BYTES", str(1 << 20))),
             win_tx_queue=int(os.environ.get(
                 "BLUEFOG_TPU_WIN_TX_QUEUE", "1024")),
+            win_stripes=_int_or_auto("BLUEFOG_TPU_WIN_STRIPES", floor=1),
+            win_decode_threads=_int_or_auto(
+                "BLUEFOG_TPU_WIN_DECODE_THREADS", floor=0),
             win_native=_flag("BLUEFOG_TPU_WIN_NATIVE", default=True),
             win_xla=_flag("BLUEFOG_TPU_WIN_XLA", default=True),
             win_retries=int(os.environ.get(

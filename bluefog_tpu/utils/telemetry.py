@@ -482,13 +482,25 @@ def health() -> dict:
     # gossip.
     with _registry.lock:
         ratio = _registry.gauges.get(_key("bf_win_tx_coalesce_ratio", {}))
-        depths = [(k[1][0][1], v) for k, v in _registry.gauges.items()
+        depths = [(dict(k[1]), v) for k, v in _registry.gauges.items()
                   if k[0] == "bf_win_tx_queue_depth"]
+        decode_busy = _registry.gauges.get(
+            _key("bf_win_rx_decode_pool_busy", {}))
     if ratio is not None:
         body["win_tx_coalesce_ratio"] = round(ratio, 2)
     if depths:
-        peer, depth = max(depths, key=lambda kv: kv[1])
-        body["win_tx_deepest_queue"] = {"peer": peer, "depth": depth}
+        labels, depth = max(depths, key=lambda kv: kv[1])
+        deepest = {"peer": labels.get("peer", "?"), "depth": depth}
+        if "stripe" in labels:
+            # Striped transport: which stripe of the peer is backlogged
+            # (a persistently hot stripe = imbalanced (window, row) shard).
+            deepest["stripe"] = labels["stripe"]
+        body["win_tx_deepest_queue"] = deepest
+    if decode_busy is not None:
+        # Drain-side decode pool (BLUEFOG_TPU_WIN_DECODE_THREADS): busy
+        # workers at snapshot time — pinned at the pool size means
+        # inbound decode is this host's bottleneck.
+        body["win_rx_decode_pool_busy"] = decode_busy
     # Host-side staging copies on the window put/drain path, by site
     # (device_get / edge_temp / enqueue / commit) — the oracle proving
     # which copies the zero-copy XLA put path (BLUEFOG_TPU_WIN_XLA)

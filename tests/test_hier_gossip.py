@@ -505,7 +505,7 @@ def test_sparse_codec_applies_to_accumulate_only(monkeypatch):
 
     class _StubTransport:
         def send(self, host, port, op, name, src, dst, weight, payload,
-                 p_weight=0.0):
+                 p_weight=0.0, stripe=None):
             sent.append((op, np.asarray(payload).copy()))
 
     class _StubDistrib:

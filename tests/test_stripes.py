@@ -1,0 +1,559 @@
+"""Multi-stream striped DCN window transport (BLUEFOG_TPU_WIN_STRIPES).
+
+The striped transport drives every peer with N sockets + N sender
+workers + N send arenas, sharding frames deterministically by
+(window, row) so each stripe is an independent FIFO; fences and mutex
+releases fan out across all stripes and complete only when every stripe
+has drained.  These tests pin the contract:
+
+  * the shard function is deterministic and pins control ops to stripe 0;
+  * randomized put/accumulate/fence/mutex interleavings commit state
+    BITWISE-identical to the single-stream path, on the native hot path
+    AND the Python fallback (the ``BLUEFOG_TPU_WIN_NATIVE=0`` oracle);
+  * the fence fan-out ack certifies that every stripe drained first
+    (end-to-end through the window store);
+  * ``BLUEFOG_TPU_WIN_STRIPES=1`` reproduces the pre-stripe wire exactly
+    (one sender, one copy per control op, weight 0.0);
+  * churn ``drop_peer`` retires EVERY stripe and clears every per-stripe
+    gauge;
+  * the drain-side decode pool preserves per-connection ordering.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import native
+from bluefog_tpu import topology as topo
+from bluefog_tpu.ops import transport as T
+from bluefog_tpu.ops import window as W
+from bluefog_tpu.utils import config, telemetry
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native core not built")
+needs_win_native = pytest.mark.skipif(not native.has_win_native(),
+                                      reason="native hot path unavailable")
+
+
+@pytest.fixture
+def stripe_env(monkeypatch):
+    """Set transport knobs for a test and restore the config cache after."""
+    def set_env(**kv):
+        for k, v in kv.items():
+            monkeypatch.setenv(k, str(v))
+        config.reload()
+    yield set_env
+    config.reload()
+
+
+# ---------------------------------------------------------------------------
+# Shard function + knob resolution
+# ---------------------------------------------------------------------------
+
+def test_stripe_for_is_deterministic_and_edge_stable():
+    """Same (window, row) -> same stripe, every call; control ops pin
+    stripe 0; a single-stripe transport always answers 0."""
+    for name in ("w", "grad/layer.0", "x" * 100):
+        for src in range(16):
+            a = T.stripe_for(name, src, T.OP_PUT, 4)
+            assert a == T.stripe_for(name, src, T.OP_ACCUMULATE, 4)
+            assert a == T.stripe_for(name, src, T.OP_GET_REPLY, 4)
+            assert 0 <= a < 4
+            assert T.stripe_for(name, src, T.OP_PUT, 1) == 0
+    for op in (T.OP_FENCE_REQ, T.OP_FENCE_ACK, T.OP_MUTEX_ACQ,
+               T.OP_MUTEX_GRANT, T.OP_MUTEX_REL, T.OP_GET_REQ,
+               T.OP_MEMBER):
+        assert T.stripe_for("w", 3, op, 8) == 0
+    # Rows actually spread: 8 rows over 4 stripes must hit >1 stripe.
+    assert len({T.stripe_for("w", s, T.OP_PUT, 4) for s in range(8)}) > 1
+
+
+def test_resolve_stripes_auto_and_explicit(stripe_env, monkeypatch):
+    stripe_env(BLUEFOG_TPU_WIN_STRIPES="auto")
+    # No placement model in a plain test process: auto stays single-stream.
+    assert T.resolve_stripes() == 1
+    stripe_env(BLUEFOG_TPU_WIN_STRIPES=5)
+    assert T.resolve_stripes() == 5
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_STRIPES", "bogus")
+    with pytest.raises(ValueError, match="BLUEFOG_TPU_WIN_STRIPES"):
+        config.reload()
+    monkeypatch.setenv("BLUEFOG_TPU_WIN_STRIPES", "auto")
+    config.reload()
+
+
+def test_resolve_stripes_from_placement_model(stripe_env, monkeypatch):
+    """auto derives the stripe count from the model's dcn_link_cost."""
+    from bluefog_tpu import basics
+
+    class _Model:
+        dcn_link_cost = 4.0
+
+    stripe_env(BLUEFOG_TPU_WIN_STRIPES="auto")
+    monkeypatch.setattr(basics._ctx, "_placement_state", (_Model(), None),
+                        raising=False)
+    assert T.resolve_stripes() == 4
+    _Model.dcn_link_cost = 100.0
+    assert T.resolve_stripes() == 8  # capped
+    monkeypatch.setattr(basics._ctx, "_placement_state", (None, None),
+                        raising=False)
+    assert T.resolve_stripes() == 1
+
+
+# ---------------------------------------------------------------------------
+# Property test: striped interleavings == single-stream state, bitwise
+# ---------------------------------------------------------------------------
+
+class _StubTransport:
+    """Records what the window store sends (fence acks, mutex grants)
+    without a wire — the receiving side's outbound half."""
+
+    n_stripes = 1
+
+    def __init__(self):
+        self.sent = []
+        self.cv = threading.Condition()
+
+    def send(self, host, port, op, name, src, dst, weight, tensor,
+             p_weight=0.0, stripe=None):
+        with self.cv:
+            self.sent.append((op, name, src, dst, float(weight)))
+            self.cv.notify_all()
+
+    def wait_for(self, pred, timeout=30):
+        with self.cv:
+            ok = self.cv.wait_for(lambda: pred(self.sent), timeout=timeout)
+        assert ok, f"stub transport never satisfied predicate: {self.sent}"
+
+    def flush(self, *a, **k):
+        pass
+
+    def kick(self):
+        pass
+
+    def error_token(self, addrs=None):
+        return 0
+
+    def drop_peer(self, *a):
+        pass
+
+    def stop(self):
+        pass
+
+
+def _stub_distrib(n=8):
+    stub = _StubTransport()
+    d = W._Distrib(stub, rank_owner={r: 0 for r in range(n)},
+                   proc_addr={0: ("127.0.0.1", 1)}, my_proc=0)
+    return d, stub
+
+
+def _scripted_stream(seed, n_ranks=8, n_ops=60):
+    """One reproducible logical op stream: data ops (window, src, dst,
+    weight, row payload), fences, and mutex acquire/release pairs.
+
+    All values are EXACTLY representable (small integers, power-of-two
+    weights): striping only reorders traffic across independent staging
+    slots and regroups same-slot folds, both of which are exact under
+    this arithmetic — so "bitwise identical" is the honest assertion for
+    the routing/ordering property, with no float-association noise."""
+    rng = np.random.RandomState(seed)
+    ops = []
+    mutex_open = None
+    for k in range(n_ops):
+        r = rng.rand()
+        if mutex_open is not None and (r < 0.15 or k == n_ops - 1):
+            ops.append(("rel",) + mutex_open)
+            mutex_open = None
+        elif r < 0.12:
+            ops.append(("fence", int(rng.randint(n_ranks))))
+        elif r < 0.2 and mutex_open is None:
+            mutex_open = (("wa" if rng.rand() < 0.5 else "wb"),
+                          int(rng.randint(n_ranks)),
+                          int(rng.randint(n_ranks)))
+            ops.append(("acq",) + mutex_open)
+        else:
+            name = "wa" if rng.rand() < 0.5 else "wb"
+            dst = int(rng.randint(n_ranks))
+            src = (dst + 1) % n_ranks if rng.rand() < 0.5 \
+                else (dst - 1) % n_ranks
+            wire_op = T.OP_PUT if rng.rand() < 0.3 else T.OP_ACCUMULATE
+            row = rng.randint(-8, 9, size=6).astype(np.float32)
+            wgt = float(rng.choice([0.25, 0.5, 1.0, 2.0]))
+            pw = float(rng.choice([0.0, 0.5, 1.0]))
+            ops.append(("data", wire_op, name, src, dst, wgt, pw, row))
+    if mutex_open is not None:
+        ops.append(("rel",) + mutex_open)
+    return ops
+
+
+def _run_striped_stream(stripes, native_on, stream, stripe_env):
+    """Drive one scripted stream through a REAL loopback transport into
+    the window store, with the client sharding across ``stripes``;
+    returns the final state dicts of both windows."""
+    stripe_env(BLUEFOG_TPU_WIN_STRIPES=stripes,
+               BLUEFOG_TPU_WIN_NATIVE=1 if native_on else 0,
+               BLUEFOG_TPU_WIN_COALESCE=1,
+               BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=2)
+    bf.init(lambda: topo.RingGraph(8))
+    x = np.zeros((8, 6), np.float32)
+    bf.turn_on_win_ops_with_associated_p()
+    d, stub = _stub_distrib()
+    saved = W._store.distrib
+    W._store.distrib = d
+    server = T.WindowTransport(W._apply_inbound,
+                               apply_batch=W._apply_inbound_batch,
+                               apply_items=W._apply_inbound_items)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        assert client.n_stripes == stripes
+        assert bf.win_create(x, "wa", zero_init=True)
+        assert bf.win_create(x, "wb", zero_init=True)
+        server.register_window("wa", 6)
+        server.register_window("wb", 6)
+        host, port = "127.0.0.1", server.port
+        n = client.n_stripes
+        fanout_w = float(n) if n > 1 else 0.0
+        fences = grants = 0
+        for item in stream:
+            kind = item[0]
+            if kind == "data":
+                _k, wire_op, name, src, dst, wgt, pw, row = item
+                client.send(host, port, wire_op, name, src, dst, wgt, row,
+                            p_weight=pw)
+            elif kind == "fence":
+                fences += 1
+                for k in range(n):
+                    client.send(host, port, T.OP_FENCE_REQ, "", item[1],
+                                -1, fanout_w, np.zeros(0, np.float32),
+                                stripe=k)
+                want = fences
+                client.flush()
+                stub.wait_for(lambda sent: sum(
+                    1 for s in sent if s[0] == T.OP_FENCE_ACK) >= want)
+            elif kind == "acq":
+                _k, name, rank, req = item
+                client.send(host, port, T.OP_MUTEX_ACQ, name, req, rank,
+                            0.0, np.zeros(0, np.float32))
+                client.flush()
+                grants += 1
+                want = grants
+                stub.wait_for(lambda sent: sum(
+                    1 for s in sent if s[0] == T.OP_MUTEX_GRANT) >= want)
+            else:  # rel: fan out across every stripe
+                _k, name, rank, req = item
+                for k in range(n):
+                    client.send(host, port, T.OP_MUTEX_REL, name, req,
+                                rank, fanout_w, np.zeros(0, np.float32),
+                                stripe=k)
+        # Final certification fence: all data applied when it acks.
+        fences += 1
+        for k in range(n):
+            client.send(host, port, T.OP_FENCE_REQ, "", 0, -1, fanout_w,
+                        np.zeros(0, np.float32), stripe=k)
+        client.flush()
+        want = fences
+        stub.wait_for(lambda sent: sum(
+            1 for s in sent if s[0] == T.OP_FENCE_ACK) >= want)
+        return {name: bf.win_state_dict(name) for name in ("wa", "wb")}
+    finally:
+        client.stop()
+        server.stop()
+        W._store.distrib = saved
+        bf.turn_off_win_ops_with_associated_p()
+        bf.win_free("wa")
+        bf.win_free("wb")
+
+
+def _assert_states_bitwise_equal(a, b, ctx):
+    for name in a:
+        for part in ("staging", "versions", "p_staging"):
+            for k, v in a[name][part].items():
+                got = np.asarray(b[name][part][k])
+                np.testing.assert_array_equal(
+                    got, np.asarray(v),
+                    err_msg=f"[{ctx}] {name}.{part}[{k}]")
+
+
+@needs_win_native
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_striped_interleavings_bitwise_equal_native(seed, stripe_env):
+    """Randomized put/accumulate/fence/mutex interleavings sharded over 4
+    stripes commit BITWISE-identical window state to the single-stream
+    path (native hot path leg).  Same-slot traffic rides one stripe FIFO,
+    so the only reordering striping introduces is across independent
+    slots — which must not change a single bit."""
+    stream = _scripted_stream(seed)
+    ref = _run_striped_stream(1, True, stream, stripe_env)
+    got = _run_striped_stream(4, True, stream, stripe_env)
+    _assert_states_bitwise_equal(ref, got, f"native seed={seed}")
+
+
+@needs_native
+@pytest.mark.parametrize("seed", [0, 1])
+def test_striped_interleavings_bitwise_equal_python(seed, stripe_env):
+    """The same bitwise property on the Python-fallback leg
+    (``BLUEFOG_TPU_WIN_NATIVE=0``), which must remain the striped
+    transport's oracle exactly as it is the native path's."""
+    stream = _scripted_stream(seed)
+    ref = _run_striped_stream(1, False, stream, stripe_env)
+    got = _run_striped_stream(3, False, stream, stripe_env)
+    _assert_states_bitwise_equal(ref, got, f"python seed={seed}")
+
+
+@needs_win_native
+def test_native_vs_python_striped_equivalence(stripe_env):
+    """Cross-path: the native striped transport and the Python striped
+    fallback commit identical state for one stream (the PR-9 oracle
+    contract, extended to stripes)."""
+    stream = _scripted_stream(7)
+    a = _run_striped_stream(4, True, stream, stripe_env)
+    b = _run_striped_stream(4, False, stream, stripe_env)
+    _assert_states_bitwise_equal(a, b, "native-vs-python")
+
+
+# ---------------------------------------------------------------------------
+# Fence fan-out ordering, end-to-end through the store
+# ---------------------------------------------------------------------------
+
+@needs_win_native
+def test_fence_fanout_acks_only_after_every_stripe_drained(stripe_env):
+    """A fence's ack must certify that puts on EVERY stripe were applied:
+    the receiver answers only the last fan-out copy, and by then each
+    stripe's FIFO has delivered everything sent before the fence."""
+    stripe_env(BLUEFOG_TPU_WIN_STRIPES=4, BLUEFOG_TPU_WIN_NATIVE=1,
+               BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=5)
+    bf.init(lambda: topo.RingGraph(8))
+    x = np.zeros((8, 3), np.float32)
+    d, stub = _stub_distrib()
+    saved = W._store.distrib
+    W._store.distrib = d
+    versions_at_ack = []
+
+    orig_send = stub.send
+
+    def send(host, port, op, name, src, dst, weight, tensor,
+             p_weight=0.0, stripe=None):
+        if op == T.OP_FENCE_ACK:
+            win = W._store.get("ff")
+            with win.lock:
+                versions_at_ack.append(sum(win.versions.values()))
+        orig_send(host, port, op, name, src, dst, weight, tensor,
+                  p_weight, stripe)
+
+    stub.send = send
+    server = T.WindowTransport(W._apply_inbound,
+                               apply_batch=W._apply_inbound_batch,
+                               apply_items=W._apply_inbound_items)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        assert bf.win_create(x, "ff", zero_init=True)
+        server.register_window("ff", 3)
+        host, port = "127.0.0.1", server.port
+        total = 0
+        rng = np.random.RandomState(11)
+        for i in range(120):
+            dst = int(rng.randint(8))
+            src = (dst + 1) % 8
+            client.send(host, port, T.OP_ACCUMULATE, "ff", src, dst, 1.0,
+                        rng.randn(3).astype(np.float32))
+            total += 1
+        # Sanity: the stream actually sharded across several stripes.
+        assert len({k[2] for k in client._senders}) > 1 \
+            or client.native_path
+        for k in range(4):
+            client.send(host, port, T.OP_FENCE_REQ, "", 2, -1, 4.0,
+                        np.zeros(0, np.float32), stripe=k)
+        client.flush()
+        stub.wait_for(lambda sent: any(s[0] == T.OP_FENCE_ACK
+                                       for s in sent))
+        assert versions_at_ack == [total], \
+            f"ack before all stripes drained: {versions_at_ack} != [{total}]"
+    finally:
+        client.stop()
+        server.stop()
+        W._store.distrib = saved
+        bf.win_free("ff")
+
+
+def test_stale_fanout_copies_cannot_complete_a_later_release():
+    """A PARTIALLY delivered fan-out (one stripe's copy lost to a send
+    failure the requester already saw) must never let its leftover count
+    complete a LATER fence/release early: copies carry a serial, stale
+    serials are discarded, newer serials reset the count."""
+    d, _stub = _stub_distrib()
+    saved = W._store.distrib
+    W._store.distrib = d
+    try:
+        ev = threading.Event()
+        d.remote_holds[("w", 2, 1)] = ev
+        # Release #1 (serial 1.0): only 3 of its 4 copies ever arrive.
+        for _ in range(3):
+            W._apply_inbound(T.OP_MUTEX_REL, "w", 1, 2, 4.0, 1.0, b"")
+        assert not ev.is_set()
+        # Release #2 (serial 2.0): its FIRST copy must NOT complete the
+        # count (the pre-fix bug: 3 stale + 1 fresh == 4 released the
+        # mutex before release #2's other stripes had drained).
+        W._apply_inbound(T.OP_MUTEX_REL, "w", 1, 2, 4.0, 2.0, b"")
+        assert not ev.is_set()
+        # A late straggler of release #1 is stale: discarded, no effect.
+        W._apply_inbound(T.OP_MUTEX_REL, "w", 1, 2, 4.0, 1.0, b"")
+        assert not ev.is_set()
+        for _ in range(3):
+            W._apply_inbound(T.OP_MUTEX_REL, "w", 1, 2, 4.0, 2.0, b"")
+        assert ev.is_set()  # all 4 copies of the newest serial arrived
+        # Fence counters follow the same rule.
+        for _ in range(2):
+            W._apply_inbound(T.OP_FENCE_REQ, "", 5, -1, 3.0, 1.0, b"")
+        W._apply_inbound(T.OP_FENCE_REQ, "", 5, -1, 3.0, 2.0, b"")
+        assert not _stub.sent  # no ack yet: count reset by the new serial
+        for _ in range(2):
+            W._apply_inbound(T.OP_FENCE_REQ, "", 5, -1, 3.0, 2.0, b"")
+        _stub.wait_for(lambda sent: any(s[0] == T.OP_FENCE_ACK
+                                        for s in sent))
+    finally:
+        W._store.distrib = saved
+
+
+# ---------------------------------------------------------------------------
+# STRIPES=1: the pre-stripe wire, bit for bit
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_single_stripe_reproduces_prestripe_wire(stripe_env):
+    """With BLUEFOG_TPU_WIN_STRIPES=1 (the no-model default) the wire is
+    the pre-stripe transport exactly: one sender per peer, one FENCE_REQ
+    per fence with weight 0.0, arrival order = send order."""
+    stripe_env(BLUEFOG_TPU_WIN_STRIPES=1, BLUEFOG_TPU_WIN_NATIVE=0,
+               BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=2)
+    got = []
+    cv = threading.Condition()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        with cv:
+            got.append((op, name, src, dst, weight, bytes(payload)))
+            cv.notify_all()
+
+    def apply_batch(msgs):
+        for m in msgs:
+            apply(*m)
+
+    server = T.WindowTransport(apply, apply_batch=apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        assert client.n_stripes == 1
+        host, port = "127.0.0.1", server.port
+        rows = [np.arange(4, dtype=np.float32) * (i + 1) for i in range(6)]
+        expect = []
+        for i, row in enumerate(rows):
+            client.send(host, port, T.OP_PUT, "w", i, 1, 0.5, row)
+            expect.append((T.OP_PUT, "w", i, 1, 0.5, row.tobytes()))
+        client.send(host, port, T.OP_FENCE_REQ, "", 0, -1,
+                    W._fanout_weight(1), np.zeros(0, np.float32), stripe=0)
+        expect.append((T.OP_FENCE_REQ, "", 0, -1, 0.0, b""))
+        client.flush()
+        with cv:
+            assert cv.wait_for(lambda: len(got) >= len(expect), timeout=20)
+        assert got == expect  # order, fields AND payload bytes identical
+        assert sorted(k[2] for k in client._senders) == [0]
+    finally:
+        client.stop()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Churn teardown + decode pool
+# ---------------------------------------------------------------------------
+
+@needs_win_native
+def test_drop_peer_retires_all_stripes_native(stripe_env):
+    """drop_peer on the native striped transport discards every stripe's
+    queue, clears every per-stripe queue-depth gauge, and a later send
+    lazily recreates fresh stripe senders."""
+    stripe_env(BLUEFOG_TPU_WIN_STRIPES=3, BLUEFOG_TPU_WIN_NATIVE=1,
+               BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=1)
+    telemetry.reset()
+    server = T.WindowTransport(lambda *a: None)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        host, port = "127.0.0.1", server.port
+        row = np.arange(8, dtype=np.float32)
+        for i in range(30):
+            client.send(host, port, T.OP_ACCUMULATE, "w", i, 1, 1.0, row)
+        client.flush()
+        client._pump_native_tx_stats(force=True)
+        snap = telemetry.snapshot()
+        depth_keys = [k for k in snap
+                      if k.startswith("bf_win_tx_queue_depth")]
+        assert len(depth_keys) == 3  # one gauge per stripe
+        client.drop_peer(host, port)
+        snap = telemetry.snapshot()
+        assert not any(k.startswith("bf_win_tx_queue_depth")
+                       for k in snap), "stripe gauges must be cleared"
+        # Lazy recreate: fresh traffic flows again on all stripes.
+        for i in range(9):
+            client.send(host, port, T.OP_ACCUMULATE, "w", i, 1, 1.0, row)
+        client.flush()
+    finally:
+        client.stop()
+        server.stop()
+
+
+@needs_win_native
+def test_decode_pool_preserves_per_edge_ordering(stripe_env):
+    """With a decode pool >1 the drain still emits frames in arrival
+    order: per-edge sequence numbers must arrive monotonic."""
+    stripe_env(BLUEFOG_TPU_WIN_STRIPES=2, BLUEFOG_TPU_WIN_NATIVE=1,
+               BLUEFOG_TPU_WIN_DECODE_THREADS=2,
+               BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=1)
+    telemetry.reset()
+    seen = {}
+    bad = []
+    cv = threading.Condition()
+    count = [0]
+
+    def apply_items(items):
+        with cv:
+            for kind, payload in items:
+                if kind:
+                    # Folded commit: weight-scaled row carries the seq in
+                    # element 0 (weight 1.0, so it survives exactly).
+                    name, _rep, src, _dst, _pm, puts, accs, vals, _wb = \
+                        payload
+                    seq = int(vals[0]) if puts + accs == 1 else None
+                    key = (name, src)
+                    if seq is not None:
+                        if seq < seen.get(key, -1):
+                            bad.append((key, seq, seen[key]))
+                        seen[key] = seq
+                    count[0] += puts + accs
+                else:
+                    count[0] += 1
+            cv.notify_all()
+
+    server = T.WindowTransport(lambda *a: None, apply_items=apply_items)
+    assert server.decode_threads == 2
+    server.register_window("dp", 4)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        host, port = "127.0.0.1", server.port
+        total = 400
+        for i in range(total):
+            src = i % 4
+            row = np.full(4, float(i), np.float32)
+            client.send(host, port, T.OP_PUT, "dp", src, 1, 1.0, row)
+            if i % 37 == 0:
+                client.flush()  # many distinct frames for the pool
+        client.flush()
+        with cv:
+            assert cv.wait_for(lambda: count[0] >= total, timeout=30), \
+                f"{count[0]}/{total}"
+        assert not bad, f"out-of-order decode emits: {bad[:5]}"
+        server._pump_native_rx_stats()
+        snap = telemetry.snapshot()
+        assert any(k.startswith("bf_win_rx_decode_pool_busy")
+                   for k in snap)
+    finally:
+        client.stop()
+        server.stop()

@@ -168,7 +168,7 @@ def test_ffi_frames_decode_identically_across_codecs(xla_env, codec):
         for i, (src, dst) in enumerate([(0, 1), (0, 2)]):
             assert lib.bf_xla_plan_edge(
                 plan, i, b"127.0.0.1", server.port, op, src, dst,
-                0.25 * (i + 1), i) == 0
+                0.25 * (i + 1), i, 0) == 0
         total = 0
         for r in range(rounds):
             data = np.ascontiguousarray(rows[r])
@@ -455,7 +455,7 @@ def test_in_program_ffi_put(xla_env):
         plan = lib.bf_xla_plan_new(b"jitw", 5, 2, 0, 1.0)
         for i, (src, dst) in enumerate([(0, 1), (0, 3)]):
             assert lib.bf_xla_plan_edge(plan, i, b"127.0.0.1", server.port,
-                                        T.OP_PUT, src, dst, 1.0, i) == 0
+                                        T.OP_PUT, src, dst, 1.0, i, 0) == 0
         run = xlaffi.xla_put_program(plan, client._tx)
         assert run is not None
 
@@ -530,7 +530,7 @@ def test_sparse_residuals_survive_path_switch(xla_env):
         assert client.native_path
         plan = lib.bf_xla_plan_new(name.encode(), elems, 1, 2, frac)
         assert lib.bf_xla_plan_edge(plan, 0, b"127.0.0.1", server.port,
-                                    T.OP_ACCUMULATE, 0, 1, 1.0, 0) == 0
+                                    T.OP_ACCUMULATE, 0, 1, 1.0, 0, 0) == 0
         wire_mass = np.zeros(elems, np.float64)
         sent_native = 0
         # Alternate: native sends (rounds 0, 2) and host-encoder sends
