@@ -7,8 +7,8 @@ PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
-        stripe-smoke ffi-smoke placement-smoke synth-smoke hier-smoke \
-        chaos-smoke chaos
+        stripe-smoke tracerec-smoke ffi-smoke placement-smoke synth-smoke \
+        hier-smoke chaos-smoke chaos
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -18,8 +18,8 @@ PYTEST = python -m pytest -q
 # window-transport hot path is fresh (graceful skip without a toolchain —
 # every native consumer has a Python fallback).
 test: native test-fast bench-comm-smoke prof-smoke transport-smoke \
-      stripe-smoke ffi-smoke placement-smoke synth-smoke hier-smoke \
-      chaos-smoke
+      stripe-smoke tracerec-smoke ffi-smoke placement-smoke synth-smoke \
+      hier-smoke chaos-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -116,6 +116,17 @@ transport-smoke:
 stripe-smoke:
 	python bench_comm.py --stripe-smoke
 	env BLUEFOG_TPU_WIN_NATIVE=0 python bench_comm.py --stripe-smoke
+
+# Message-level tracing CI gate: flight recorder armed + wire trace tags
+# sampled at 1/2 through a loopback window-store pair — asserts the
+# per-edge contribution-age histograms/gauges land on /metrics and in
+# /healthz, the recorder dump decodes into a valid merged chrome trace
+# with matched cross-rank flow arrows (trace-gossip), and that a
+# BLUEFOG_TPU_TELEMETRY=0 leg leaves the registry completely untouched.
+# With BLUEFOG_TPU_TRACE_SAMPLE unset and the recorder off, nothing in
+# this PR runs at all — the wire stays bitwise identical (unit-tested).
+tracerec-smoke:
+	env JAX_PLATFORMS=cpu python bench_comm.py --tracerec-smoke
 
 # Zero-copy XLA put-path CI gate: loopback window-store puts of DEVICE
 # arrays through the BLUEFOG_TPU_WIN_XLA plan dispatch — asserts the FFI

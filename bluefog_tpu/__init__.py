@@ -138,5 +138,10 @@ from bluefog_tpu.utils.timeline import (  # noqa: F401
 from bluefog_tpu.utils import telemetry  # noqa: F401
 from bluefog_tpu.utils.telemetry import telemetry_snapshot  # noqa: F401
 
+# Transport flight recorder (BLUEFOG_TPU_FLIGHT_RECORDER): dump the
+# in-memory event ring to flightrec.<rank>.bin — the gossip black box
+# `python -m bluefog_tpu.tools trace-gossip` merges across ranks.
+from bluefog_tpu.utils.flightrec import dump as flight_recorder_dump  # noqa: F401,E501
+
 from bluefog_tpu.utils import profiler  # noqa: F401
 from bluefog_tpu.utils.profiler import step_profile  # noqa: F401

@@ -61,7 +61,30 @@ class WinItem(ctypes.Structure):
         ("off", ctypes.c_uint64),
         ("len", ctypes.c_uint64),
         ("wire_bytes", ctypes.c_uint64),
+        # Wire trace tag of the last tagged message folded into a commit
+        # entry (trace_seq == 0: untagged); raw items keep the trailer in
+        # their payload instead.
+        ("trace_seq", ctypes.c_uint32),
+        ("trace_src", ctypes.c_int32),
+        ("trace_mono_us", ctypes.c_int64),
+        ("trace_unix_us", ctypes.c_int64),
         ("name", ctypes.c_char * 128),
+    ]
+
+
+class RecEvent(ctypes.Structure):
+    """Mirror of ``bf_rec_event_t`` (one flight-recorder ring slot)."""
+    _fields_ = [
+        ("t_us", ctypes.c_int64),
+        ("src", ctypes.c_int32),
+        ("dst", ctypes.c_int32),
+        ("seq", ctypes.c_uint32),
+        ("len", ctypes.c_uint32),
+        ("etype", ctypes.c_uint8),
+        ("op", ctypes.c_uint8),
+        ("stripe", ctypes.c_uint8),
+        ("flags", ctypes.c_uint8),
+        ("name", ctypes.c_char * 20),
     ]
 
 
@@ -190,6 +213,30 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bf_wintx_stripes.argtypes = [ctypes.c_void_p]
         lib.bf_wintx_stop.restype = None
         lib.bf_wintx_stop.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        pass
+    # Wire trace tags + transport flight recorder (winsvc.cc, this PR's
+    # symbols) — own try: an older .so missing them falls back cleanly
+    # (has_win_native additionally requires bf_rec_snapshot, because the
+    # same build grew bf_win_item_t's trace fields).
+    try:
+        lib.bf_trace_configure.restype = None
+        lib.bf_trace_configure.argtypes = [i32]
+        lib.bf_trace_period.restype = i32
+        lib.bf_trace_period.argtypes = []
+        lib.bf_trace_next.restype = i32
+        lib.bf_trace_next.argtypes = [i32, ptr(ctypes.c_uint8)]
+        lib.bf_rec_enable.restype = i64
+        lib.bf_rec_enable.argtypes = [i64]
+        lib.bf_rec_is_enabled.restype = i32
+        lib.bf_rec_is_enabled.argtypes = []
+        lib.bf_rec_note.restype = None
+        lib.bf_rec_note.argtypes = [i32, i32, i32, i32, i32,
+                                    ctypes.c_uint32, u64, ctypes.c_char_p]
+        lib.bf_rec_snapshot.restype = i64
+        lib.bf_rec_snapshot.argtypes = [ptr(RecEvent), i64]
+        lib.bf_rec_reset.restype = None
+        lib.bf_rec_reset.argtypes = []
     except AttributeError:
         pass
     # Zero-copy XLA put plans (xlacall.cc, this PR's symbols) — bound in
@@ -343,12 +390,15 @@ def has_win_native() -> bool:
     hot path (``bf_wintx_*`` / ``bf_winsvc_drain``) — including the
     multi-stream stripe surface (``bf_wintx_stripe_stats``, whose absence
     marks a pre-stripe build with the OLD ``bf_wintx_start``/``send``
-    signatures) — and is not stale."""
+    signatures) and the tracing surface (``bf_rec_snapshot``, whose
+    absence marks a pre-trace build with the OLD ``bf_win_item_t``
+    layout) — and is not stale."""
     handle = lib()
     return (handle is not None and not _stale
             and hasattr(handle, "bf_wintx_start")
             and hasattr(handle, "bf_winsvc_drain")
-            and hasattr(handle, "bf_wintx_stripe_stats"))
+            and hasattr(handle, "bf_wintx_stripe_stats")
+            and hasattr(handle, "bf_rec_snapshot"))
 
 
 def has_win_xla() -> bool:

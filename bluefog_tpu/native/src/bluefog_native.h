@@ -127,6 +127,14 @@ typedef struct {
                         * offset into val_buf */
   uint64_t len;        /* raw: payload bytes; commit: element count */
   uint64_t wire_bytes; /* commit: summed wire payload bytes (telemetry) */
+  /* Wire trace tag (OP_TRACE_FLAG trailer) of the LAST tagged message
+   * folded into this commit entry; trace_seq == 0 means untagged.  Raw
+   * items keep their trailer in the payload instead (the Python decoder
+   * strips it). */
+  uint32_t trace_seq;
+  int32_t trace_src;
+  int64_t trace_mono_us;  /* sender's CLOCK_MONOTONIC at origin (us) */
+  int64_t trace_unix_us;  /* sender's unix wall clock at origin (us) */
   char name[128];
 } bf_win_item_t;
 
@@ -350,6 +358,72 @@ int32_t bf_xla_add_residual(const char* name, int32_t src, int32_t dst,
 /* 1 when this build carries the `bf_xla_win_put` XLA FFI handler (the
  * jaxlib FFI headers were present at compile time), else 0. */
 int32_t bf_xla_has_handler(void);
+
+/* -------- winsvc.cc: wire trace tags + transport flight recorder --------
+ *
+ * Trace tags (BLUEFOG_TPU_TRACE_SAMPLE): a sampled subset of
+ * put/accumulate messages carries OP_TRACE_FLAG (0x10) in the op byte
+ * and a 24-byte trailer appended to the payload:
+ *   i32 src_rank | u32 seq | i64 origin_monotonic_us | i64 origin_unix_us
+ * The Python sender builds the trailer itself (the payload is opaque to
+ * bf_wintx_send, so the native tx path ships it unchanged); the XLA put
+ * plans call bf_trace_next from C.  Sequence spaces are disjoint: Python
+ * tags count up from 1, native tags carry bit 31 set — one process's
+ * (src_rank, seq) is globally unique either way. */
+
+#define BF_TRACE_TRAILER_LEN 24
+
+/* Set the sampling period (tag every Nth data message; <= 0 = off). */
+void bf_trace_configure(int32_t period);
+int32_t bf_trace_period(void);
+/* Sampling decision + trailer for one outgoing message on the native
+ * encode paths.  Returns 1 and fills trailer[BF_TRACE_TRAILER_LEN] when
+ * this message is tagged, else 0 (trailer untouched). */
+int32_t bf_trace_next(int32_t src, uint8_t* trailer);
+
+/* Flight recorder: a process-wide lock-free fixed-size ring of transport
+ * events (enqueue/flush/sendmsg/drain/decode/fold/commit), keyed by
+ * (window/peer name, stripe, src, dst, trace seq).  Recording costs tens
+ * of ns per event (one relaxed fetch_add + a struct write); when not
+ * enabled every record site is a single atomic pointer load — zero
+ * mutation, zero allocation.  Snapshots taken while traffic is live may
+ * contain a few torn in-flight slots (flight-recorder semantics: the
+ * black box favors availability over consistency). */
+
+#define BF_REC_ENQUEUE 1 /* message accepted by a send queue            */
+#define BF_REC_FLUSH   2 /* frame assembled from a queue (pre-send)     */
+#define BF_REC_SENDMSG 3 /* frame handed to TCP (src field carries rc)  */
+#define BF_REC_DRAIN   4 /* inbound frame popped by the drain           */
+#define BF_REC_DECODE  5 /* tagged sub-message decoded                  */
+#define BF_REC_FOLD    6 /* tagged sub-message folded into a commit     */
+#define BF_REC_COMMIT  7 /* entry committed to window staging (Python)  */
+
+typedef struct {
+  int64_t t_us;   /* CLOCK_MONOTONIC microseconds at record time */
+  int32_t src;
+  int32_t dst;
+  uint32_t seq;   /* trace-tag seq (0 untagged); FLUSH/SENDMSG: msgs in
+                   * the frame */
+  uint32_t len;   /* payload/frame bytes (saturating u32) */
+  uint8_t etype;  /* BF_REC_* */
+  uint8_t op;     /* wire op byte, flags intact */
+  uint8_t stripe;
+  uint8_t flags;  /* reserved */
+  char name[20];  /* window name or peer "host:port", NUL-padded */
+} bf_rec_event_t;
+
+/* Allocate + arm the ring (idempotent; capacity <= 0 = 65536).  Returns
+ * the live capacity. */
+int64_t bf_rec_enable(int64_t capacity);
+int32_t bf_rec_is_enabled(void);
+/* Record one event from the host side (the native hot paths record
+ * directly; this entry serves the Python fallback path + commit sites). */
+void bf_rec_note(int32_t etype, int32_t op, int32_t stripe, int32_t src,
+                 int32_t dst, uint32_t seq, uint64_t len, const char* name);
+/* Copy up to cap events oldest-first into out; returns the count copied.
+ * out == NULL returns the count a full snapshot would produce. */
+int64_t bf_rec_snapshot(bf_rec_event_t* out, int64_t cap);
+void bf_rec_reset(void);
 
 #ifdef __cplusplus
 }

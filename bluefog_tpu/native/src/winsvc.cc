@@ -96,7 +96,9 @@ constexpr uint8_t kOpAccumulate = 2;
 constexpr uint8_t kOpBatch = 10;
 constexpr uint8_t kFlagBf16 = 0x40;
 constexpr uint8_t kFlagSparse = 0x20;
-constexpr uint8_t kFlagMask = kFlagBf16 | kFlagSparse;
+constexpr uint8_t kFlagTrace = 0x10;  // OP_TRACE_FLAG: payload carries a
+                                      // 24-byte (src, seq, origin) trailer
+constexpr uint8_t kFlagMask = kFlagBf16 | kFlagSparse | kFlagTrace;
 constexpr uint8_t kBatchVersion = 1;
 
 // The telemetry module's shared log-spaced histogram boundary table
@@ -118,6 +120,74 @@ inline double NowSec() {
   return std::chrono::duration<double>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+inline int64_t MonoUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+inline int64_t UnixUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// Flight recorder (bf_rec_*) + wire trace-tag sampling (bf_trace_*)
+// ---------------------------------------------------------------------------
+// The recorder is a process-wide fixed-size ring armed once; every record
+// site is one relaxed atomic pointer load when the ring is off — the
+// transport hot paths pay nothing until an operator arms the black box.
+// Slot claims are a relaxed fetch_add, so concurrent writers never
+// serialize; a snapshot taken while traffic is live may carry a few torn
+// in-flight slots (documented flight-recorder semantics).
+
+struct RecRing {
+  std::vector<bf_rec_event_t> ev;
+  std::atomic<uint64_t> idx{0};
+  explicit RecRing(size_t cap) : ev(cap) {}
+};
+
+std::atomic<RecRing*> g_rec{nullptr};
+std::mutex g_rec_m;  // serializes enable/reset only, never record
+
+std::atomic<int32_t> g_trace_period{0};
+std::atomic<uint32_t> g_trace_count{0};
+std::atomic<uint32_t> g_trace_seq{0};
+
+inline bool RecOn() {
+  return g_rec.load(std::memory_order_acquire) != nullptr;
+}
+
+void RecNoteN(uint8_t etype, uint8_t op, uint8_t stripe, int32_t src,
+              int32_t dst, uint32_t seq, uint64_t len, const char* name,
+              size_t nlen) {
+  RecRing* r = g_rec.load(std::memory_order_acquire);
+  if (!r) return;
+  uint64_t i = r->idx.fetch_add(1, std::memory_order_relaxed);
+  bf_rec_event_t& e = r->ev[(size_t)(i % r->ev.size())];
+  e.t_us = MonoUs();
+  e.src = src;
+  e.dst = dst;
+  e.seq = seq;
+  e.len = len > 0xffffffffull ? 0xffffffffu : (uint32_t)len;
+  e.etype = etype;
+  e.op = op;
+  e.stripe = stripe;
+  e.flags = 0;
+  if (nlen >= sizeof(e.name)) nlen = sizeof(e.name) - 1;
+  std::memset(e.name, 0, sizeof(e.name));
+  if (name && nlen) std::memcpy(e.name, name, nlen);
+}
+
+inline void RecNote(uint8_t etype, uint8_t op, uint8_t stripe, int32_t src,
+                    int32_t dst, uint32_t seq, uint64_t len,
+                    const char* name) {
+  if (!RecOn()) return;
+  RecNoteN(etype, op, stripe, src, dst, seq, len, name,
+           name ? std::strlen(name) : 0);
 }
 
 // bf16 -> f32 widening (exact: bf16 is f32's top 16 bits).
@@ -197,6 +267,78 @@ size_t BuildHeader(uint8_t* hdr, uint8_t op, int32_t src, int32_t dst,
 }
 
 }  // namespace
+
+extern "C" {
+
+void bf_trace_configure(int32_t period) {
+  g_trace_period.store(period < 0 ? 0 : period, std::memory_order_relaxed);
+}
+
+int32_t bf_trace_period(void) {
+  return g_trace_period.load(std::memory_order_relaxed);
+}
+
+int32_t bf_trace_next(int32_t src, uint8_t* trailer) {
+  int32_t p = g_trace_period.load(std::memory_order_relaxed);
+  if (p <= 0 || trailer == nullptr) return 0;
+  uint32_t c = g_trace_count.fetch_add(1, std::memory_order_relaxed);
+  if (c % (uint32_t)p) return 0;
+  // Bit 31 marks the native sequence space: Python-side tags count up
+  // from 1, so one process's (src_rank, seq) never collides across the
+  // two encoders.
+  uint32_t seq = 0x80000000u |
+                 (g_trace_seq.fetch_add(1, std::memory_order_relaxed) + 1);
+  int64_t mono = MonoUs(), unix_us = UnixUs();
+  std::memcpy(trailer, &src, 4);
+  std::memcpy(trailer + 4, &seq, 4);
+  std::memcpy(trailer + 8, &mono, 8);
+  std::memcpy(trailer + 16, &unix_us, 8);
+  return 1;
+}
+
+int64_t bf_rec_enable(int64_t capacity) {
+  std::lock_guard<std::mutex> lk(g_rec_m);
+  RecRing* r = g_rec.load(std::memory_order_acquire);
+  if (r != nullptr) return (int64_t)r->ev.size();
+  if (capacity <= 0) capacity = 65536;
+  r = new RecRing((size_t)capacity);
+  g_rec.store(r, std::memory_order_release);
+  return capacity;
+}
+
+int32_t bf_rec_is_enabled(void) { return RecOn() ? 1 : 0; }
+
+void bf_rec_note(int32_t etype, int32_t op, int32_t stripe, int32_t src,
+                 int32_t dst, uint32_t seq, uint64_t len, const char* name) {
+  RecNote((uint8_t)etype, (uint8_t)op, (uint8_t)stripe, src, dst, seq, len,
+          name);
+}
+
+int64_t bf_rec_snapshot(bf_rec_event_t* out, int64_t cap) {
+  RecRing* r = g_rec.load(std::memory_order_acquire);
+  if (!r) return 0;
+  uint64_t total = r->idx.load(std::memory_order_acquire);
+  uint64_t size = (uint64_t)r->ev.size();
+  uint64_t n = total < size ? total : size;
+  if (out == nullptr) return (int64_t)n;
+  if ((uint64_t)cap < n) n = (uint64_t)cap;
+  // Oldest-first: when the ring has wrapped, the oldest live slot is at
+  // total % size (the next one to be overwritten).
+  uint64_t start = total < size ? 0 : total % size;
+  for (uint64_t i = 0; i < n; ++i)
+    out[i] = r->ev[(size_t)((start + i) % size)];
+  return (int64_t)n;
+}
+
+void bf_rec_reset(void) {
+  std::lock_guard<std::mutex> lk(g_rec_m);
+  RecRing* r = g_rec.load(std::memory_order_acquire);
+  if (!r) return;
+  r->idx.store(0, std::memory_order_release);
+  for (auto& e : r->ev) std::memset(&e, 0, sizeof(e));
+}
+
+}  // extern "C"
 
 // One frame decoded by the drain-side pool into its OWN buffers (so
 // decode of different connections/stripes runs in parallel); the drain
@@ -583,6 +725,36 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
       continue;
     }
     float wf = (float)w;
+    // Wire trace tag (kFlagTrace): strip the 24-byte trailer BEFORE the
+    // codec validation (the payload-length checks are exact); the full
+    // plen still counts as wire bytes.  A tagged payload too short to
+    // carry its trailer is malformed — raw emit, losing only itself,
+    // exactly like any other bad payload.
+    uint64_t dlen = plen;
+    uint32_t tr_seq = 0;
+    int32_t tr_src = 0;
+    int64_t tr_mono = 0, tr_unix = 0;
+    if (op & kFlagTrace) {
+      if (plen < BF_TRACE_TRAILER_LEN) {
+        int rc = EmitRaw(c, op, msrc, mdst, w, pw, nm, nlen, pp, plen);
+        if (rc != 0) {
+          c->n_items = save_items;
+          c->raw_off = save_raw;
+          c->val_off = save_val;
+          return rc;
+        }
+        c->items[c->n_items - 1].frame = frame_tag;
+        continue;
+      }
+      const uint8_t* tp = pp + plen - BF_TRACE_TRAILER_LEN;
+      std::memcpy(&tr_src, tp, 4);
+      std::memcpy(&tr_seq, tp + 4, 4);
+      std::memcpy(&tr_mono, tp + 8, 8);
+      std::memcpy(&tr_unix, tp + 16, 8);
+      dlen -= BF_TRACE_TRAILER_LEN;
+      if (RecOn())
+        RecNoteN(BF_REC_DECODE, op, 0, msrc, mdst, tr_seq, plen, nm, nlen);
+    }
     bool can_fold = false;
     if (base == kOpAccumulate && last_commit >= 0) {
       bf_win_item_t& prev = c->items[last_commit];
@@ -592,7 +764,7 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
     }
     if (can_fold) {
       bf_win_item_t& prev = c->items[last_commit];
-      if (!DecodePayload(pp, plen, op, wf, elems, c->val_buf + prev.off,
+      if (!DecodePayload(pp, dlen, op, wf, elems, c->val_buf + prev.off,
                          /*fold=*/true, scratch)) {
         // Malformed payload: this sub-message alone goes raw (Python
         // raises + logs it, losing only itself); the fold run survives —
@@ -610,6 +782,17 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
       prev.p_weight += pw;
       prev.accs += 1;
       prev.wire_bytes += plen;
+      if (tr_seq) {
+        // The commit entry carries the LAST tag folded into it — at
+        // 1/N sampling a multi-tag fold is rare, and the freshest tag
+        // is the one the staleness bound cares about.
+        prev.trace_seq = tr_seq;
+        prev.trace_src = tr_src;
+        prev.trace_mono_us = tr_mono;
+        prev.trace_unix_us = tr_unix;
+        if (RecOn())
+          RecNoteN(BF_REC_FOLD, op, 0, msrc, mdst, tr_seq, plen, nm, nlen);
+      }
       local.folded++;
       continue;
     }
@@ -626,7 +809,7 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
       c->val_off = save_val;
       return -2;
     }
-    if (!DecodePayload(pp, plen, op, wf, elems, c->val_buf + c->val_off,
+    if (!DecodePayload(pp, dlen, op, wf, elems, c->val_buf + c->val_off,
                        /*fold=*/false, scratch)) {
       int rc = EmitRaw(c, op, msrc, mdst, w, pw, nm, nlen, pp, plen);
       if (rc != 0) {
@@ -651,6 +834,10 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
     it.off = c->val_off;
     it.len = (uint64_t)elems;
     it.wire_bytes = plen;
+    it.trace_seq = tr_seq;
+    it.trace_src = tr_src;
+    it.trace_mono_us = tr_mono;
+    it.trace_unix_us = tr_unix;
     std::memcpy(it.name, nm, nlen);
     it.name[nlen] = '\0';
     last_commit = c->n_items;
@@ -746,6 +933,9 @@ void bf_winsvc::DecodeWorker() {
       seq = seq_assign++;
       cv_space.notify_one();  // q space freed: unblock a reader
     }
+    if (RecOn())
+      RecNote(BF_REC_DRAIN, in.msg.op, 0, in.msg.src, in.msg.dst, 0,
+              in.payload.size(), in.msg.name);
     decode_busy.fetch_add(1, std::memory_order_acq_rel);
     DecodedFrame df;
     RxTally tally{};
@@ -862,6 +1052,9 @@ int32_t bf_winsvc_drain(bf_winsvc_t* s, bf_win_item_t* items,
       s->q.pop_front();
       s->cv_space.notify_one();
     }
+    if (RecOn())
+      RecNote(BF_REC_DRAIN, in.msg.op, 0, in.msg.src, in.msg.dst, 0,
+              in.payload.size(), in.msg.name);
     frame_tag = (uint8_t)(frame_tag == 255 ? 1 : frame_tag + 1);
     int rc = DecodeFrame(s, in, &c, &tally, frame_tag);
     if (rc != 0) {
@@ -1331,9 +1524,18 @@ void TxWorker(bf_wintx* t, TxPeer* p) {
         send_body = body;
         send_blen = flen;
       }
+      const uint8_t frame_op = fmsgs == 1 ? body[0] : kOpBatch;
+      if (RecOn())
+        RecNote(BF_REC_FLUSH, frame_op, (uint8_t)p->stripe, -1, p->port,
+                (uint32_t)fmsgs, send_blen, p->addr.c_str());
       double t0 = NowSec();
       int rc = SendFrameWithRetries(t, p, hdr, hlen, send_body, send_blen);
       double dt = NowSec() - t0;
+      if (RecOn())
+        // src carries the send rc (0 = handed to TCP) — the black box
+        // must show WHICH frame a drop was.
+        RecNote(BF_REC_SENDMSG, frame_op, (uint8_t)p->stripe, rc, p->port,
+                (uint32_t)fmsgs, send_blen, p->addr.c_str());
       std::lock_guard<std::mutex> lk(p->m);
       p->seq_done += fmsgs;
       if (rc == 0) {
@@ -1533,6 +1735,16 @@ int32_t bf_wintx_send(bf_wintx_t* t, const char* host, int32_t port,
   p->bytes_pending += payload_len;
   p->bytes_enq += payload_len;
   p->by_op[(op & (uint8_t)~kFlagMask) & 15]++;
+  if (RecOn()) {
+    // A Python-tagged message already carries its trailer in the
+    // payload: lift the seq so the enqueue event joins the tag's chain.
+    uint32_t seq = 0;
+    if ((op & kFlagTrace) && payload_len >= BF_TRACE_TRAILER_LEN)
+      std::memcpy(&seq, payload + payload_len - BF_TRACE_TRAILER_LEN + 4,
+                  4);
+    RecNote(BF_REC_ENQUEUE, op, (uint8_t)stripe, src, dst, seq,
+            payload_len, name);
+  }
   // Wake the worker only on transitions it cares about: queue went
   // nonempty (it may sit in the outer wait) or the linger must be cut
   // (urgent op / byte threshold).  A steady burst otherwise enqueues with

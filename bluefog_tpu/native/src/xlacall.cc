@@ -60,6 +60,7 @@ namespace {
 
 constexpr uint8_t kXFlagBf16 = 0x40;    // OP_BF16_FLAG (ops/transport.py)
 constexpr uint8_t kXFlagSparse = 0x20;  // OP_SPARSE_FLAG
+constexpr uint8_t kXFlagTrace = 0x10;   // OP_TRACE_FLAG
 
 struct XEdge {
   std::string host;
@@ -166,8 +167,16 @@ int32_t SendSparse(bf_wintx_t* tx, const XPlan& p, const XEdge& e,
     res.assign(v.begin(), v.end());
     for (int64_t j = 0; j < k; ++j) res[(size_t)order[(size_t)j]] = 0.0f;
   }
-  return bf_wintx_send(tx, e.host.c_str(), e.port,
-                       (uint8_t)(e.op | kXFlagSparse), p.name.c_str(),
+  uint8_t op = (uint8_t)(e.op | kXFlagSparse);
+  uint8_t trailer[BF_TRACE_TRAILER_LEN];
+  if (bf_trace_next(e.src, trailer)) {
+    // Wire trace tag: the trailer rides INSIDE the payload (after the
+    // sparse stream), exactly as the Python encoder appends it, so the
+    // receiver strips it identically whichever path sent the row.
+    payload.insert(payload.end(), trailer, trailer + BF_TRACE_TRAILER_LEN);
+    op |= kXFlagTrace;
+  }
+  return bf_wintx_send(tx, e.host.c_str(), e.port, op, p.name.c_str(),
                        e.src, e.dst, e.weight, e.p_weight, payload.data(),
                        payload.size(), 0, e.stripe);
 }
@@ -178,6 +187,8 @@ int32_t PlanRun(int64_t plan, const void* txp, const float* data,
   if (!p || txp == nullptr || data == nullptr) return -9;
   auto* tx = (bf_wintx_t*)(uintptr_t)txp;
   thread_local std::vector<uint16_t> half;
+  thread_local std::vector<uint8_t> tagged;
+  uint8_t trailer[BF_TRACE_TRAILER_LEN];
   for (const XEdge& e : p->edges) {
     if (e.row < 0 ||
         (uint64_t)(e.row + 1) * (uint64_t)p->elems > total_elems)
@@ -189,18 +200,39 @@ int32_t PlanRun(int64_t plan, const void* txp, const float* data,
     } else if (p->codec == 1) {
       half.resize((size_t)p->elems);
       for (int64_t i = 0; i < p->elems; ++i) half[(size_t)i] = Bf16RNE(row[i]);
-      rc = bf_wintx_send(tx, e.host.c_str(), e.port,
-                         (uint8_t)(e.op | kXFlagBf16), p->name.c_str(),
-                         e.src, e.dst, e.weight, e.p_weight,
-                         (const uint8_t*)half.data(),
-                         (uint64_t)p->elems * 2, 0, e.stripe);
+      const uint8_t* body = (const uint8_t*)half.data();
+      uint64_t blen = (uint64_t)p->elems * 2;
+      uint8_t op = (uint8_t)(e.op | kXFlagBf16);
+      if (bf_trace_next(e.src, trailer)) {
+        tagged.assign(body, body + blen);
+        tagged.insert(tagged.end(), trailer,
+                      trailer + BF_TRACE_TRAILER_LEN);
+        body = tagged.data();
+        blen = tagged.size();
+        op |= kXFlagTrace;
+      }
+      rc = bf_wintx_send(tx, e.host.c_str(), e.port, op, p->name.c_str(),
+                         e.src, e.dst, e.weight, e.p_weight, body, blen, 0,
+                         e.stripe);
     } else {
       // Dense: the row pointer goes straight into the arena copy — the
       // zero-staging-copy fast path (the weight rides the wire header;
       // the receiver scales, exactly like the Python remote-edge path).
-      rc = bf_wintx_send(tx, e.host.c_str(), e.port, e.op, p->name.c_str(),
-                         e.src, e.dst, e.weight, e.p_weight,
-                         (const uint8_t*)row, (uint64_t)p->elems * 4, 0,
+      // A sampled trace tag is the one exception: the trailer must ride
+      // the payload, so that 1-in-N message pays one staging copy.
+      const uint8_t* body = (const uint8_t*)row;
+      uint64_t blen = (uint64_t)p->elems * 4;
+      uint8_t op = e.op;
+      if (bf_trace_next(e.src, trailer)) {
+        tagged.assign(body, body + blen);
+        tagged.insert(tagged.end(), trailer,
+                      trailer + BF_TRACE_TRAILER_LEN);
+        body = tagged.data();
+        blen = tagged.size();
+        op |= kXFlagTrace;
+      }
+      rc = bf_wintx_send(tx, e.host.c_str(), e.port, op, p->name.c_str(),
+                         e.src, e.dst, e.weight, e.p_weight, body, blen, 0,
                          e.stripe);
     }
     if (rc != 0) return rc;  // first failing edge stops the dispatch

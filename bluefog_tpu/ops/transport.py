@@ -70,7 +70,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from bluefog_tpu import native
-from bluefog_tpu.utils import config
+from bluefog_tpu.utils import config, flightrec
 
 # Wire op codes — the single source of truth for the window protocol.  The
 # native layer carries ``op`` opaquely; codes beyond put/accumulate are
@@ -105,15 +105,27 @@ OP_BF16_FLAG = 0x40
 # receiver scatters back into a zero row.  Explicit on the wire for the
 # same reason as OP_BF16_FLAG — never inferred from payload size.
 OP_SPARSE_FLAG = 0x20
+# Flag bit ORed into the op byte when the payload carries a wire trace
+# tag: a 24-byte ``i32 src_rank | u32 seq | i64 origin_monotonic_us |
+# i64 origin_unix_us`` trailer APPENDED to the (possibly compressed)
+# payload, on a sampled subset of puts/accumulates
+# (``BLUEFOG_TPU_TRACE_SAMPLE=1/N``; default off — no flag, no trailer,
+# the wire bitwise identical).  Riding inside the payload means the tag
+# survives OP_BATCH framing, the bf16/sparse codecs and striping with no
+# further protocol: every decoder strips it by this flag before codec
+# validation.
+OP_TRACE_FLAG = 0x10
 # Every wire-flag bit the base op code must be masked with before
 # comparing against the OP_* constants.
-OP_FLAG_MASK = OP_BF16_FLAG | OP_SPARSE_FLAG
+OP_FLAG_MASK = OP_BF16_FLAG | OP_SPARSE_FLAG | OP_TRACE_FLAG
 
 __all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
            "OP_GET_REPLY", "OP_FENCE_REQ", "OP_FENCE_ACK", "OP_MUTEX_ACQ",
            "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BATCH", "OP_MEMBER",
-           "OP_BF16_FLAG", "OP_SPARSE_FLAG", "OP_FLAG_MASK",
-           "sparse_encode", "sparse_decode", "stripe_for", "resolve_stripes"]
+           "OP_BF16_FLAG", "OP_SPARSE_FLAG", "OP_TRACE_FLAG",
+           "OP_FLAG_MASK", "TRACE_TRAILER", "make_trace_tag",
+           "trace_strip", "sparse_encode", "sparse_decode", "stripe_for",
+           "resolve_stripes"]
 
 _OP_NAMES = {OP_PUT: "put", OP_ACCUMULATE: "accumulate",
              OP_GET_REQ: "get_req", OP_GET_REPLY: "get_reply",
@@ -136,6 +148,62 @@ _URGENT_OPS = frozenset((OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
 def _op_label(op: int) -> str:
     """Telemetry label for a wire op code (compression flags stripped)."""
     return _OP_NAMES.get(op & ~OP_FLAG_MASK, str(op))
+
+
+# ---------------------------------------------------------------------------
+# Wire trace tags (OP_TRACE_FLAG / BLUEFOG_TPU_TRACE_SAMPLE)
+# ---------------------------------------------------------------------------
+# A sampled subset of data messages carries a compact identity + origin
+# timestamp, so one put can be followed from dispatch through arena →
+# stripe → wire → drain → fold → commit (the trace-gossip tool joins the
+# per-rank flight-recorder dumps into cross-rank flow arrows) and every
+# fold can be given an AGE (bf_win_contribution_age_seconds — the sensor
+# a bounded-staleness async mode will read).  Sequence spaces are
+# disjoint between the encoders: Python tags count up from 1, the native
+# XLA-plan encoder (bf_trace_next) sets bit 31 — one process's
+# (src_rank, seq) pair is globally unique either way.
+
+TRACE_TRAILER = struct.Struct("<iIqq")  # src_rank, seq, mono_us, unix_us
+
+_trace_lock = threading.Lock()
+_trace_count = 0
+_trace_seq = 0
+
+
+def make_trace_tag(src: int) -> Optional[bytes]:
+    """Sampling decision + trailer for one outgoing data message: the
+    packed 24-byte trailer when this message is the 1-in-N tagged one,
+    else None.  With ``BLUEFOG_TPU_TRACE_SAMPLE`` unset this is one
+    config-flag check — no counter mutation, no allocation (the
+    bitwise-identical-wire guarantee)."""
+    period = config.get().trace_sample
+    if period <= 0:
+        return None
+    global _trace_count, _trace_seq
+    with _trace_lock:
+        count = _trace_count
+        _trace_count += 1
+        if count % period:
+            return None
+        _trace_seq += 1
+        seq = _trace_seq
+    return TRACE_TRAILER.pack(src, seq, time.monotonic_ns() // 1000,
+                              time.time_ns() // 1000)
+
+
+def trace_strip(payload) -> Tuple["bytes | memoryview",
+                                  Tuple[int, int, int, int]]:
+    """Split a tagged payload into ``(body, (src_rank, seq,
+    origin_monotonic_us, origin_unix_us))``.  Raises ValueError when the
+    payload cannot carry its trailer (malformed frame — per-message
+    isolation handles it exactly like any other bad payload)."""
+    n = len(payload)
+    if n < TRACE_TRAILER.size:
+        raise ValueError(
+            f"trace-flagged payload of {n} bytes cannot carry the "
+            f"{TRACE_TRAILER.size}-byte trailer")
+    tag = TRACE_TRAILER.unpack_from(payload, n - TRACE_TRAILER.size)
+    return payload[:n - TRACE_TRAILER.size], tag
 
 
 # ---------------------------------------------------------------------------
@@ -405,6 +473,15 @@ class _PeerSender:
             if urgent or self.bytes_pending >= self._t._flush_bytes:
                 self.flush_now = True
             self.cond.notify_all()
+        if flightrec.enabled():
+            op, name = msg[0], msg[1]
+            seq = 0
+            if op & OP_TRACE_FLAG and len(msg[6]) >= TRACE_TRAILER.size:
+                seq = TRACE_TRAILER.unpack_from(
+                    msg[6], len(msg[6]) - TRACE_TRAILER.size)[1]
+            flightrec.note(flightrec.ENQUEUE, op=op, stripe=self.stripe,
+                           src=msg[2], dst=msg[3], seq=seq,
+                           length=len(msg[6]), name=name)
 
     def flush(self, timeout: float) -> None:
         """Block until everything enqueued BEFORE this call has been
@@ -499,6 +576,10 @@ class _PeerSender:
                 logging.getLogger("bluefog_tpu").warning(
                     "window transport: batch of %d message(s) to %s "
                     "dropped: %s", len(batch), self.peer, e)
+                # The moment the black box matters most: a dropped batch
+                # is the canonical "wedged stripe" postmortem input.
+                flightrec.dump_on_error(
+                    f"batch send to {self.peer} dropped")
                 with self.cond:
                     self.error = e
                     self.err_count += 1
@@ -536,8 +617,10 @@ class WindowTransport:
     ``(0, msg)`` for raw messages (``msg`` exactly as ``apply`` takes it,
     payload a zero-copy view) and ``(1, commit)`` for folded commit
     entries ``(name, replace, src, dst, p_mass, puts, accs, values,
-    wire_bytes)`` with ``values`` a zero-copy f32 view valid only for the
-    call.  Windows opt into native folding via :meth:`register_window`;
+    wire_bytes, trace)`` with ``values`` a zero-copy f32 view valid only
+    for the call and ``trace`` the last folded wire trace tag
+    ``(src_rank, seq, origin_monotonic_us, origin_unix_us)`` or None.
+    Windows opt into native folding via :meth:`register_window`;
     unregistered traffic always arrives raw.
     """
 
@@ -563,6 +646,15 @@ class WindowTransport:
         self._tx_queue_max = max(1, cfg.win_tx_queue)
         self._retries = max(0, cfg.win_retries)
         self._retry_backoff = max(0.0, cfg.win_retry_backoff_ms) / 1e3
+        # Message-level observability: arm the flight recorder
+        # (BLUEFOG_TPU_FLIGHT_RECORDER) and publish the trace-tag
+        # sampling period to the native encoders (the XLA put plans tag
+        # in C via bf_trace_next; the Python sender tags through
+        # make_trace_tag).  Both default off — zero wire/state change.
+        from bluefog_tpu.utils import flightrec
+        flightrec.maybe_enable()
+        if hasattr(self._lib, "bf_trace_configure"):
+            self._lib.bf_trace_configure(int(cfg.trace_sample))
         # Multi-stream striping: N sockets + sender workers + send arenas
         # per peer, frames sharded by (window, row).  1 (the no-model
         # auto default) is the bitwise single-stream wire behavior.
@@ -711,6 +803,8 @@ class WindowTransport:
                 raise ValueError(
                     "window transport: window name exceeds the receiver's "
                     f"128-byte name field (127 usable bytes): {name!r}")
+            flightrec.dump_on_error(
+                f"native enqueue to {host}:{port} failed (code {rc})")
             raise ConnectionError(
                 f"win transport send to {host}:{port} failed "
                 f"(native code {rc})")
@@ -803,6 +897,12 @@ class WindowTransport:
             # send, exactly like the native peer itself).
             self._peer_addrs.discard((host, port))
             self._peer_last.pop((host, port), None)
+            for k in [k for k in self._stripe_last if k[:2] == (host, port)]:
+                # Same hygiene as _peer_last: a restarted peer's fresh
+                # stripe counters restart at 0, and a stale baseline
+                # would clamp its bf_win_tx_stripe_bytes_total diffs to
+                # 0 until the new totals pass the old ones.
+                self._stripe_last.pop(k, None)
             for k in range(self.n_stripes):
                 telemetry.clear_gauge("bf_win_tx_queue_depth",
                                       peer=f"{host}:{port}", stripe=str(k))
@@ -917,6 +1017,8 @@ class WindowTransport:
                     errors.append(rc)
         self._pump_native_tx_stats()
         if errors:
+            flightrec.dump_on_error(
+                f"native flush failed (code {errors[0]})")
             rc = errors[0]
             if rc == -6:
                 raise ConnectionError(
@@ -1087,6 +1189,12 @@ class WindowTransport:
             telemetry.inc("bf_win_tx_stripe_bytes_total",
                           float(sum(len(m[6]) for m in batch)),
                           peer=f"{host}:{port}", stripe=str(stripe))
+        frame_op = batch[0][0] if len(batch) == 1 else OP_BATCH
+        if flightrec.enabled():
+            flightrec.note(flightrec.FLUSH, op=frame_op, stripe=stripe,
+                           src=-1, dst=port, seq=len(batch),
+                           length=sum(len(m[6]) for m in batch),
+                           name=f"{host}:{port}")
         if len(batch) == 1:
             op, name, src, dst, weight, p_weight, payload = batch[0]
             blob = np.frombuffer(payload, np.uint8)
@@ -1104,6 +1212,12 @@ class WindowTransport:
             if t0 is not None:
                 telemetry.observe_since(t0, "bf_win_rpc_seconds",
                                         op="batch")
+        if flightrec.enabled():
+            # src carries the rc convention of the native recorder: this
+            # site only runs on success (a failed send raised above).
+            flightrec.note(flightrec.SENDMSG, op=frame_op, stripe=stripe,
+                           src=0, dst=port, seq=len(batch),
+                           length=blob.size, name=f"{host}:{port}")
         with self._stats_lock:  # several sender threads update the ratio
             self._tx_frames += 1
             self._tx_msgs += len(batch)
@@ -1159,6 +1273,9 @@ class WindowTransport:
             if telemetry.enabled():
                 telemetry.inc("bf_win_tx_errors_total",
                               peer=f"{host}:{port}")
+            if rc != -4:
+                flightrec.dump_on_error(
+                    f"send to {host}:{port} failed (code {rc})")
             if rc == -4:
                 # Deterministic caller bug, not a connectivity problem:
                 # the receiver's fixed name[128] field rejects the route.
@@ -1282,11 +1399,18 @@ class WindowTransport:
                 if it.kind:
                     vals = np.frombuffer(self._val_buf, np.float32,
                                          count=it.len, offset=it.off * 4)
+                    # Trace tag of the last tagged message folded into
+                    # this entry (None untagged) — same (src, seq, mono,
+                    # unix) shape trace_strip returns on the Python path.
+                    trace = (int(it.trace_src), int(it.trace_seq),
+                             int(it.trace_mono_us),
+                             int(it.trace_unix_us)) \
+                        if it.trace_seq else None
                     items.append((1, (it.name.decode(), bool(it.replace),
                                       int(it.src), int(it.dst),
                                       float(it.p_weight), int(it.puts),
                                       int(it.accs), vals,
-                                      int(it.wire_bytes))))
+                                      int(it.wire_bytes), trace)))
                     msgs += it.puts + it.accs
                     continue
                 if int(it.op) == OP_BATCH:

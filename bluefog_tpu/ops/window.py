@@ -70,8 +70,9 @@ __all__ = [
 from bluefog_tpu.ops.transport import (  # noqa: E402
     OP_PUT, OP_ACCUMULATE, OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
     OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL, OP_MEMBER,
-    OP_BF16_FLAG, OP_SPARSE_FLAG, OP_FLAG_MASK, sparse_encode,
-    sparse_decode)
+    OP_BF16_FLAG, OP_SPARSE_FLAG, OP_TRACE_FLAG, OP_FLAG_MASK,
+    make_trace_tag, trace_strip, sparse_encode, sparse_decode)
+from bluefog_tpu.utils import flightrec  # noqa: E402
 # Zero-copy XLA put path (BLUEFOG_TPU_WIN_XLA): plan-compiled dispatch of
 # remote put edges straight from the device buffer into the native
 # per-peer arenas, plus the host-staging-copy accounting helpers.
@@ -293,6 +294,9 @@ def _shutdown_transport() -> None:
         # keyed on the new directory).
         xlaffi.invalidate()
         d.transport.stop()
+        # No transport, no edges: per-edge staleness gauges describing a
+        # dead wire must not linger as live series (churn hygiene class).
+        clear_contribution_age()
 
 
 def _to_numpy(x) -> np.ndarray:
@@ -493,6 +497,63 @@ _ef_residuals: Dict[tuple, np.ndarray] = {}
 _ef_lock = threading.Lock()
 
 
+# Per-edge contribution-age extrema (seconds), keyed by src rank: the
+# freshest/stalest gauges summarize what the per-src age histogram
+# records sample by sample — the sensors a bounded-staleness async mode
+# (ROADMAP item 4) will read to reject/downweight old contributions.
+_age_lock = threading.Lock()
+_age_minmax: Dict[int, list] = {}
+
+
+def _note_trace_commit(name: str, src: int, tag) -> None:
+    """One tagged contribution reached its staging slot: record its age
+    (receiver wall clock minus the tag's origin wall clock — NTP-grade
+    across hosts, exact on one host) into the per-src histogram + the
+    freshest/stalest gauges, and give the flight recorder its COMMIT
+    event so the tag's chain ends where the state changed."""
+    import time as _time
+    from bluefog_tpu.utils import telemetry
+    if flightrec.enabled():
+        flightrec.note(flightrec.COMMIT, src=tag[0], dst=src, seq=tag[1],
+                       name=name)
+    if not telemetry.enabled():
+        return
+    age = max(0.0, (_time.time_ns() // 1000 - tag[3]) / 1e6)
+    telemetry.observe("bf_win_contribution_age_seconds", age,
+                      src=str(src))
+    with _age_lock:
+        mm = _age_minmax.get(src)
+        if mm is None:
+            mm = _age_minmax[src] = [age, age]
+        else:
+            mm[0] = min(mm[0], age)
+            mm[1] = max(mm[1], age)
+        lo, hi = mm
+    telemetry.set_gauge("bf_win_contribution_freshest_age_seconds", lo,
+                        src=str(src))
+    telemetry.set_gauge("bf_win_contribution_stalest_age_seconds", hi,
+                        src=str(src))
+
+
+def clear_contribution_age(ranks=None) -> None:
+    """Drop the per-edge age gauges for ``ranks`` (None = every edge) —
+    churn hygiene: a dead peer's last-known ages must not linger as live
+    series (the same orphan-gauge class ``drop_peer`` already clears for
+    ``bf_win_tx_queue_depth``).  Histograms stay — they are monotonic
+    counters, not state claims about a live edge."""
+    from bluefog_tpu.utils import telemetry
+    with _age_lock:
+        targets = list(_age_minmax) if ranks is None else \
+            [r for r in ranks if r in _age_minmax]
+        for r in targets:
+            _age_minmax.pop(r, None)
+    for r in targets:
+        telemetry.clear_gauge("bf_win_contribution_freshest_age_seconds",
+                              src=str(r))
+        telemetry.clear_gauge("bf_win_contribution_stalest_age_seconds",
+                              src=str(r))
+
+
 def _drop_ef_residuals(name: Optional[str] = None) -> None:
     """Forget sender residuals (all windows, or one freed window's) —
     Python dict AND the native XLA-put twin (plus that path's cached
@@ -573,6 +634,17 @@ def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
         # from the payload size.
         payload = payload.astype(_BF16)
         op |= OP_BF16_FLAG
+    if payload.size and (op & ~OP_FLAG_MASK) in (OP_PUT, OP_ACCUMULATE):
+        # Wire trace tag (BLUEFOG_TPU_TRACE_SAMPLE): the sampled 1-in-N
+        # data message carries its identity + origin timestamps as a
+        # trailer INSIDE the payload — appended after any codec, so it
+        # survives OP_BATCH framing, bf16/sparse and striping without
+        # further protocol.  Default off: make_trace_tag returns None
+        # from one config check and nothing here mutates.
+        tag = make_trace_tag(src)
+        if tag is not None:
+            payload = np.frombuffer(payload.tobytes() + tag, np.uint8)
+            op |= OP_TRACE_FLAG
     from bluefog_tpu.utils import telemetry
     if telemetry.enabled():
         telemetry.inc("bf_win_proc_tx_bytes_total", float(payload.nbytes),
@@ -851,6 +923,7 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
     orig_op = op  # parked/replayed messages must keep the wire flag bits
     compressed = bool(op & OP_BF16_FLAG)
     sparse = bool(op & OP_SPARSE_FLAG)
+    traced = bool(op & OP_TRACE_FLAG)
     op &= ~OP_FLAG_MASK
     d = _store.distrib
     if d is None:
@@ -936,6 +1009,12 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         # is the sender's job via the distributed mutex (_remote_mutex).
         from bluefog_tpu.utils.timeline import op_span
         with op_span(f"win_apply.{name}.{src}->{dst}", "COMMUNICATE"):
+            tag = None
+            if traced:
+                # Strip the trace trailer before the codec-length
+                # validation; the tag's age is recorded only once the
+                # contribution actually lands in its staging slot.
+                payload, tag = trace_strip(payload)
             # copy=False: the scale below materializes a fresh array; the
             # transient view is never retained.
             row = _payload_row(win, payload, compressed, copy=False,
@@ -953,11 +1032,15 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
                         win.p_staging[(dst, src)] += p_weight
                     else:
                         win.p_staging[(dst, src)] = p_weight
+            if tag is not None:
+                _note_trace_commit(name, src, tag)
     elif op == OP_GET_REQ:
         _store.svc_pool.submit(_reply_get, name, src, dst, weight)
     elif op == OP_GET_REPLY:
         from bluefog_tpu.utils.timeline import op_span
         with op_span(f"win_apply.{name}.{src}->{dst}", "COMMUNICATE"):
+            if traced:  # senders never tag replies; strip defensively
+                payload, _ = trace_strip(payload)
             # copy=False: the scale below materializes a fresh array; the
             # transient view is never retained.
             row = _payload_row(win, payload, compressed, copy=False,
@@ -1057,12 +1140,14 @@ def _apply_inbound_items(items) -> None:
 def _commit_native_run(name: str, entries) -> None:
     """Commit one window's run of natively-folded entries under ONE
     ``win.lock`` hold.  Each entry is ``(name, replace, src, dst, p_mass,
-    puts, accs, values, wire_bytes)`` with ``values`` a zero-copy f32 view
-    into the transport's drain buffer (valid only for this call): replace
-    entries copy it into a fresh staging array, accumulate entries fold it
-    in with ``+=`` — numerically IDENTICAL to what the Python batched
-    apply computes for the same frames, since the C++ fold replicates its
-    decode/scale/fold order bit-for-bit."""
+    puts, accs, values, wire_bytes, trace)`` with ``values`` a zero-copy
+    f32 view into the transport's drain buffer (valid only for this
+    call): replace entries copy it into a fresh staging array, accumulate
+    entries fold it in with ``+=`` — numerically IDENTICAL to what the
+    Python batched apply computes for the same frames, since the C++ fold
+    replicates its decode/scale/fold order bit-for-bit.  ``trace`` (the
+    last folded wire trace tag, or None) feeds the per-edge
+    contribution-age telemetry once the entry lands."""
     d = _store.distrib
     with _store.lock:
         win = _store.windows.get(name) if d is not None else None
@@ -1073,22 +1158,24 @@ def _commit_native_run(name: str, entries) -> None:
         # and let the per-message path own the parking bookkeeping.  The
         # folded version ticks collapse to one per entry in this narrow
         # race — the replayed STATE is exact.
-        for (nm, replace, src, dst, p_mass, _puts, _accs, vals, _wb) \
-                in entries:
+        for (nm, replace, src, dst, p_mass, _puts, _accs, vals, _wb,
+             _tr) in entries:
             _apply_inbound(OP_PUT if replace else OP_ACCUMULATE, nm, src,
                            dst, 1.0, p_mass, np.asarray(vals).tobytes())
         return
     from bluefog_tpu.utils import telemetry
     if telemetry.enabled():
-        for (_nm, _r, src, _d2, _pm, _p, _a, _v, wire_bytes) in entries:
+        for (_nm, _r, src, _d2, _pm, _p, _a, _v, wire_bytes,
+             _tr) in entries:
             telemetry.inc("bf_win_proc_rx_bytes_total", float(wire_bytes),
                           proc=d.rank_owner.get(src, -1))
     expected = int(np.prod(win.shape, dtype=np.int64))
     from bluefog_tpu.utils.timeline import op_span
+    noted = []
     with op_span(f"win_apply_batch.{name}", "COMMUNICATE"):
         with win.lock:
-            for (_nm, replace, src, dst, p_mass, puts, accs, vals, _wb) \
-                    in entries:
+            for (_nm, replace, src, dst, p_mass, puts, accs, vals, _wb,
+                 trace) in entries:
                 key = (dst, src)
                 if key not in win.staging:
                     continue
@@ -1113,6 +1200,10 @@ def _commit_native_run(name: str, entries) -> None:
                         win.p_staging[key] = p_mass
                     else:
                         win.p_staging[key] += p_mass
+                if trace is not None:
+                    noted.append((src, trace))
+    for src, tag in noted:  # outside win.lock: telemetry is not state
+        _note_trace_commit(name, src, tag)
 
 
 def _apply_data_run(name: str, group) -> None:
@@ -1136,13 +1227,17 @@ def _apply_data_run(name: str, group) -> None:
             telemetry.inc("bf_win_proc_rx_bytes_total", float(len(payload)),
                           proc=d.rank_owner.get(src, -1))
     # -- decode + fold outside the lock ------------------------------------
-    # entries: [replace, (dst, src), scaled_row, p_mass, version_ticks]
+    # entries: [replace, (dst, src), scaled_row, p_mass, version_ticks,
+    #           trace_tag_or_None]
     entries = []
     for (op, _n, src, dst, weight, p_weight, payload) in group:
         compressed = bool(op & OP_BF16_FLAG)
         sparse = bool(op & OP_SPARSE_FLAG)
         accumulate = (op & ~OP_FLAG_MASK) == OP_ACCUMULATE
         try:
+            tag = None
+            if op & OP_TRACE_FLAG:
+                payload, tag = trace_strip(payload)
             row = _payload_row(win, payload, compressed, copy=False,
                                sparse=sparse)
         except ValueError:
@@ -1160,13 +1255,16 @@ def _apply_data_run(name: str, group) -> None:
             entries[-1][2] += scaled
             entries[-1][3] += p_weight
             entries[-1][4] += 1
+            if tag is not None:  # latest tag wins, as in the native fold
+                entries[-1][5] = tag
         else:
-            entries.append([not accumulate, key, scaled, p_weight, 1])
+            entries.append([not accumulate, key, scaled, p_weight, 1, tag])
     # -- commit under one lock hold ----------------------------------------
     from bluefog_tpu.utils.timeline import op_span
+    noted = []
     with op_span(f"win_apply_batch.{name}", "COMMUNICATE"):
         with win.lock:
-            for replace, key, scaled, p_mass, ticks in entries:
+            for replace, key, scaled, p_mass, ticks, tag in entries:
                 if key not in win.staging:
                     continue
                 if replace:
@@ -1179,6 +1277,10 @@ def _apply_data_run(name: str, group) -> None:
                         win.p_staging[key] = p_mass
                     else:
                         win.p_staging[key] += p_mass
+                if tag is not None:
+                    noted.append((key[1], tag))
+    for src, tag in noted:  # outside win.lock: telemetry is not state
+        _note_trace_commit(name, src, tag)
 
 
 def _neighbors_from_topology():

@@ -222,7 +222,7 @@ def _wire_bytes(comp: str, op: int, elems: int) -> int:
     """Wire payload bytes of one encoded row — the ONE rule this path and
     the telemetry accounting share (mirrors ``_send_to_proc``'s codec
     choice: sparse is accumulate-only, puts stay exact)."""
-    if comp.startswith("sparse") and (op & 0x9F) == _OP_ACCUMULATE:
+    if comp.startswith("sparse") and (op & 0x8F) == _OP_ACCUMULATE:
         k = max(1, int(np.ceil(config.parse_sparse_frac(comp) * elems)))
         k = min(k, elems)
         return 4 + 8 * k
@@ -232,7 +232,7 @@ def _wire_bytes(comp: str, op: int, elems: int) -> int:
 
 
 def _codec_id(comp: str, op: int) -> int:
-    if comp.startswith("sparse") and (op & 0x9F) == _OP_ACCUMULATE:
+    if comp.startswith("sparse") and (op & 0x8F) == _OP_ACCUMULATE:
         return 2
     if comp == "bf16":
         return 1

@@ -90,6 +90,16 @@ def bfstat_text() -> str:
             + (" (EVICTED)" if member.get("evicted") else "")
             + (f"; last change {datetime.datetime.fromtimestamp(when):%H:%M:%S}"
                if when else ""))
+    ages = health.get("contribution_age")
+    if ages:
+        # Per-edge gossip staleness (wire trace tags): how old each
+        # in-neighbor's contribution was when it folded here — the line
+        # an operator reads to spot a lagging edge before it wedges.
+        parts = ", ".join(
+            f"src {s} {a.get('freshest_sec', 0):.3f}.."
+            f"{a.get('stalest_sec', 0):.3f}s"
+            for s, a in sorted(ages.items(), key=lambda kv: int(kv[0])))
+        lines.append(f"[bfstat] contribution age: {parts}")
     straggler = health.get("straggler")
     if straggler:
         slow = straggler["slowest_rank"]

@@ -156,6 +156,11 @@ class ChurnSupervisor:
         if view is None:
             return None
         if view.evicted:
+            # The gang voted this rank out: its black box is the only
+            # record of what its transport saw leading up to eviction —
+            # dump before the process exits.
+            from bluefog_tpu.utils import flightrec
+            flightrec.dump(reason=f"evicted at epoch {view.epoch}")
             self._stop.set()
             return view
         self._recover(view)
@@ -189,12 +194,23 @@ class ChurnSupervisor:
            fresh in-edges start clean) and restore the push-sum scalars,
            so a push-sum run keeps its conservation invariant across the
            membership change."""
-        from bluefog_tpu.utils import telemetry
+        from bluefog_tpu.utils import flightrec, telemetry
+        # Postmortem first: every survivor dumps its flight recorder at
+        # the committed change, so the kill/eviction that caused it can
+        # be reconstructed across ranks (trace-gossip merges the dumps)
+        # even though the dead peer will never write its own.
+        flightrec.dump(reason=f"membership change to epoch {view.epoch}")
         t0 = time.perf_counter()
+        dead_ranks = [r for r, p in self._d.rank_owner.items()
+                      if p in set(view.removed_procs)]
         for proc in view.removed_procs:
             addr = self._d.proc_addr.get(proc)
             if addr is not None:
                 self._d.transport.drop_peer(*addr)
+        # Gauge hygiene (the orphan-series class drop_peer already clears
+        # for bf_win_tx_queue_depth): a dead peer's per-edge contribution
+        # -age gauges must not linger as live staleness claims.
+        self._W.clear_contribution_age(dead_ranks)
         W = self._W
         snaps: Dict[str, dict] = {}
         for name in W.get_current_created_window_names():

@@ -34,6 +34,18 @@ step the reference never had:
       outer cadence and codec.  Pure host math — no accelerator, no
       mesh, no bf.init() required.
 
+  python -m bluefog_tpu.tools trace-gossip <prefix> [-o merged.json]
+      Merge per-rank flight-recorder dumps (``flightrec.<rank>.bin``,
+      written by ``BLUEFOG_TPU_FLIGHT_RECORDER`` on fatal transport
+      errors / churn events or by ``bf.flight_recorder_dump()``) into
+      one chrome trace: a process lane per rank, wall-aligned through
+      each dump's clock anchor, with a cross-rank FLOW ARROW per
+      sampled wire trace tag (``BLUEFOG_TPU_TRACE_SAMPLE``) — follow
+      one put from the sender's enqueue to the receiver's decode.
+      Also prints the per-edge one-way-delay p50/p99 table.  Pure host
+      math over the dump files (``tools/tracegossip.py``); runs on
+      whatever survived a chaos kill.
+
   python -m bluefog_tpu.tools chaos [--np 4] [--kill-rank K] [--smoke]
       Chaos harness for the churn controller (``tools/chaos.py``): launch
       a CPU multi-process gang under ``bfrun --chaos``, SIGKILL one rank
@@ -437,6 +449,16 @@ def main(argv=None) -> int:
         "trace-summary",
         help="per-phase p50/p95/p99 table from a (merged) trace")
     ps.add_argument("trace", help="trace JSON file (merged or single-rank)")
+    pg = sub.add_parser(
+        "trace-gossip",
+        help="merge per-rank flight-recorder dumps into one chrome trace "
+             "with cross-rank gossip flow arrows + a per-edge one-way-"
+             "delay table")
+    pg.add_argument("prefix",
+                    help="the BLUEFOG_TPU_FLIGHT_RECORDER_PATH prefix the "
+                         "run used (dumps are <prefix>.<rank>.bin)")
+    pg.add_argument("-o", "--output", default=None,
+                    help="output path (default <prefix>.merged.json)")
     # Listed for --help only; the real dispatch happens above (the chaos
     # harness owns its own flag surface, including the bfrun-launched
     # --worker mode).
@@ -489,6 +511,9 @@ def main(argv=None) -> int:
             hier_outer_every=args.hier_outer_every,
             hier_compression=args.hier_compression))
         return 0
+    if args.cmd == "trace-gossip":
+        from bluefog_tpu.tools.tracegossip import main_trace_gossip
+        return main_trace_gossip(args.prefix, args.output)
     if args.cmd == "trace-merge":
         out = trace_merge(args.prefix, args.output)
         events, _ = load_trace_events(out)

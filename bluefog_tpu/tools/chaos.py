@@ -266,6 +266,9 @@ def run_demo(args) -> int:
            "--steps", str(args.steps), "--dim", str(args.dim),
            "--lr", str(args.lr), "--pace-ms", str(args.pace_ms),
            "--grace", str(args.grace), "--kill-step", str(args.kill_step)]
+    import tempfile
+    rec_dir = tempfile.mkdtemp(prefix="bf-chaos-flightrec-")
+    rec_prefix = os.path.join(rec_dir, "flightrec")
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -275,6 +278,13 @@ def run_demo(args) -> int:
         "BLUEFOG_TPU_WIN_RETRIES": "1",
         "BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS": "25",
         "BLUEFOG_TPU_TELEMETRY": "1",
+        # Black-box leg: recorder armed + sampled wire trace tags, so the
+        # committed membership change makes every survivor dump a
+        # postmortem the driver can merge (the CI path for reading the
+        # flight recorder after a kill — not just unit tests).
+        "BLUEFOG_TPU_FLIGHT_RECORDER": "1",
+        "BLUEFOG_TPU_TRACE_SAMPLE": "4",
+        "BLUEFOG_TPU_FLIGHT_RECORDER_PATH": rec_prefix,
     })
     print(f"chaos: launching {n}-process gang, {spec} "
           f"({args.steps} steps)...", flush=True)
@@ -348,6 +358,35 @@ def run_demo(args) -> int:
             _fail(failures, f"rank {rank}: post-recovery step time "
                             f"{post:.2f}ms > {args.step_ratio}x "
                             f"pre-failure {pre:.2f}ms")
+    # Flight-recorder postmortem: every survivor dumps its black box at
+    # the committed membership change (run/supervisor.py); the dumps must
+    # decode into one valid merged trace — the exact artifact an operator
+    # reads after a real kill.
+    try:
+        from bluefog_tpu.tools import tracegossip
+        rec_files = tracegossip.dump_files(rec_prefix)
+        missing = [r for r in survivors if r not in rec_files]
+        if missing:
+            _fail(failures, "no flight-recorder dump from survivor(s) "
+                            f"{missing} (found {sorted(rec_files)})")
+        else:
+            dumps = tracegossip.load_dumps(rec_prefix)
+            out, stats = tracegossip.merge_gossip(rec_prefix, dumps=dumps)
+            with open(out) as f:
+                merged = json.load(f)
+            lanes = {e.get("pid") for e in merged}
+            if not set(survivors) <= lanes:
+                _fail(failures, f"merged trace lanes {sorted(lanes)} miss "
+                                f"survivors {survivors}")
+            print(f"chaos: flight-recorder postmortem OK — "
+                  f"{stats['events']} events from ranks {stats['ranks']}, "
+                  f"{stats['flows_matched']} cross-rank flow arrow(s)",
+                  flush=True)
+    except Exception as e:  # noqa: BLE001 — a broken dump IS the failure
+        _fail(failures, f"flight-recorder postmortem failed: {e}")
+    finally:
+        import shutil
+        shutil.rmtree(rec_dir, ignore_errors=True)
     if failures:
         print("\nchaos FAILED:", file=sys.stderr)
         for f in failures:

@@ -26,6 +26,10 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_DECODE_THREADS | auto | drain-side decode pool size (native path); 0 = inline single-thread decode; auto sizes from the host core count |
 | BLUEFOG_TPU_WIN_RETRIES       | 1     | transient-send retries before ConnectionError (0=none) |
 | BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS | 50 | base of the jittered exponential retry backoff |
+| BLUEFOG_TPU_TRACE_SAMPLE      | 0     | wire trace-tag sampling: "1/N" (or plain "N") tags every Nth put/accumulate with a (src, seq, origin-time) trailer; 0/unset = off, wire bitwise identical |
+| BLUEFOG_TPU_FLIGHT_RECORDER   | 0     | 1: record transport events (enqueue/flush/sendmsg/drain/decode/fold/commit) into the native in-memory ring, dumped to flightrec.<rank>.bin on fatal transport error / eviction / bf.flight_recorder_dump() |
+| BLUEFOG_TPU_FLIGHT_RECORDER_EVENTS | 65536 | flight-recorder ring capacity (events; oldest overwritten) |
+| BLUEFOG_TPU_FLIGHT_RECORDER_PATH | flightrec | dump path prefix (files are <prefix>.<rank>.bin) |
 | BLUEFOG_TPU_CHURN             | 0     | 1: enable the elastic-gossip churn controller |
 | BLUEFOG_TPU_CHURN_HEARTBEAT_MS | 250  | membership heartbeat period |
 | BLUEFOG_TPU_CHURN_SUSPECT_MS  | 1500  | heartbeat silence before a peer is suspected |
@@ -138,6 +142,31 @@ def _validated_sketch(value: str) -> str:
     return value
 
 
+def _parse_trace_sample(raw: Optional[str]) -> int:
+    """``BLUEFOG_TPU_TRACE_SAMPLE`` parser: ``"1/N"`` (the documented
+    spelling) or a plain integer period ``N`` both mean "tag every Nth
+    data message"; ``0``/unset/empty disable tagging entirely (the wire
+    stays bitwise identical).  A typo fails loudly — silently-off tracing
+    during an incident would be worse than a crash at init."""
+    if raw is None:
+        return 0
+    raw = raw.strip()
+    if raw in ("", "0", "off"):
+        return 0
+    if raw.startswith("1/"):
+        raw = raw[2:]
+    try:
+        period = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"BLUEFOG_TPU_TRACE_SAMPLE={raw!r} is not '1/N', an integer "
+            "period N, or 0/off") from None
+    if period < 0:
+        raise ValueError(
+            f"BLUEFOG_TPU_TRACE_SAMPLE period must be >= 0, got {period}")
+    return period
+
+
 def _flag(name: str, default: bool = False) -> bool:
     return os.environ.get(name, "1" if default else "0") in ("1", "true",
                                                              "True", "yes")
@@ -220,6 +249,24 @@ class Config:
     # churn controller's failure detector wants).
     win_retries: int
     win_retry_backoff_ms: float
+    # Message-level wire trace tags (ops/transport.py OP_TRACE_FLAG):
+    # every Nth put/accumulate carries a compact (src, seq, origin-time)
+    # trailer the drain side turns into per-edge contribution-age
+    # telemetry and the trace-gossip tool turns into cross-rank flow
+    # arrows.  0 (the default) = off: no flag, no trailer, no counter
+    # mutation — the wire is bitwise identical to the pre-trace
+    # transport.
+    trace_sample: int
+    # Native transport flight recorder (winsvc.cc bf_rec_*): a fixed-size
+    # in-memory ring of enqueue/flush/sendmsg/drain/decode/fold/commit
+    # events keyed (window, peer, stripe, seq), ~tens of ns per event,
+    # dumped to <flight_recorder_path>.<rank>.bin on fatal transport
+    # error, churn eviction/membership change, or an explicit
+    # bf.flight_recorder_dump().  Off by default: the ring is never
+    # allocated and every record site is a single pointer-null check.
+    flight_recorder: bool
+    flight_recorder_events: int
+    flight_recorder_path: str
     # Elastic-gossip churn controller (ops/membership.py +
     # run/supervisor.py); OFF by default — with churn=0 no membership
     # state exists, no heartbeat is ever sent and every code path is
@@ -334,6 +381,13 @@ class Config:
                 "BLUEFOG_TPU_WIN_RETRIES", "1")),
             win_retry_backoff_ms=float(os.environ.get(
                 "BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS", "50")),
+            trace_sample=_parse_trace_sample(
+                os.environ.get("BLUEFOG_TPU_TRACE_SAMPLE")),
+            flight_recorder=_flag("BLUEFOG_TPU_FLIGHT_RECORDER"),
+            flight_recorder_events=int(os.environ.get(
+                "BLUEFOG_TPU_FLIGHT_RECORDER_EVENTS", "65536")),
+            flight_recorder_path=os.environ.get(
+                "BLUEFOG_TPU_FLIGHT_RECORDER_PATH", "flightrec"),
             churn=_flag("BLUEFOG_TPU_CHURN"),
             churn_heartbeat_ms=float(os.environ.get(
                 "BLUEFOG_TPU_CHURN_HEARTBEAT_MS", "250")),

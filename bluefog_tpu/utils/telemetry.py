@@ -501,6 +501,19 @@ def health() -> dict:
         # workers at snapshot time — pinned at the pool size means
         # inbound decode is this host's bottleneck.
         body["win_rx_decode_pool_busy"] = decode_busy
+    # Per-edge contribution age (wire trace tags, BLUEFOG_TPU_TRACE_SAMPLE):
+    # how old each in-neighbor's gossip was when it folded, freshest and
+    # stalest seen per src rank — the exact sensors a bounded-staleness
+    # async gossip mode reads.  Absent entirely when tracing is off.
+    with _registry.lock:
+        ages: Dict[str, dict] = {}
+        for k, v in _registry.gauges.items():
+            if k[0] == "bf_win_contribution_freshest_age_seconds" and k[1]:
+                ages.setdefault(k[1][0][1], {})["freshest_sec"] = round(v, 4)
+            elif k[0] == "bf_win_contribution_stalest_age_seconds" and k[1]:
+                ages.setdefault(k[1][0][1], {})["stalest_sec"] = round(v, 4)
+    if ages:
+        body["contribution_age"] = ages
     # Host-side staging copies on the window put/drain path, by site
     # (device_get / edge_temp / enqueue / commit) — the oracle proving
     # which copies the zero-copy XLA put path (BLUEFOG_TPU_WIN_XLA)

@@ -519,8 +519,8 @@ def test_decode_pool_preserves_per_edge_ordering(stripe_env):
                 if kind:
                     # Folded commit: weight-scaled row carries the seq in
                     # element 0 (weight 1.0, so it survives exactly).
-                    name, _rep, src, _dst, _pm, puts, accs, vals, _wb = \
-                        payload
+                    (name, _rep, src, _dst, _pm, puts, accs, vals,
+                     _wb, _trace) = payload
                     seq = int(vals[0]) if puts + accs == 1 else None
                     key = (name, src)
                     if seq is not None:

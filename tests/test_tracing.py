@@ -1,0 +1,605 @@
+"""Cross-rank gossip tracing (OP_TRACE_FLAG wire tags, the native flight
+recorder, per-edge contribution-age telemetry, and the trace-gossip
+merge tool).
+
+Covers the tentpole's contract surface:
+  * trailer round-trip + sampling semantics (`BLUEFOG_TPU_TRACE_SAMPLE`);
+  * SAMPLE off => the wire is bitwise identical to the untraced
+    transport AND nothing in the tracing machinery mutates;
+  * the tag survives OP_BATCH framing x bf16/sparse codecs x 1/2/4
+    stripes, with the native and Python decode paths cross-checked
+    against each other (same committed state, bitwise) and against the
+    untraced run (the tag must never perturb numerics);
+  * per-edge age histograms + freshest/stalest gauges, /healthz block,
+    gauge clearing (churn hygiene), TELEMETRY=0 zero-mutation;
+  * flight-recorder struct pinning, dump/load round-trip, and the
+    fake-clock two-rank trace-gossip merge (flow arrows + one-way-delay
+    math).
+"""
+
+import ctypes
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import native
+from bluefog_tpu import topology as topo
+from bluefog_tpu.ops import transport as T
+from bluefog_tpu.ops import window as W
+from bluefog_tpu.tools import tracegossip
+from bluefog_tpu.utils import config, flightrec, telemetry
+
+needs_native = pytest.mark.skipif(
+    not (native.available() and native.has_win_native()),
+    reason="native core lacks the window-transport hot path")
+needs_xla = pytest.mark.skipif(
+    not (native.available() and native.has_win_xla()),
+    reason="native core lacks the bf_xla symbols")
+
+
+@pytest.fixture
+def trace_env(monkeypatch):
+    """Set knobs + reload config; restores (and reloads) afterwards."""
+    def set_env(**kv):
+        for k, v in kv.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, str(v))
+        config.reload()
+    yield set_env
+    config.reload()
+
+
+@pytest.fixture(autouse=True)
+def _reset_trace_counters():
+    """Each test starts with fresh Python-side sampling counters and a
+    clean per-edge age table."""
+    with T._trace_lock:
+        T._trace_count = 0
+        T._trace_seq = 0
+    W.clear_contribution_age()
+    yield
+    W.clear_contribution_age()
+
+
+# ---------------------------------------------------------------------------
+# Trailer + sampling semantics
+# ---------------------------------------------------------------------------
+
+def test_trailer_roundtrip_and_sampling(trace_env):
+    trace_env(BLUEFOG_TPU_TRACE_SAMPLE="1/3")
+    tags = [T.make_trace_tag(src=7) for _ in range(9)]
+    hits = [t for t in tags if t is not None]
+    assert len(hits) == 3 and tags[0] is not None  # every 3rd, from #1
+    body = b"\x01\x02\x03\x04"
+    stripped, tag = T.trace_strip(body + hits[0])
+    assert bytes(stripped) == body
+    src, seq, mono, unix = tag
+    assert src == 7 and seq == 1 and mono > 0 and unix > mono  # unix >> mono
+    # Sequences are unique and monotonic across samples.
+    seqs = [T.TRACE_TRAILER.unpack(t)[1] for t in hits]
+    assert seqs == [1, 2, 3]
+
+
+def test_trace_strip_rejects_short_payload():
+    with pytest.raises(ValueError, match="trailer"):
+        T.trace_strip(b"\x00" * (T.TRACE_TRAILER.size - 1))
+
+
+def test_sample_off_is_inert(trace_env):
+    """Default (unset): no tag, no counter mutation — the zero-overhead
+    contract behind the bitwise-identical-wire guarantee."""
+    trace_env(BLUEFOG_TPU_TRACE_SAMPLE=None)
+    before = (T._trace_count, T._trace_seq)
+    assert all(T.make_trace_tag(0) is None for _ in range(100))
+    assert (T._trace_count, T._trace_seq) == before
+    trace_env(BLUEFOG_TPU_TRACE_SAMPLE="0")
+    assert T.make_trace_tag(0) is None
+
+
+def test_trace_sample_parse():
+    assert config._parse_trace_sample(None) == 0
+    assert config._parse_trace_sample("0") == 0
+    assert config._parse_trace_sample("off") == 0
+    assert config._parse_trace_sample("1/64") == 64
+    assert config._parse_trace_sample("64") == 64
+    assert config._parse_trace_sample("1/1") == 1
+    with pytest.raises(ValueError):
+        config._parse_trace_sample("every-now-and-then")
+    with pytest.raises(ValueError):
+        config._parse_trace_sample("-3")
+
+
+# ---------------------------------------------------------------------------
+# Wire equivalence: SAMPLE off => bitwise identical frames
+# ---------------------------------------------------------------------------
+
+@needs_native
+@pytest.mark.parametrize("win_native", ["0", "1"])
+def test_wire_bitwise_identical_with_sample_off(trace_env, win_native):
+    """With BLUEFOG_TPU_TRACE_SAMPLE unset, every delivered message is
+    byte-for-byte what the untraced transport ships: no OP_TRACE_FLAG,
+    payload exactly the row — on both the Python and native senders."""
+    trace_env(BLUEFOG_TPU_TRACE_SAMPLE=None,
+              BLUEFOG_TPU_WIN_NATIVE=win_native,
+              BLUEFOG_TPU_WIN_COALESCE_LINGER_MS="2")
+    got = []
+    cv = threading.Condition()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        with cv:
+            got.append((op, name, src, dst, weight, bytes(payload)))
+            cv.notify_all()
+
+    def apply_batch(msgs):
+        for m in msgs:
+            apply(*m)
+
+    server = T.WindowTransport(apply, apply_batch=apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        expect = []
+        for i in range(12):
+            row = (np.arange(8, dtype=np.float32) * (i + 1))
+            client.send("127.0.0.1", server.port, T.OP_PUT, "w", i % 4, 1,
+                        0.5, row)
+            expect.append((T.OP_PUT, "w", i % 4, 1, 0.5, row.tobytes()))
+        client.flush()
+        with cv:
+            assert cv.wait_for(lambda: len(got) >= len(expect), timeout=30)
+        assert sorted(got) == sorted(expect)  # stripes may interleave
+        assert all((op & T.OP_TRACE_FLAG) == 0 for op, *_ in got)
+    finally:
+        client.stop()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Loopback-through-store: tag survives framing x codecs x stripes
+# ---------------------------------------------------------------------------
+
+def _drive_store(trace_env, *, sample, win_native, codec="none",
+                 stripes=1, server_native=None):
+    """One deterministic put/accumulate stream through the real window-op
+    path into a loopback store; returns (state, age_series).
+
+    ``server_native`` lets the two wire ends run DIFFERENT hot paths
+    (native-encoded frames decoded by the Python decoder and vice
+    versa) — the cross-codec check of the tentpole."""
+    bf.init(lambda: topo.RingGraph(8))
+    if server_native is None:
+        server_native = win_native
+    trace_env(BLUEFOG_TPU_WIN_COALESCE="1",
+              BLUEFOG_TPU_WIN_COALESCE_LINGER_MS="300",
+              BLUEFOG_TPU_WIN_NATIVE=server_native,
+              BLUEFOG_TPU_WIN_XLA="0",
+              BLUEFOG_TPU_WIN_STRIPES=str(stripes),
+              BLUEFOG_TPU_WIN_COMPRESSION=codec,
+              BLUEFOG_TPU_TRACE_SAMPLE=sample)
+    with T._trace_lock:
+        T._trace_count = 0
+        T._trace_seq = 0
+    telemetry.reset()
+    W.clear_contribution_age()
+    applied = [0]
+    cv = threading.Condition()
+
+    def bump(k):
+        with cv:
+            applied[0] += k
+            cv.notify_all()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        W._apply_inbound(op, name, src, dst, weight, p_weight, payload)
+        bump(1)
+
+    def apply_batch(msgs):
+        W._apply_inbound_batch(msgs)
+        bump(len(msgs))
+
+    def apply_items(items):
+        W._apply_inbound_items(items)
+        bump(sum((p[5] + p[6]) if k else 1 for k, p in items))
+
+    server = T.WindowTransport(apply, apply_batch=apply_batch,
+                               apply_items=apply_items)
+    trace_env(BLUEFOG_TPU_WIN_NATIVE=win_native)  # client side's path
+    client = T.WindowTransport(lambda *a: None)
+    saved = W._store.distrib
+    rng = np.random.RandomState(11)
+    try:
+        assert bf.win_create(rng.randn(8, 6).astype(np.float32), "trace",
+                             zero_init=True)
+        server.register_window("trace", 6)
+        W._store.distrib = W._Distrib(
+            client, rank_owner={r: r % 2 for r in range(8)},
+            proc_addr={0: ("127.0.0.1", 1),
+                       1: ("127.0.0.1", server.port)},
+            my_proc=0)
+        total = 0
+        for step in range(6):
+            t = np.random.RandomState(500 + step) \
+                .randn(8, 6).astype(np.float32)
+            if step % 2:
+                bf.win_accumulate(t, "trace")
+            else:
+                bf.win_put(t, "trace")
+            total += 8  # the ring's 8 remote (even->odd) edges per op
+            with cv:
+                assert cv.wait_for(lambda: applied[0] >= total,
+                                   timeout=30), (applied[0], total)
+        state = bf.win_state_dict("trace")
+        ages = {k: v for k, v in telemetry.snapshot().items()
+                if k.startswith("bf_win_contribution")}
+        return state, ages
+    finally:
+        W._store.distrib = saved
+        bf.win_free("trace")
+        client.stop()
+        server.stop()
+
+
+def _assert_state_equal(a, b, what):
+    for part in ("staging", "versions", "main"):
+        assert set(a[part]) == set(b[part]), (what, part)
+        for k, v in a[part].items():
+            np.testing.assert_array_equal(
+                np.asarray(b[part][k]), np.asarray(v),
+                err_msg=f"{what}: {part}[{k}] (bitwise)")
+
+
+@needs_native
+@pytest.mark.parametrize("codec", ["none", "bf16", "sparse:0.5"])
+@pytest.mark.parametrize("stripes", [1, 2, 4])
+def test_tag_survives_framing_property(trace_env, codec, stripes):
+    """The tentpole property: a 1/1-sampled stream commits BIT-identical
+    window state to the untraced stream across OP_BATCH framing x codec
+    x stripe count on the native path — the trailer is stripped exactly,
+    never decoded as payload — and the age telemetry appears per src."""
+    traced, ages = _drive_store(trace_env, sample="1", win_native="1",
+                                codec=codec, stripes=stripes)
+    plain, no_ages = _drive_store(trace_env, sample=None, win_native="1",
+                                  codec=codec, stripes=stripes)
+    _assert_state_equal(plain, traced, f"{codec} x{stripes}")
+    assert any(k.startswith("bf_win_contribution_age_seconds_bucket")
+               for k in ages), sorted(ages)[:5]
+    assert any("freshest" in k for k in ages)
+    assert not no_ages  # untraced run records no age series
+
+
+@needs_native
+@pytest.mark.parametrize("codec", ["none", "bf16", "sparse:0.5"])
+def test_native_python_decoder_cross_check(trace_env, codec):
+    """Native-encoded tagged frames decoded by the PYTHON drain (and the
+    python-encoded ones by the native drain) land the same committed
+    state as the all-python leg — the two codecs agree on every byte of
+    the trailer handling."""
+    py, _ = _drive_store(trace_env, sample="1", win_native="0",
+                         codec=codec)
+    nat_tx, _ = _drive_store(trace_env, sample="1", win_native="1",
+                             codec=codec, server_native="0")
+    py_tx, _ = _drive_store(trace_env, sample="1", win_native="0",
+                            codec=codec, server_native="1")
+    _assert_state_equal(py, nat_tx, f"native-tx/{codec}")
+    _assert_state_equal(py, py_tx, f"native-rx/{codec}")
+
+
+@needs_xla
+def test_xla_plan_encoder_tags(trace_env):
+    """The THIRD encoder — the zero-copy XLA put plans (bf_trace_next in
+    C) — tags sampled device-array puts identically: committed state
+    stays bitwise equal to the untraced plan run, ages are recorded, and
+    the native sequence space (bit 31) never collides with Python's."""
+    import jax.numpy as jnp
+
+    from bluefog_tpu.ops import xlaffi
+
+    def drive(sample):
+        bf.init(lambda: topo.RingGraph(8))
+        trace_env(BLUEFOG_TPU_WIN_COALESCE="1",
+                  BLUEFOG_TPU_WIN_COALESCE_LINGER_MS="300",
+                  BLUEFOG_TPU_WIN_NATIVE="1",
+                  BLUEFOG_TPU_WIN_XLA="1",
+                  BLUEFOG_TPU_WIN_STRIPES="1",
+                  BLUEFOG_TPU_WIN_COMPRESSION="none",
+                  BLUEFOG_TPU_TRACE_SAMPLE=sample,
+                  BLUEFOG_TPU_FLIGHT_RECORDER="1")
+        xlaffi._reset_for_tests()
+        telemetry.reset()
+        W.clear_contribution_age()
+        applied = [0]
+        cv = threading.Condition()
+
+        def bump(k):
+            with cv:
+                applied[0] += k
+                cv.notify_all()
+
+        def apply(op, name, src, dst, weight, p_weight, payload):
+            W._apply_inbound(op, name, src, dst, weight, p_weight, payload)
+            bump(1)
+
+        def apply_items(items):
+            W._apply_inbound_items(items)
+            bump(sum((p[5] + p[6]) if k else 1 for k, p in items))
+
+        server = T.WindowTransport(apply, apply_items=apply_items)
+        client = T.WindowTransport(lambda *a: None)
+        flightrec.reset()
+        saved = W._store.distrib
+        rng = np.random.RandomState(19)
+        try:
+            assert bf.win_create(rng.randn(8, 5).astype(np.float32),
+                                 "xtr", zero_init=True)
+            server.register_window("xtr", 5)
+            W._store.distrib = W._Distrib(
+                client, rank_owner={r: r % 2 for r in range(8)},
+                proc_addr={0: ("127.0.0.1", 1),
+                           1: ("127.0.0.1", server.port)},
+                my_proc=0)
+            if not xlaffi.armed():
+                pytest.skip(f"xla path disarmed: "
+                            f"{xlaffi.disarm_reason()}")
+            total = 0
+            for step in range(4):
+                t = jnp.asarray(np.random.RandomState(700 + step)
+                                .randn(8, 5).astype(np.float32))
+                bf.win_put(t, "xtr")
+                total += 8
+                with cv:
+                    assert cv.wait_for(lambda: applied[0] >= total,
+                                       timeout=30), (applied[0], total)
+            snap = telemetry.snapshot()
+            assert any(k.startswith("bf_win_xla_puts_total")
+                       for k in snap), "plan path did not engage"
+            ages = {k: v for k, v in snap.items()
+                    if k.startswith("bf_win_contribution")}
+            return bf.win_state_dict("xtr"), ages, flightrec.snapshot()
+        finally:
+            W._store.distrib = saved
+            bf.win_free("xtr")
+            client.stop()
+            server.stop()
+
+    traced, ages, ev = drive("1")
+    plain, no_ages, _ = drive(None)
+    _assert_state_equal(plain, traced, "xla-plan traced")
+    assert any(k.startswith("bf_win_contribution_age_seconds_bucket")
+               for k in ages), sorted(ages)[:5]
+    assert not no_ages
+    # Native-encoder sequence space: bit 31 set on every plan-path tag.
+    dec = ev[ev["etype"] == flightrec.DECODE]
+    assert len(dec) > 0
+    assert np.all(dec["seq"].astype(np.int64) & 0x80000000)
+
+
+# ---------------------------------------------------------------------------
+# Age telemetry + churn hygiene + zero mutation
+# ---------------------------------------------------------------------------
+
+def test_contribution_age_math_and_healthz(trace_env):
+    trace_env(BLUEFOG_TPU_TELEMETRY="1")
+    telemetry.reset()
+    import time
+    now_us = time.time_ns() // 1000
+    # Two samples for src 3: ~2 s old and ~0.5 s old.
+    W._note_trace_commit("w", 3, (3, 1, 0, now_us - 2_000_000))
+    W._note_trace_commit("w", 3, (3, 2, 0, now_us - 500_000))
+    pct = telemetry.histogram_percentiles(
+        "bf_win_contribution_age_seconds", qs=(50.0,), src="3")
+    assert pct is not None and 0.2 < pct[50.0] < 5.0
+    snap = telemetry.snapshot()
+    fresh = snap['bf_win_contribution_freshest_age_seconds{src="3"}']
+    stale = snap['bf_win_contribution_stalest_age_seconds{src="3"}']
+    assert 0.3 < fresh < 1.0 < stale < 3.0
+    hz = telemetry.health()
+    assert "3" in hz["contribution_age"]
+    assert hz["contribution_age"]["3"]["stalest_sec"] > \
+        hz["contribution_age"]["3"]["freshest_sec"]
+    # %bfstat renders the line without raising.
+    from bluefog_tpu.run.cluster_repl import bfstat_text
+    bf.init(lambda: topo.RingGraph(8))
+    assert "contribution age" in bfstat_text()
+
+
+def test_clear_contribution_age_churn_hygiene(trace_env):
+    """drop_peer-class hygiene: a dead peer's ranks lose their age
+    gauges; survivors' gauges stay."""
+    trace_env(BLUEFOG_TPU_TELEMETRY="1")
+    telemetry.reset()
+    import time
+    now_us = time.time_ns() // 1000
+    for src in (1, 3, 5):
+        W._note_trace_commit("w", src, (src, 1, 0, now_us))
+    W.clear_contribution_age([3])
+    snap = telemetry.snapshot()
+    assert 'bf_win_contribution_freshest_age_seconds{src="3"}' not in snap
+    assert 'bf_win_contribution_stalest_age_seconds{src="3"}' not in snap
+    assert 'bf_win_contribution_freshest_age_seconds{src="1"}' in snap
+    assert 'bf_win_contribution_freshest_age_seconds{src="5"}' in snap
+    # None clears everything (transport teardown).
+    W.clear_contribution_age()
+    snap = telemetry.snapshot()
+    assert not any(k.startswith("bf_win_contribution_freshest") or
+                   k.startswith("bf_win_contribution_stalest")
+                   for k in snap)
+
+
+def test_telemetry_off_zero_mutation(trace_env):
+    trace_env(BLUEFOG_TPU_TELEMETRY="0")
+    telemetry.reset()
+    import time
+    W._note_trace_commit("w", 3, (3, 1, 0, time.time_ns() // 1000))
+    assert telemetry.snapshot() == {}
+    assert not W._age_minmax
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: struct pinning, snapshot, dump/load
+# ---------------------------------------------------------------------------
+
+def test_rec_event_struct_pinned():
+    """The ctypes mirror, the numpy dtype and the C struct must agree —
+    a silent layout drift would misparse every dump."""
+    assert ctypes.sizeof(native.RecEvent) == 48
+    assert flightrec.EVENT_DTYPE.itemsize == 48
+    for name, _ in native.RecEvent._fields_:
+        assert name in flightrec.EVENT_DTYPE.names
+
+
+@needs_native
+def test_flightrec_snapshot_dump_load(trace_env, tmp_path):
+    trace_env(BLUEFOG_TPU_FLIGHT_RECORDER="1")
+    assert flightrec.enable()
+    flightrec.reset()
+    flightrec.note(flightrec.ENQUEUE, op=T.OP_PUT, stripe=2, src=4,
+                   dst=1, seq=77, length=1024, name="winname")
+    flightrec.note(flightrec.COMMIT, src=4, dst=1, seq=77, name="winname")
+    ev = flightrec.snapshot()
+    assert len(ev) == 2
+    assert int(ev["etype"][0]) == flightrec.ENQUEUE
+    assert int(ev["seq"][0]) == 77 and int(ev["stripe"][0]) == 2
+    assert ev["name"][0].split(b"\0")[0] == b"winname"
+    assert ev["t_us"][1] >= ev["t_us"][0]  # oldest-first
+    path = flightrec.dump(path=str(tmp_path / "fr.0.bin"), reason="test")
+    header, loaded = flightrec.load(path)
+    assert header["unix_us"] > header["mono_us"] >= 0
+    np.testing.assert_array_equal(loaded, ev)
+
+
+@needs_native
+def test_flightrec_ring_wraps_oldest_first(trace_env):
+    """A ring smaller than the event count keeps the NEWEST events
+    (black-box semantics) in order."""
+    # The ring is process-global and sized at first enable; emulate wrap
+    # by writing far past whatever capacity is live.
+    assert flightrec.enable()
+    flightrec.reset()
+    cap = int(native.lib().bf_rec_enable(0))  # idempotent: live capacity
+    n = min(cap + 50, 200_000)
+    for i in range(n):
+        flightrec.note(flightrec.DRAIN, seq=i + 1)
+    ev = flightrec.snapshot()
+    assert len(ev) == min(n, cap)
+    seqs = ev["seq"].astype(np.int64)
+    assert seqs[-1] == n  # newest survived
+    assert np.all(np.diff(seqs) == 1)  # contiguous, oldest-first
+
+
+# ---------------------------------------------------------------------------
+# trace-gossip: fake-clock two-rank merge
+# ---------------------------------------------------------------------------
+
+def _write_fake_dump(path, rank, unix_us, mono_us, events):
+    arr = np.zeros(len(events), flightrec.EVENT_DTYPE)
+    for i, e in enumerate(events):
+        for k, v in e.items():
+            arr[i][k] = v
+    with open(path, "wb") as f:
+        f.write(flightrec.HEADER.pack(flightrec.MAGIC, flightrec.VERSION,
+                                      rank, 0, unix_us, mono_us,
+                                      len(arr)))
+        f.write(arr.tobytes())
+
+
+def test_trace_gossip_fake_clock_two_rank_merge(tmp_path):
+    """Two synthetic ranks with DIFFERENT clock origins: the merge must
+    wall-align them through the anchors and compute the exact one-way
+    delay, and the chrome trace must carry the s/f flow pair."""
+    prefix = str(tmp_path / "flightrec")
+    # Rank 0 (sender): monotonic clock starts at 1_000; anchor says
+    # mono 0 == unix 10_000_000.  Its ENQUEUE of tag (src=0, seq=5)
+    # happens at mono 1_000 -> wall 10_001_000.
+    _write_fake_dump(
+        f"{prefix}.0.bin", 0, unix_us=10_000_000, mono_us=0,
+        events=[dict(t_us=1_000, src=0, dst=1, seq=5, len=64,
+                     etype=flightrec.ENQUEUE, op=T.OP_PUT, name=b"w"),
+                dict(t_us=1_200, src=-1, dst=9, seq=1, len=64,
+                     etype=flightrec.SENDMSG, op=T.OP_PUT,
+                     name=b"h:9")])
+    # Rank 1 (receiver): a completely different monotonic origin; anchor
+    # mono 500_000 == unix 10_000_000.  Its DECODE of the same tag at
+    # mono 501_250 -> wall 10_001_250 => one-way delay 250 us.
+    _write_fake_dump(
+        f"{prefix}.1.bin", 1, unix_us=10_000_000, mono_us=500_000,
+        events=[dict(t_us=501_100, src=0, dst=1, seq=0, len=100,
+                     etype=flightrec.DRAIN, op=T.OP_BATCH, name=b""),
+                dict(t_us=501_250, src=0, dst=1, seq=5, len=64,
+                     etype=flightrec.DECODE,
+                     op=T.OP_PUT | T.OP_TRACE_FLAG, name=b"w")])
+    dumps = tracegossip.load_dumps(prefix)
+    assert [d["rank"] for d in dumps] == [0, 1]
+    delays = tracegossip.edge_delays(dumps)
+    assert list(delays) == [(0, 1)]
+    np.testing.assert_allclose(delays[(0, 1)], [250.0])
+    table = tracegossip.delay_table(delays)
+    assert "0 -> 1" in table and "0.250" in table
+
+    out, stats = tracegossip.merge_gossip(prefix, dumps=dumps)
+    import json
+    with open(out) as f:
+        merged = json.load(f)
+    assert stats["flows_matched"] == 1
+    lanes = {e["pid"] for e in merged if e.get("ph") == "X"}
+    assert lanes == {0, 1}
+    flow_id = (0 << 32) | 5
+    s = [e for e in merged if e.get("ph") == "s" and e["id"] == flow_id]
+    fin = [e for e in merged if e.get("ph") == "f" and e["id"] == flow_id]
+    assert len(s) == 1 and len(fin) == 1
+    assert s[0]["pid"] == 0 and fin[0]["pid"] == 1
+    # Wall alignment: the arrow spans exactly the 250 us delay.
+    assert fin[0]["ts"] - s[0]["ts"] == 250
+    # The frame-level SENDMSG event's seq (msgs-in-frame) must NOT have
+    # been mistaken for a trace tag.
+    assert stats["tags_sent"] == 1
+
+
+def test_trace_gossip_missing_dumps_raise(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        tracegossip.load_dumps(str(tmp_path / "nope"))
+
+
+# ---------------------------------------------------------------------------
+# Native commit plumbing: the WinItem trace fields reach the store
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_native_commit_entry_carries_trace(trace_env):
+    """A tagged native drain item surfaces its tag through
+    _commit_native_run into the age telemetry (unit-level: fake entry)."""
+    trace_env(BLUEFOG_TPU_TELEMETRY="1")
+    telemetry.reset()
+    bf.init(lambda: topo.RingGraph(8))
+    try:
+        assert bf.win_create(np.zeros((8, 4), np.float32), "nc",
+                             zero_init=True)
+        import time
+        now_us = time.time_ns() // 1000
+        win = W._store.get("nc")
+        (dst, src) = next(iter(win.staging))
+        vals = np.arange(4, dtype=np.float32)
+        # Mimic _apply_native_items' commit tuple with a live distrib:
+        # the store path needs one, so call the commit with the module's
+        # single-process distrib shim (None -> parking path would lose
+        # the tag; install a minimal stand-in).
+        saved = W._store.distrib
+        W._store.distrib = W._Distrib(
+            object(), rank_owner={r: 0 for r in range(8)},
+            proc_addr={0: ("127.0.0.1", 1)}, my_proc=0)
+        try:
+            W._commit_native_run("nc", [
+                ("nc", True, src, dst, 0.0, 1, 0, vals, 16,
+                 (src, 9, 0, now_us - 1_000_000))])
+        finally:
+            W._store.distrib = saved
+        np.testing.assert_array_equal(
+            np.asarray(win.staging[(dst, src)]), vals)
+        pct = telemetry.histogram_percentiles(
+            "bf_win_contribution_age_seconds", qs=(50.0,), src=str(src))
+        assert pct is not None and 0.5 < pct[50.0] < 2.5
+    finally:
+        bf.win_free("nc")
