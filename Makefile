@@ -7,8 +7,8 @@ PYTEST = python -m pytest -q
 
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
-        stripe-smoke tracerec-smoke ffi-smoke placement-smoke synth-smoke \
-        hier-smoke chaos-smoke chaos
+        stripe-smoke tracerec-smoke async-smoke ffi-smoke placement-smoke \
+        synth-smoke hier-smoke chaos-smoke chaos
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -18,8 +18,8 @@ PYTEST = python -m pytest -q
 # window-transport hot path is fresh (graceful skip without a toolchain —
 # every native consumer has a Python fallback).
 test: native test-fast bench-comm-smoke prof-smoke transport-smoke \
-      stripe-smoke tracerec-smoke ffi-smoke placement-smoke synth-smoke \
-      hier-smoke chaos-smoke
+      stripe-smoke tracerec-smoke async-smoke ffi-smoke placement-smoke \
+      synth-smoke hier-smoke chaos-smoke
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -128,6 +128,20 @@ stripe-smoke:
 tracerec-smoke:
 	env JAX_PLATFORMS=cpu python bench_comm.py --tracerec-smoke
 
+# Barrier-free async gossip CI gate: a loopback two-transport rig with
+# BLUEFOG_TPU_ASYNC=1 and the sender's origin-step clock pinned behind
+# the receiver's (the injected delay) — asserts the bounded-staleness
+# fold rejects the over-age accumulates into the stale-residual store
+# (bf_win_stale_rejected_total on /metrics, the "async" block in
+# /healthz), that win_fold_stale_residuals restores the held mass into
+# staging EXACTLY (push-sum conservation on real wire frames), and that
+# a BLUEFOG_TPU_TELEMETRY=0 leg leaves the registry untouched.  Run on
+# the native hot path AND pinned to the Python fallback.
+async-smoke:
+	env JAX_PLATFORMS=cpu python bench_comm.py --async-smoke
+	env JAX_PLATFORMS=cpu BLUEFOG_TPU_WIN_NATIVE=0 \
+	    python bench_comm.py --async-smoke
+
 # Zero-copy XLA put-path CI gate: loopback window-store puts of DEVICE
 # arrays through the BLUEFOG_TPU_WIN_XLA plan dispatch — asserts the FFI
 # path engaged and bf_win_host_copy_bytes_total reports ZERO put-side
@@ -144,9 +158,16 @@ ffi-smoke:
 # failure consensus (a committed membership epoch in /healthz), re-plan
 # onto a survivor topology without a global restart within a bounded
 # number of steps, converge to the survivor-consensus optimum, and keep
-# post-recovery step time within 1.5x the pre-failure median.
+# post-recovery step time within 1.5x the pre-failure median.  The
+# delay leg runs the same gang under a `delay:` fault in BOTH gossip
+# modes: synchronous survivors must DEGRADE toward the slowest rank's
+# cadence while BLUEFOG_TPU_ASYNC=1 survivors hold the no-fault step
+# time, the merely-slow rank is NOT evicted even with step-lag eviction
+# armed (the widened async bound), and both modes reach the same
+# consensus optimum (matched final loss through rejection + backstop).
 chaos-smoke:
 	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --smoke
+	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --delay-smoke
 
 # Full interactive chaos demo (same harness, bigger run; see
 # `python -m bluefog_tpu.tools chaos --help` for kill/delay/partition

@@ -104,6 +104,18 @@ def _parse_args():
                         "asserts the FFI path engaged + zero staging-copy "
                         "bytes, no timing assertion; graceful skip when "
                         "jax.ffi or the native bf_xla symbols are absent")
+    p.add_argument("--async-smoke", action="store_true",
+                   help="structural CI gate of the barrier-free async "
+                        "gossip mode (`make async-smoke`): a loopback "
+                        "two-transport rig drives real accumulates whose "
+                        "origin-step clock is pinned behind the "
+                        "receiver's (the injected delay), asserts the "
+                        "bounded-staleness fold rejected them into the "
+                        "stale-residual store with the counters on "
+                        "/metrics + the async /healthz block, that "
+                        "win_fold_stale_residuals restores mass exactly, "
+                        "and that BLUEFOG_TPU_TELEMETRY=0 leaves the "
+                        "registry untouched")
     p.add_argument("--tracerec-smoke", action="store_true",
                    help="CI gate of message-level tracing "
                         "(`make tracerec-smoke`): flight recorder on + "
@@ -702,6 +714,215 @@ def stripe_main(args) -> int:
             "striped_cell": res,
             "single_stripe_wire_ok": all(
                 "STRIPES=1" not in f for f in failures),
+        },
+    }))
+    return rc
+
+
+def async_main(args) -> int:
+    """`make async-smoke`: the barrier-free async gossip CI gate.
+
+    Structural assertions, no timing — a loopback two-transport rig
+    (real win_accumulate through the real coalesced/native drain path)
+    with the async mode armed:
+      1. a FRESH round (origin-step clock == receiver clock) commits
+         into staging on the exact legacy arithmetic path;
+      2. a STALE round — the sender's origin-step clock pinned behind
+         the receiver's (the injected delay: exactly what a straggler's
+         gossip looks like on the wire) — is rejected into the
+         stale-residual store, with `bf_win_stale_rejected_total{src}`
+         on /metrics and the "async" block (step, lag, policy) in
+         /healthz;
+      3. win_fold_stale_residuals folds the held mass back into staging
+         EXACTLY (wire + residual + folded == input, the conservation
+         invariant, proven on real wire frames);
+      4. a BLUEFOG_TPU_TELEMETRY=0 leg runs the same traffic with the
+         registry left completely untouched (the policy still applies —
+         it is state, not telemetry).
+    """
+    import sys
+    import threading
+    import urllib.error
+    import urllib.request
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    prev = {v: os.environ.get(v) for v in (
+        "BLUEFOG_TPU_ASYNC", "BLUEFOG_TPU_ASYNC_STALENESS_STEPS",
+        "BLUEFOG_TPU_ASYNC_STALENESS_POLICY", "BLUEFOG_TPU_TRACE_SAMPLE",
+        "BLUEFOG_TPU_TELEMETRY", "BLUEFOG_TPU_WIN_COALESCE_LINGER_MS")}
+    os.environ.update({
+        "BLUEFOG_TPU_ASYNC": "1",
+        "BLUEFOG_TPU_ASYNC_STALENESS_STEPS": "4",
+        "BLUEFOG_TPU_ASYNC_STALENESS_POLICY": "reject",
+        "BLUEFOG_TPU_TRACE_SAMPLE": "1",
+        "BLUEFOG_TPU_TELEMETRY": "1",
+        "BLUEFOG_TPU_WIN_COALESCE_LINGER_MS": "100",
+    })
+    import numpy as np
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import transport as T
+    from bluefog_tpu.ops import window as W
+    from bluefog_tpu.utils import config as _config
+    from bluefog_tpu.utils import telemetry
+    _config.reload()
+    failures = []
+    bf.init(lambda: topo.RingGraph(8))
+    telemetry.reset()
+
+    def drive(rounds):
+        """Real accumulate streams through the loopback store; each round
+        is (origin_step, rows 8xD).  Returns the committed window state.
+        The window is created pre-directory so one store serves both
+        wire ends (the tracerec/test_win_xla pattern)."""
+        applied = [0]
+        cv = threading.Condition()
+
+        def bump(k):
+            with cv:
+                applied[0] += k
+                cv.notify_all()
+
+        def apply(op, name, src, dst, weight, p_weight, payload):
+            W._apply_inbound(op, name, src, dst, weight, p_weight, payload)
+            bump(1)
+
+        def apply_batch(msgs):
+            W._apply_inbound_batch(msgs)
+            bump(len(msgs))
+
+        def apply_items(items):
+            W._apply_inbound_items(items)
+            bump(sum((p[5] + p[6]) if k else 1 for k, p in items))
+
+        server = T.WindowTransport(apply, apply_batch=apply_batch,
+                                   apply_items=apply_items)
+        client = T.WindowTransport(lambda *a: None)
+        saved = W._store.distrib
+        try:
+            assert bf.win_create(np.zeros((8, 6), np.float32), "asmoke",
+                                 zero_init=True)
+            server.register_window("asmoke", 6)
+            W._store.distrib = W._Distrib(
+                client, rank_owner={r: r % 2 for r in range(8)},
+                proc_addr={0: ("127.0.0.1", 1),
+                           1: ("127.0.0.1", server.port)},
+                my_proc=0)
+            W.configure_async()
+            # The receiver's step clock: contributions age against it.
+            W.set_async_step(100)
+            total = 0
+            for origin_step, t in rounds:
+                # Injected delay: pin the SENDER-side origin-step clock
+                # (both encoders) behind the receiver's — each tag now
+                # says "I was computed at step <origin_step>".
+                T.set_trace_origin_step(origin_step)
+                bf.win_accumulate(t, "asmoke")
+                total += 8  # the ring's 8 remote (even->odd) edges
+                with cv:
+                    assert cv.wait_for(lambda: applied[0] >= total,
+                                       timeout=30), (applied[0], total)
+            win = W._store.get("asmoke")
+            with win.lock:
+                return (
+                    {k: v.copy() for k, v in win.staging.items()},
+                    {k: v.copy() for k, v in win.stale_residual.items()},
+                    W.win_fold_stale_residuals("asmoke"),
+                    {k: v.copy() for k, v in win.staging.items()},
+                )
+        finally:
+            W._store.distrib = saved
+            bf.win_free("asmoke")
+            client.stop()
+            server.stop()
+
+    fresh = np.random.RandomState(5).randn(8, 6).astype(np.float32)
+    stale = np.random.RandomState(6).randn(8, 6).astype(np.float32)
+    staging, residual, folded, after = drive(
+        [(99, fresh), (50, stale)])    # ages 1 (fresh) and 50 (stale)
+    # The ring's 8 remote (even-src -> odd-dst) edges, wraparound included.
+    remote = sorted({((s + step) % 8, s)
+                     for s in range(0, 8, 2) for step in (1, -1)})
+    n_stale_edges = 0
+    for key in remote:
+        d, s = key
+        exp_fresh = fresh[s]
+        exp_stale = stale[s]
+        if not np.array_equal(staging.get(key), exp_fresh):
+            failures.append(f"edge {key}: fresh round not committed "
+                            "on the legacy path")
+        if key in residual:
+            n_stale_edges += 1
+            if not np.array_equal(residual[key], exp_stale):
+                failures.append(f"edge {key}: stale residual mismatch")
+        if not np.array_equal(after.get(key), exp_fresh + exp_stale):
+            failures.append(f"edge {key}: fold did not restore mass "
+                            "exactly")
+    if n_stale_edges == 0:
+        failures.append("no edge ever hit the staleness policy")
+
+    # -- /metrics + /healthz surfaces ---------------------------------------
+    port = telemetry.start_http_server(0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            hz = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:   # degraded status is still JSON
+        hz = json.loads(e.read().decode())
+    if "bf_win_stale_rejected_total" not in text:
+        failures.append("bf_win_stale_rejected_total missing on /metrics")
+    ablock = hz.get("async")
+    if not ablock:
+        failures.append("no async block in /healthz")
+    elif ablock.get("staleness_steps") != 4 or "stale_rejected" not in \
+            ablock:
+        failures.append(f"async /healthz block incomplete: {ablock}")
+
+    # -- BLUEFOG_TPU_TELEMETRY=0 zero-mutation guard ------------------------
+    os.environ["BLUEFOG_TPU_TELEMETRY"] = "0"
+    _config.reload()
+    telemetry.reset()
+    W.clear_async_staleness()
+    _, residual0, _, _ = drive([(40, stale)])
+    leaked = telemetry.snapshot()
+    if not residual0:
+        failures.append("TELEMETRY=0 leg: policy did not apply (it is "
+                        "state, not telemetry)")
+    if leaked:
+        failures.append("BLUEFOG_TPU_TELEMETRY=0 leg mutated the "
+                        f"registry: {sorted(leaked)[:5]}")
+
+    for var, val in prev.items():
+        if val is None:
+            os.environ.pop(var, None)
+        else:
+            os.environ[var] = val
+    _config.reload()
+    W.configure_async()
+    W.clear_async_staleness()
+    T.set_trace_origin_step(-1)
+    telemetry.stop_http_server()
+
+    rc = 0
+    for f in failures:
+        print(f"bench_comm --async-smoke: {f}", file=sys.stderr)
+        rc = 1
+    print(json.dumps({
+        "metric": "win_async_stale_edges",
+        "value": n_stale_edges,
+        "unit": "edges",
+        "detail": {
+            "healthz_async": ablock,
+            "fold_restored_exactly": rc == 0,
+            "zero_mutation_ok": not leaked,
         },
     }))
     return rc
@@ -1732,6 +1953,8 @@ def main():
     args = _parse_args()
     if args.ffi or args.ffi_smoke:
         return ffi_main(args)
+    if args.async_smoke:
+        return async_main(args)
     if args.tracerec_smoke:
         return tracerec_main(args)
     if args.stripe_smoke:

@@ -118,6 +118,10 @@ from bluefog_tpu.ops.window import (  # noqa: F401
     win_associated_p,
     turn_on_win_ops_with_associated_p,
     turn_off_win_ops_with_associated_p,
+    # Barrier-free async gossip (BLUEFOG_TPU_ASYNC): fold held-back
+    # stale mass / read the async block programmatically.
+    win_fold_stale_residuals,
+    async_info,
 )
 
 # Zero-copy XLA window put path (BLUEFOG_TPU_WIN_XLA) diagnostics:

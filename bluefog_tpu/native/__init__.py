@@ -68,6 +68,7 @@ class WinItem(ctypes.Structure):
         ("trace_src", ctypes.c_int32),
         ("trace_mono_us", ctypes.c_int64),
         ("trace_unix_us", ctypes.c_int64),
+        ("trace_step", ctypes.c_int64),
         ("name", ctypes.c_char * 128),
     ]
 
@@ -226,6 +227,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bf_trace_period.argtypes = []
         lib.bf_trace_next.restype = i32
         lib.bf_trace_next.argtypes = [i32, ptr(ctypes.c_uint8)]
+        lib.bf_trace_set_step.restype = None
+        lib.bf_trace_set_step.argtypes = [i64]
+        lib.bf_trace_step.restype = i64
+        lib.bf_trace_step.argtypes = []
+        lib.bf_winsvc_set_fold_across_put.restype = None
+        lib.bf_winsvc_set_fold_across_put.argtypes = [i32]
         lib.bf_rec_enable.restype = i64
         lib.bf_rec_enable.argtypes = [i64]
         lib.bf_rec_is_enabled.restype = i32
@@ -390,15 +397,18 @@ def has_win_native() -> bool:
     hot path (``bf_wintx_*`` / ``bf_winsvc_drain``) — including the
     multi-stream stripe surface (``bf_wintx_stripe_stats``, whose absence
     marks a pre-stripe build with the OLD ``bf_wintx_start``/``send``
-    signatures) and the tracing surface (``bf_rec_snapshot``, whose
-    absence marks a pre-trace build with the OLD ``bf_win_item_t``
-    layout) — and is not stale."""
+    signatures), the tracing surface (``bf_rec_snapshot``, whose absence
+    marks a pre-trace build with the OLD ``bf_win_item_t`` layout) and
+    the async step clock (``bf_trace_set_step``, whose absence marks a
+    build with the 24-byte trace trailer and no ``trace_step`` item
+    field) — and is not stale."""
     handle = lib()
     return (handle is not None and not _stale
             and hasattr(handle, "bf_wintx_start")
             and hasattr(handle, "bf_winsvc_drain")
             and hasattr(handle, "bf_wintx_stripe_stats")
-            and hasattr(handle, "bf_rec_snapshot"))
+            and hasattr(handle, "bf_rec_snapshot")
+            and hasattr(handle, "bf_trace_set_step"))
 
 
 def has_win_xla() -> bool:

@@ -135,6 +135,8 @@ typedef struct {
   int32_t trace_src;
   int64_t trace_mono_us;  /* sender's CLOCK_MONOTONIC at origin (us) */
   int64_t trace_unix_us;  /* sender's unix wall clock at origin (us) */
+  int64_t trace_step;     /* sender's training step at origin (-1 = the
+                           * sender had no step clock) */
   char name[128];
 } bf_win_item_t;
 
@@ -363,19 +365,31 @@ int32_t bf_xla_has_handler(void);
  *
  * Trace tags (BLUEFOG_TPU_TRACE_SAMPLE): a sampled subset of
  * put/accumulate messages carries OP_TRACE_FLAG (0x10) in the op byte
- * and a 24-byte trailer appended to the payload:
+ * and a 32-byte trailer appended to the payload:
  *   i32 src_rank | u32 seq | i64 origin_monotonic_us | i64 origin_unix_us
+ *   | i64 origin_step
  * The Python sender builds the trailer itself (the payload is opaque to
  * bf_wintx_send, so the native tx path ships it unchanged); the XLA put
  * plans call bf_trace_next from C.  Sequence spaces are disjoint: Python
  * tags count up from 1, native tags carry bit 31 set — one process's
- * (src_rank, seq) is globally unique either way. */
+ * (src_rank, seq) is globally unique either way.  origin_step is the
+ * sender's training step at encode time (-1 when no step clock was
+ * published) — the exact age-in-steps sensor the bounded-staleness
+ * async fold reads. */
 
-#define BF_TRACE_TRAILER_LEN 24
+#define BF_TRACE_TRAILER_LEN 32
 
 /* Set the sampling period (tag every Nth data message; <= 0 = off). */
 void bf_trace_configure(int32_t period);
 int32_t bf_trace_period(void);
+/* Publish the sender-side origin-step clock carried by native-encoded
+ * trailers (the window optimizer family calls this each step). */
+void bf_trace_set_step(int64_t step);
+int64_t bf_trace_step(void);
+/* Drain-fold policy: allow=0 stops the decoder folding accumulates into
+ * PUT-headed commit entries, so the async bounded-staleness policy sees
+ * every accumulate individually (default 1 = the legacy-exact fold). */
+void bf_winsvc_set_fold_across_put(int32_t allow);
 /* Sampling decision + trailer for one outgoing message on the native
  * encode paths.  Returns 1 and fills trailer[BF_TRACE_TRAILER_LEN] when
  * this message is tagged, else 0 (trailer untouched). */

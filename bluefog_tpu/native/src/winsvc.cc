@@ -156,6 +156,12 @@ std::mutex g_rec_m;  // serializes enable/reset only, never record
 std::atomic<int32_t> g_trace_period{0};
 std::atomic<uint32_t> g_trace_count{0};
 std::atomic<uint32_t> g_trace_seq{0};
+std::atomic<int64_t> g_trace_step{-1};
+// 1 (default): the drain fold may merge an accumulate into a PUT-headed
+// commit entry (the legacy-exact behavior).  0 (async bounded-staleness
+// mode): accumulates never fold across a put, so every accumulate gets
+// its own staleness decision at the Python commit.
+std::atomic<int32_t> g_fold_across_put{1};
 
 inline bool RecOn() {
   return g_rec.load(std::memory_order_acquire) != nullptr;
@@ -278,6 +284,18 @@ int32_t bf_trace_period(void) {
   return g_trace_period.load(std::memory_order_relaxed);
 }
 
+void bf_trace_set_step(int64_t step) {
+  g_trace_step.store(step, std::memory_order_relaxed);
+}
+
+void bf_winsvc_set_fold_across_put(int32_t allow) {
+  g_fold_across_put.store(allow ? 1 : 0, std::memory_order_relaxed);
+}
+
+int64_t bf_trace_step(void) {
+  return g_trace_step.load(std::memory_order_relaxed);
+}
+
 int32_t bf_trace_next(int32_t src, uint8_t* trailer) {
   int32_t p = g_trace_period.load(std::memory_order_relaxed);
   if (p <= 0 || trailer == nullptr) return 0;
@@ -289,10 +307,12 @@ int32_t bf_trace_next(int32_t src, uint8_t* trailer) {
   uint32_t seq = 0x80000000u |
                  (g_trace_seq.fetch_add(1, std::memory_order_relaxed) + 1);
   int64_t mono = MonoUs(), unix_us = UnixUs();
+  int64_t step = g_trace_step.load(std::memory_order_relaxed);
   std::memcpy(trailer, &src, 4);
   std::memcpy(trailer + 4, &seq, 4);
   std::memcpy(trailer + 8, &mono, 8);
   std::memcpy(trailer + 16, &unix_us, 8);
+  std::memcpy(trailer + 24, &step, 8);
   return 1;
 }
 
@@ -725,7 +745,7 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
       continue;
     }
     float wf = (float)w;
-    // Wire trace tag (kFlagTrace): strip the 24-byte trailer BEFORE the
+    // Wire trace tag (kFlagTrace): strip the 32-byte trailer BEFORE the
     // codec validation (the payload-length checks are exact); the full
     // plen still counts as wire bytes.  A tagged payload too short to
     // carry its trailer is malformed — raw emit, losing only itself,
@@ -733,7 +753,7 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
     uint64_t dlen = plen;
     uint32_t tr_seq = 0;
     int32_t tr_src = 0;
-    int64_t tr_mono = 0, tr_unix = 0;
+    int64_t tr_mono = 0, tr_unix = 0, tr_step = -1;
     if (op & kFlagTrace) {
       if (plen < BF_TRACE_TRAILER_LEN) {
         int rc = EmitRaw(c, op, msrc, mdst, w, pw, nm, nlen, pp, plen);
@@ -751,6 +771,7 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
       std::memcpy(&tr_seq, tp + 4, 4);
       std::memcpy(&tr_mono, tp + 8, 8);
       std::memcpy(&tr_unix, tp + 16, 8);
+      std::memcpy(&tr_step, tp + 24, 8);
       dlen -= BF_TRACE_TRAILER_LEN;
       if (RecOn())
         RecNoteN(BF_REC_DECODE, op, 0, msrc, mdst, tr_seq, plen, nm, nlen);
@@ -761,6 +782,13 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
       can_fold = prev.src == msrc && prev.dst == mdst &&
                  prev.name[nlen] == '\0' &&
                  std::memcmp(prev.name, nm, nlen) == 0;
+      // Async bounded-staleness mode: never fold an accumulate into a
+      // PUT-headed entry — puts bypass the staleness policy (overwrite
+      // semantics), so the fold would smuggle the accumulate's mass
+      // past it.  Accumulate-into-accumulate folds stay.
+      if (can_fold && prev.replace &&
+          !g_fold_across_put.load(std::memory_order_relaxed))
+        can_fold = false;
     }
     if (can_fold) {
       bf_win_item_t& prev = c->items[last_commit];
@@ -790,6 +818,7 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
         prev.trace_src = tr_src;
         prev.trace_mono_us = tr_mono;
         prev.trace_unix_us = tr_unix;
+        prev.trace_step = tr_step;
         if (RecOn())
           RecNoteN(BF_REC_FOLD, op, 0, msrc, mdst, tr_seq, plen, nm, nlen);
       }
@@ -838,6 +867,7 @@ int DecodeFrame(bf_winsvc* s, const Inbound& in, DrainCursor* c,
     it.trace_src = tr_src;
     it.trace_mono_us = tr_mono;
     it.trace_unix_us = tr_unix;
+    it.trace_step = tr_step;
     std::memcpy(it.name, nm, nlen);
     it.name[nlen] = '\0';
     last_commit = c->n_items;

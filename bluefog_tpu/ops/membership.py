@@ -110,8 +110,20 @@ class MembershipController:
         self.straggler_steps = (cfg.churn_straggler_steps
                                 if straggler_steps is None
                                 else straggler_steps)
+        # Barrier-free async mode (BLUEFOG_TPU_ASYNC): ranks LEGITIMATELY
+        # run ahead of each other between exact-collect backstops, so a
+        # raw step-lag threshold would evict peers that are merely slow.
+        # The lag a healthy straggler can accumulate is bounded by the
+        # backstop cadence (fast ranks block at the collect fence until
+        # it arrives), so the effective threshold widens by exactly
+        # ASYNC_COLLECT_EVERY; with no backstop (collect_every=0) lag is
+        # unbounded by design and step-lag eviction disables itself —
+        # the staleness policy, not membership, absorbs slow peers.
+        self._async_mode = cfg.async_mode
+        self._async_collect_every = cfg.async_collect_every
         self._lock = threading.RLock()
         self.epoch = 0
+        self._warned_lag_eviction_off = False
         self.active: frozenset = frozenset(range(n_procs))
         self.evicted = False
         self.changes_total = 0
@@ -221,6 +233,29 @@ class MembershipController:
         with self._lock:
             self.my_step = int(step)
 
+    def _straggler_bound(self) -> int:
+        """Effective step-lag eviction threshold: 0 = lag eviction off.
+        Lockstep mode: the raw CHURN_STRAGGLER_STEPS knob.  Async mode:
+        widened by the collect-backstop cadence (the lag a merely-slow
+        peer legitimately reaches); disabled entirely with no backstop —
+        any threshold would evict healthy slow peers the staleness
+        policy is already absorbing."""
+        if not self.straggler_steps:
+            return 0
+        if not self._async_mode:
+            return self.straggler_steps
+        if not self._async_collect_every:
+            if not self._warned_lag_eviction_off:
+                self._warned_lag_eviction_off = True
+                from bluefog_tpu.utils.logging import get_logger
+                get_logger().warning(
+                    "churn: BLUEFOG_TPU_CHURN_STRAGGLER_STEPS is set but "
+                    "BLUEFOG_TPU_ASYNC=1 with no collect backstop "
+                    "(BLUEFOG_TPU_ASYNC_COLLECT_EVERY=0) makes step lag "
+                    "unbounded by design — step-lag eviction is disabled")
+            return 0
+        return self.straggler_steps + self._async_collect_every
+
     def _stale_peers(self, now: float) -> List[int]:
         """Active peers whose heartbeats have gone stale (lock held by the
         caller) — the probe candidates."""
@@ -243,6 +278,7 @@ class MembershipController:
         hard-silence window."""
         out = set()
         fresh_cut = now - self.suspect_sec
+        straggler_bound = self._straggler_bound()
         for p in sorted(self.active):
             if p == self.my_proc:
                 continue
@@ -258,9 +294,9 @@ class MembershipController:
                     # (wedged process, or a chaos partition dropping its
                     # outbound traffic).
                     out.add(p)
-            elif (self.straggler_steps
+            elif (straggler_bound
                   and self.my_step - self.peer_step.get(p, self.my_step)
-                  > self.straggler_steps):
+                  > straggler_bound):
                 # Alive but persistently behind: the straggler-eviction
                 # policy (opt-in) proposes it out so the survivors stop
                 # waiting on its gossip.

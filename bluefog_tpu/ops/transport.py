@@ -106,9 +106,9 @@ OP_BF16_FLAG = 0x40
 # same reason as OP_BF16_FLAG — never inferred from payload size.
 OP_SPARSE_FLAG = 0x20
 # Flag bit ORed into the op byte when the payload carries a wire trace
-# tag: a 24-byte ``i32 src_rank | u32 seq | i64 origin_monotonic_us |
-# i64 origin_unix_us`` trailer APPENDED to the (possibly compressed)
-# payload, on a sampled subset of puts/accumulates
+# tag: a 32-byte ``i32 src_rank | u32 seq | i64 origin_monotonic_us |
+# i64 origin_unix_us | i64 origin_step`` trailer APPENDED to the
+# (possibly compressed) payload, on a sampled subset of puts/accumulates
 # (``BLUEFOG_TPU_TRACE_SAMPLE=1/N``; default off — no flag, no trailer,
 # the wire bitwise identical).  Riding inside the payload means the tag
 # survives OP_BATCH framing, the bf16/sparse codecs and striping with no
@@ -124,7 +124,8 @@ __all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
            "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BATCH", "OP_MEMBER",
            "OP_BF16_FLAG", "OP_SPARSE_FLAG", "OP_TRACE_FLAG",
            "OP_FLAG_MASK", "TRACE_TRAILER", "make_trace_tag",
-           "trace_strip", "sparse_encode", "sparse_decode", "stripe_for",
+           "trace_strip", "set_trace_origin_step", "trace_origin_step",
+           "sparse_encode", "sparse_decode", "stripe_for",
            "resolve_stripes"]
 
 _OP_NAMES = {OP_PUT: "put", OP_ACCUMULATE: "accumulate",
@@ -163,16 +164,39 @@ def _op_label(op: int) -> str:
 # XLA-plan encoder (bf_trace_next) sets bit 31 — one process's
 # (src_rank, seq) pair is globally unique either way.
 
-TRACE_TRAILER = struct.Struct("<iIqq")  # src_rank, seq, mono_us, unix_us
+# src_rank, seq, mono_us, unix_us, origin_step (-1 = sender had no step
+# clock — pre-async senders, raw transport users).
+TRACE_TRAILER = struct.Struct("<iIqqq")
 
 _trace_lock = threading.Lock()
 _trace_count = 0
 _trace_seq = 0
+# The sender's current training step (the async step clock): published
+# by the window optimizer family each step so sampled messages carry an
+# EXACT origin step and the receiver's staleness bound can count in
+# steps instead of estimating from wall clocks.  -1 = unknown.
+_origin_step = -1
+
+
+def set_trace_origin_step(step: int) -> None:
+    """Publish the sender-side origin-step clock (both encoders: this
+    module's :func:`make_trace_tag` and, when the native core is live,
+    the XLA put plans' ``bf_trace_next``)."""
+    global _origin_step
+    _origin_step = int(step)
+    from bluefog_tpu import native
+    handle = native.lib()
+    if handle is not None and hasattr(handle, "bf_trace_set_step"):
+        handle.bf_trace_set_step(int(step))
+
+
+def trace_origin_step() -> int:
+    return _origin_step
 
 
 def make_trace_tag(src: int) -> Optional[bytes]:
     """Sampling decision + trailer for one outgoing data message: the
-    packed 24-byte trailer when this message is the 1-in-N tagged one,
+    packed 32-byte trailer when this message is the 1-in-N tagged one,
     else None.  With ``BLUEFOG_TPU_TRACE_SAMPLE`` unset this is one
     config-flag check — no counter mutation, no allocation (the
     bitwise-identical-wire guarantee)."""
@@ -188,15 +212,16 @@ def make_trace_tag(src: int) -> Optional[bytes]:
         _trace_seq += 1
         seq = _trace_seq
     return TRACE_TRAILER.pack(src, seq, time.monotonic_ns() // 1000,
-                              time.time_ns() // 1000)
+                              time.time_ns() // 1000, _origin_step)
 
 
 def trace_strip(payload) -> Tuple["bytes | memoryview",
-                                  Tuple[int, int, int, int]]:
+                                  Tuple[int, int, int, int, int]]:
     """Split a tagged payload into ``(body, (src_rank, seq,
-    origin_monotonic_us, origin_unix_us))``.  Raises ValueError when the
-    payload cannot carry its trailer (malformed frame — per-message
-    isolation handles it exactly like any other bad payload)."""
+    origin_monotonic_us, origin_unix_us, origin_step))``.  Raises
+    ValueError when the payload cannot carry its trailer (malformed frame
+    — per-message isolation handles it exactly like any other bad
+    payload)."""
     n = len(payload)
     if n < TRACE_TRAILER.size:
         raise ValueError(
@@ -1401,10 +1426,12 @@ class WindowTransport:
                                          count=it.len, offset=it.off * 4)
                     # Trace tag of the last tagged message folded into
                     # this entry (None untagged) — same (src, seq, mono,
-                    # unix) shape trace_strip returns on the Python path.
+                    # unix, step) shape trace_strip returns on the
+                    # Python path.
                     trace = (int(it.trace_src), int(it.trace_seq),
                              int(it.trace_mono_us),
-                             int(it.trace_unix_us)) \
+                             int(it.trace_unix_us),
+                             int(it.trace_step)) \
                         if it.trace_seq else None
                     items.append((1, (it.name.decode(), bool(it.replace),
                                       int(it.src), int(it.dst),

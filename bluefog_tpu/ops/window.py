@@ -59,6 +59,8 @@ __all__ = [
     "win_state_dict", "win_load_state_dict",
     "get_current_created_window_names", "win_associated_p",
     "turn_on_win_ops_with_associated_p", "turn_off_win_ops_with_associated_p",
+    "configure_async", "async_armed", "set_async_step", "async_step_lag",
+    "async_info", "win_fold_stale_residuals", "clear_async_staleness",
 ]
 
 # Wire op codes live in ops.transport (single source of truth).  Field use:
@@ -158,6 +160,16 @@ class _Window:
         # associated-P scalars (push-sum weights); self starts at 1.0
         self.p_main: Dict[int, float] = {r: 1.0 for r in self.owned}
         self.p_staging: Dict[tuple, float] = {k: 0.0 for k in self.staging}
+        # Receiver-side stale-contribution store (BLUEFOG_TPU_ASYNC
+        # bounded staleness): value/P mass the staleness policy diverted
+        # away from staging instead of dropping, keyed by the same
+        # (dst, src) edges.  Folded back into staging at the periodic
+        # exact collect (win_fold_stale_residuals) so push-sum mass
+        # conservation holds: staging + stale residual + wire-in-flight
+        # always equals the mass senders put on the wire.  Empty (and
+        # never touched) outside async mode.
+        self.stale_residual: Dict[tuple, np.ndarray] = {}
+        self.p_stale_residual: Dict[tuple, float] = {}
 
 
 class _Distrib:
@@ -295,8 +307,11 @@ def _shutdown_transport() -> None:
         xlaffi.invalidate()
         d.transport.stop()
         # No transport, no edges: per-edge staleness gauges describing a
-        # dead wire must not linger as live series (churn hygiene class).
+        # dead wire must not linger as live series (churn hygiene class),
+        # and the async per-peer step/age estimates describe peers that
+        # no longer exist.
         clear_contribution_age()
+        clear_async_staleness()
 
 
 def _to_numpy(x) -> np.ndarray:
@@ -448,6 +463,10 @@ def init_transport() -> bool:
     # ``operations.cc:417-429`` lists missing ranks per stalled tensor).
     from bluefog_tpu.utils import stall
     stall.set_peer_probe(_probe_missing_ranks)
+    # Barrier-free async mode (BLUEFOG_TPU_ASYNC): arm the bounded-
+    # staleness fold with the transport — with the knob off this is one
+    # config check and the flag stays False (bitwise legacy paths).
+    configure_async()
     return True
 
 
@@ -513,6 +532,14 @@ def _note_trace_commit(name: str, src: int, tag) -> None:
     event so the tag's chain ends where the state changed."""
     import time as _time
     from bluefog_tpu.utils import telemetry
+    if _async.armed and len(tag) > 4 and tag[4] >= 0:
+        # Every traced data commit feeds the freshest-peer-step estimate
+        # (state, not telemetry): the put and pull families never route
+        # through the accumulate-only staleness policy, but their
+        # bf_async_step_lag must still see who runs ahead.
+        with _async.lock:
+            if tag[4] > _async.peer_step.get(src, -(1 << 62)):
+                _async.peer_step[src] = int(tag[4])
     if flightrec.enabled():
         flightrec.note(flightrec.COMMIT, src=tag[0], dst=src, seq=tag[1],
                        name=name)
@@ -552,6 +579,248 @@ def clear_contribution_age(ranks=None) -> None:
                               src=str(r))
         telemetry.clear_gauge("bf_win_contribution_stalest_age_seconds",
                               src=str(r))
+
+
+# ---------------------------------------------------------------------------
+# Barrier-free async gossip: step clock + bounded-staleness policy
+# (BLUEFOG_TPU_ASYNC / _STALENESS_STEPS / _STALENESS_POLICY)
+# ---------------------------------------------------------------------------
+
+class _AsyncGossip:
+    """Process-wide state of the async window-gossip mode.
+
+    ``armed`` is the single hot-path check every commit performs: with
+    ``BLUEFOG_TPU_ASYNC=0`` (the default) it stays False and every data
+    path is bit-identical to the lockstep tree.  The step clock
+    (``step`` + the EWMA ``step_period``) is published by the window
+    optimizer family each step; ``peer_step`` tracks the freshest origin
+    step seen per in-neighbor (from sampled wire trace tags) and
+    ``edge_age`` the last estimated age per edge — the estimate
+    unsampled messages on the same edge inherit (staleness is a sender
+    property: a straggler is persistently behind, so a 1/N sample tracks
+    it)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.armed = False
+        self.staleness_steps = 0
+        self.policy = ("reject", 0.0)
+        self.step = 0
+        self.step_period = 0.0          # EWMA seconds per local step
+        self._last_step_mono = None
+        self.peer_step: Dict[int, int] = {}
+        self.edge_age: Dict[tuple, float] = {}
+
+
+_async = _AsyncGossip()
+
+
+def configure_async(enabled: Optional[bool] = None) -> bool:
+    """(Re-)arm the async gossip mode from config (``enabled`` overrides
+    ``BLUEFOG_TPU_ASYNC``); returns the armed state.  Disarming clears
+    every estimate so a later re-arm starts fresh."""
+    cfg = config.get()
+    on = cfg.async_mode if enabled is None else bool(enabled)
+    with _async.lock:
+        _async.staleness_steps = int(cfg.async_staleness_steps)
+        _async.policy = config.parse_staleness_policy(
+            cfg.async_staleness_policy)
+        _async.armed = on
+        if not on:
+            _async.peer_step.clear()
+            _async.edge_age.clear()
+            _async._last_step_mono = None
+            _async.step_period = 0.0
+    # Native drain-fold parity: the C decoder must stop folding
+    # accumulates across PUT-headed entries exactly when the Python
+    # decoder does (see _apply_data_run), or the policy would see
+    # different granularity per hot path.
+    from bluefog_tpu import native
+    handle = native.lib()
+    if handle is not None and hasattr(handle,
+                                      "bf_winsvc_set_fold_across_put"):
+        handle.bf_winsvc_set_fold_across_put(0 if on else 1)
+    return on
+
+
+def async_armed() -> bool:
+    return _async.armed
+
+
+def set_async_step(step: int) -> None:
+    """Publish this process's training-step clock: staleness ages count
+    against it, and both trace-tag encoders (the Python sender and the
+    native XLA-plan path) stamp it into the wire trailer as the origin
+    step, so receivers measure age in steps exactly."""
+    import time as _time
+    now = _time.monotonic()
+    with _async.lock:
+        prev, _async._last_step_mono = _async._last_step_mono, now
+        _async.step = int(step)
+        if prev is not None and now > prev:
+            dt = now - prev
+            _async.step_period = dt if _async.step_period == 0.0 \
+                else 0.9 * _async.step_period + 0.1 * dt
+    from bluefog_tpu.ops import transport as _transport
+    _transport.set_trace_origin_step(step)
+
+
+def async_step_lag() -> int:
+    """My step vs the freshest-seen peer step (positive = I am behind the
+    freshest peer; 0 when no peer origin step has been observed)."""
+    with _async.lock:
+        if not _async.peer_step:
+            return 0
+        return max(_async.peer_step.values()) - _async.step
+
+
+def async_info() -> Optional[dict]:
+    """The /healthz "async" block source: None unless the mode is armed."""
+    with _async.lock:
+        if not _async.armed:
+            return None
+        cfg = config.get()
+        freshest = max(_async.peer_step.values(), default=None)
+        return {
+            "step": _async.step,
+            "staleness_steps": _async.staleness_steps,
+            "policy": cfg.async_staleness_policy,
+            "collect_every": cfg.async_collect_every,
+            "step_lag": (freshest - _async.step)
+            if freshest is not None else 0,
+            "step_period_sec": round(_async.step_period, 6),
+            "peer_steps": dict(_async.peer_step),
+        }
+
+
+def _staleness_factor(name: str, key: tuple, tag) -> tuple:
+    """Bounded-staleness decision for ONE arriving ACCUMULATE
+    contribution (call with ``win.lock`` held): returns ``(keep, action)``
+    where ``keep`` is the fraction entering staging and ``action`` is
+    None (fresh — the caller must take the exact legacy arithmetic
+    path), ``"reject"`` (keep == 0.0) or ``"downweight"``.
+
+    Age in origin steps: exact when the message carried a trace tag with
+    an origin step (my step clock minus the tag's step); a tag without a
+    step clock falls back to wall-clock age converted through my own
+    step period; an UNSAMPLED message inherits its edge's last sampled
+    estimate (fresh until the first sample — the optimistic default, the
+    periodic collect backstop covers what it misses)."""
+    if not _async.armed:
+        return 1.0, None
+    src = key[1]
+    with _async.lock:
+        bound = _async.staleness_steps
+        kind, alpha = _async.policy
+        if tag is not None:
+            o_step = tag[4] if len(tag) > 4 else -1
+            if o_step >= 0:
+                age = float(max(0, _async.step - o_step))
+                if o_step > _async.peer_step.get(src, -(1 << 62)):
+                    _async.peer_step[src] = int(o_step)
+            else:
+                import time as _time
+                age_sec = max(0.0, (_time.time_ns() // 1000 - tag[3]) / 1e6)
+                period = _async.step_period
+                age = age_sec / period if period > 0 else 0.0
+            _async.edge_age[(name,) + key] = age
+        else:
+            age = _async.edge_age.get((name,) + key, 0.0)
+    if bound <= 0 or age <= bound:
+        return 1.0, None
+    if kind == "downweight":
+        return alpha, "downweight"
+    return 0.0, "reject"
+
+
+def _divert_stale(win: _Window, key: tuple, contrib: np.ndarray,
+                  p_mass: float, keep: float) -> None:
+    """Move the non-admitted fraction of one stale contribution into the
+    window's stale-residual store (call with ``win.lock`` held).
+    ``contrib`` may be a zero-copy view into a transport buffer — the
+    store always owns its arrays."""
+    frac = 1.0 - keep
+    add = contrib if keep == 0.0 else contrib * win.dtype.type(frac)
+    res = win.stale_residual.get(key)
+    if res is None:
+        win.stale_residual[key] = np.array(add, dtype=win.dtype)
+    else:
+        res += add
+    if _store.associated_p_enabled:
+        win.p_stale_residual[key] = \
+            win.p_stale_residual.get(key, 0.0) + frac * p_mass
+
+
+def _note_stale(name: str, actions) -> None:
+    """Telemetry for applied staleness decisions (outside ``win.lock`` —
+    counters are not state)."""
+    from bluefog_tpu.utils import telemetry
+    if not telemetry.enabled():
+        return
+    for src, action in actions:
+        telemetry.inc("bf_win_stale_rejected_total" if action == "reject"
+                      else "bf_win_stale_downweighted_total",
+                      src=str(src))
+
+
+def win_fold_stale_residuals(name: Optional[str] = None) -> int:
+    """Fold every stale-diverted contribution back into its staging slot
+    (one window, or all).  Returns the number of edges folded.
+
+    The async optimizer calls this right after its periodic
+    ``win_fence`` (the ``BLUEFOG_TPU_ASYNC_COLLECT_EVERY`` backstop) and
+    before the exact collect: post-fence nothing is in flight, so
+    staging + these residuals is exactly the mass senders shipped — the
+    collect that follows restores exact push-sum conservation including
+    everything the staleness policy held back.  Residuals of edges that
+    no longer exist (survivor re-plan dropped the edge) die with their
+    window, same as staging from a dead peer."""
+    with _store.lock:
+        names = [name] if name is not None else list(_store.windows)
+    folded = 0
+    for nm in names:
+        try:
+            win = _store.get(nm)
+        except KeyError:
+            continue
+        with win.lock:
+            for key, res in list(win.stale_residual.items()):
+                if key in win.staging:
+                    win.staging[key] += res
+                    win.versions[key] += 1
+                    if _store.associated_p_enabled:
+                        win.p_staging[key] += \
+                            win.p_stale_residual.get(key, 0.0)
+                    folded += 1
+            win.stale_residual.clear()
+            win.p_stale_residual.clear()
+    return folded
+
+
+def clear_async_staleness(ranks=None) -> None:
+    """Drop the per-peer async staleness state for ``ranks`` (None = all)
+    — churn hygiene, the same orphan-series class as
+    :func:`clear_contribution_age`: a dead peer's last-known origin step
+    must not keep inflating ``bf_async_step_lag``, and its per-src stale
+    counters must not linger as live series."""
+    from bluefog_tpu.utils import telemetry
+    with _async.lock:
+        if ranks is None:
+            # Union of BOTH estimate stores: a src aged only through the
+            # wall-clock fallback (no origin step) lives in edge_age but
+            # never in peer_step — its counters must clear too.
+            targets = sorted(set(_async.peer_step)
+                             | {k[2] for k in _async.edge_age})
+        else:
+            targets = [int(r) for r in ranks]
+        for r in targets:
+            _async.peer_step.pop(r, None)
+        for k in [k for k in _async.edge_age if k[2] in targets]:
+            _async.edge_age.pop(k, None)
+    for r in targets:
+        telemetry.clear_counter("bf_win_stale_rejected_total", src=str(r))
+        telemetry.clear_counter("bf_win_stale_downweighted_total",
+                                src=str(r))
 
 
 def _drop_ef_residuals(name: Optional[str] = None) -> None:
@@ -1019,19 +1288,41 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
             # transient view is never retained.
             row = _payload_row(win, payload, compressed, copy=False,
                                sparse=sparse)
+            stale_action = None
             with win.lock:
                 if (dst, src) not in win.staging:
                     return
                 if op == OP_ACCUMULATE:
-                    win.staging[(dst, src)] += row * win.dtype.type(weight)
+                    keep, stale_action = _staleness_factor(
+                        name, (dst, src), tag)
+                    if stale_action is None:
+                        win.staging[(dst, src)] += \
+                            row * win.dtype.type(weight)
+                    else:
+                        # Bounded staleness (async mode): the admitted
+                        # fraction enters staging, the complement is
+                        # HELD in the stale-residual store — never
+                        # dropped, so mass conservation survives.
+                        contrib = row * win.dtype.type(weight)
+                        if keep:
+                            win.staging[(dst, src)] += \
+                                contrib * win.dtype.type(keep)
+                        _divert_stale(win, (dst, src), contrib,
+                                      p_weight, keep)
                 else:
                     win.staging[(dst, src)] = row * win.dtype.type(weight)
-                win.versions[dst, src] += 1
+                if stale_action != "reject":
+                    win.versions[dst, src] += 1
                 if _store.associated_p_enabled:
                     if op == OP_ACCUMULATE:
-                        win.p_staging[(dst, src)] += p_weight
+                        if stale_action is None:
+                            win.p_staging[(dst, src)] += p_weight
+                        elif keep:
+                            win.p_staging[(dst, src)] += keep * p_weight
                     else:
                         win.p_staging[(dst, src)] = p_weight
+            if stale_action is not None:
+                _note_stale(name, [(src, stale_action)])
             if tag is not None:
                 _note_trace_commit(name, src, tag)
     elif op == OP_GET_REQ:
@@ -1172,6 +1463,7 @@ def _commit_native_run(name: str, entries) -> None:
     expected = int(np.prod(win.shape, dtype=np.int64))
     from bluefog_tpu.utils.timeline import op_span
     noted = []
+    stale_noted = []
     with op_span(f"win_apply_batch.{name}", "COMMUNICATE"):
         with win.lock:
             for (_nm, replace, src, dst, p_mass, puts, accs, vals, _wb,
@@ -1192,16 +1484,31 @@ def _commit_native_run(name: str, entries) -> None:
                 row = vals.reshape(win.shape)
                 if replace:
                     win.staging[key] = row.copy()  # own it: buffer is reused
-                else:
-                    win.staging[key] += row
-                win.versions[key] += puts + accs
-                if _store.associated_p_enabled:
-                    if replace:
+                    win.versions[key] += puts + accs
+                    if _store.associated_p_enabled:
                         win.p_staging[key] = p_mass
+                else:
+                    keep, action = _staleness_factor(name, key, trace)
+                    if action is None:
+                        win.staging[key] += row
+                        win.versions[key] += puts + accs
+                        if _store.associated_p_enabled:
+                            win.p_staging[key] += p_mass
                     else:
-                        win.p_staging[key] += p_mass
+                        # Bounded staleness (async mode): admitted
+                        # fraction in, the complement held in the
+                        # stale-residual store (which always copies —
+                        # `row` is a view into the reused drain buffer).
+                        if keep:
+                            win.staging[key] += row * win.dtype.type(keep)
+                            win.versions[key] += puts + accs
+                            if _store.associated_p_enabled:
+                                win.p_staging[key] += keep * p_mass
+                        _divert_stale(win, key, row, p_mass, keep)
+                        stale_noted.append((src, action))
                 if trace is not None:
                     noted.append((src, trace))
+    _note_stale(name, stale_noted)
     for src, tag in noted:  # outside win.lock: telemetry is not state
         _note_trace_commit(name, src, tag)
 
@@ -1249,9 +1556,16 @@ def _apply_data_run(name: str, group) -> None:
             continue
         scaled = row * win.dtype.type(weight)  # fresh array: view not kept
         key = (dst, src)
-        if accumulate and entries and entries[-1][1] == key:
+        if accumulate and entries and entries[-1][1] == key \
+                and (not _async.armed or not entries[-1][0]):
             # Fold into the previous same-slot entry (put or accumulate):
             # the slot would have received both anyway, in this order.
+            # Async mode refuses to fold an accumulate into a PUT-headed
+            # entry: puts bypass the staleness policy (overwrite
+            # semantics), so the fold would smuggle the accumulate's
+            # mass past it — each accumulate gets its own decision
+            # instead.  Accumulate-into-accumulate folds stay (one wire
+            # frame = one arrival burst; the last tag governs the run).
             entries[-1][2] += scaled
             entries[-1][3] += p_weight
             entries[-1][4] += 1
@@ -1262,6 +1576,7 @@ def _apply_data_run(name: str, group) -> None:
     # -- commit under one lock hold ----------------------------------------
     from bluefog_tpu.utils.timeline import op_span
     noted = []
+    stale_noted = []
     with op_span(f"win_apply_batch.{name}", "COMMUNICATE"):
         with win.lock:
             for replace, key, scaled, p_mass, ticks, tag in entries:
@@ -1269,16 +1584,31 @@ def _apply_data_run(name: str, group) -> None:
                     continue
                 if replace:
                     win.staging[key] = scaled
-                else:
-                    win.staging[key] += scaled
-                win.versions[key] += ticks
-                if _store.associated_p_enabled:
-                    if replace:
+                    win.versions[key] += ticks
+                    if _store.associated_p_enabled:
                         win.p_staging[key] = p_mass
+                else:
+                    keep, action = _staleness_factor(name, key, tag)
+                    if action is None:
+                        win.staging[key] += scaled
+                        win.versions[key] += ticks
+                        if _store.associated_p_enabled:
+                            win.p_staging[key] += p_mass
                     else:
-                        win.p_staging[key] += p_mass
+                        # Bounded staleness (async mode): admitted
+                        # fraction in, the complement held in the
+                        # stale-residual store (mass conserved).
+                        if keep:
+                            win.staging[key] += \
+                                scaled * win.dtype.type(keep)
+                            win.versions[key] += ticks
+                            if _store.associated_p_enabled:
+                                win.p_staging[key] += keep * p_mass
+                        _divert_stale(win, key, scaled, p_mass, keep)
+                        stale_noted.append((key[1], action))
                 if tag is not None:
                     noted.append((key[1], tag))
+    _note_stale(name, stale_noted)
     for src, tag in noted:  # outside win.lock: telemetry is not state
         _note_trace_commit(name, src, tag)
 
@@ -2251,6 +2581,15 @@ def win_state_dict(name: str) -> Dict[str, object]:
                        for r in win.owned},
             "p_staging": {f"{d}:{s}": np.float64(v)
                           for (d, s), v in win.p_staging.items()},
+            # Async-mode stale-residual store: mass the bounded-staleness
+            # policy held back and has not yet folded — without it a
+            # checkpoint taken mid-async-epoch would silently lose
+            # conserved push-sum mass.  Empty outside async mode.
+            "stale_residual": {f"{d}:{s}": a.copy()
+                               for (d, s), a in win.stale_residual.items()},
+            "p_stale_residual": {
+                f"{d}:{s}": np.float64(v)
+                for (d, s), v in win.p_stale_residual.items()},
         }
 
 
@@ -2300,6 +2639,18 @@ def win_load_state_dict(name: str, state: Dict[str, object]) -> None:
             win.p_main[int(r)] = float(v)
         for k, v in dict(state["p_staging"]).items():
             win.p_staging[tuple(int(x) for x in k.split(":"))] = float(v)
+        # Optional (snapshots predating async mode lack them): restore
+        # the stale-residual store for edges the window still has.
+        win.stale_residual.clear()
+        win.p_stale_residual.clear()
+        for k, v in dict(state.get("stale_residual", {})).items():
+            key = tuple(int(x) for x in k.split(":"))
+            if key in win.staging:
+                win.stale_residual[key] = np.asarray(v).copy()
+        for k, v in dict(state.get("p_stale_residual", {})).items():
+            key = tuple(int(x) for x in k.split(":"))
+            if key in win.staging:
+                win.p_stale_residual[key] = float(v)
 
 
 def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:

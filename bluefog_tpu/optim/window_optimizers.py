@@ -26,6 +26,19 @@ instead of one per (leaf, edge).  This mirrors the collective family's
 buffer (``tensor_queue.h:70-92``); ``fuse=False`` keeps per-leaf windows (the
 reference's per-parameter layout, ``torch/optimizers.py:933-944``).
 
+Async mode (``BLUEFOG_TPU_ASYNC=1``, default off): barrier-free gossip —
+the push-sum family drops its per-cadence transport fence entirely, each
+rank accumulates at its own pace and every step folds only what has
+arrived (associated-P corrects for in-flight mass, so the effective
+operator still averages); the window layer's bounded-staleness policy
+(``BLUEFOG_TPU_ASYNC_STALENESS_STEPS`` / ``_STALENESS_POLICY``) rejects
+or downweights contributions older than the bound, diverting their mass
+into a per-edge stale-residual store; and every
+``BLUEFOG_TPU_ASYNC_COLLECT_EVERY`` steps one exact collect (fence +
+residual fold) backstops the drift.  The put family steps as if
+``overlap=True``; the pull family keeps its request/reply shape.  With
+``=0`` nothing here changes — the lockstep path is bitwise identical.
+
 Churn: with ``BLUEFOG_TPU_CHURN=1`` and a live gang transport, every
 ``step()`` drives the churn supervisor (``run/supervisor.maybe_supervisor``)
 at the step boundary — failure detection, survivor re-planning and
@@ -214,6 +227,12 @@ class _WindowOptimizerBase:
         basics._require_init()
         self._n = basics.size()
         self._owned = W._owned_ranks(self._n)
+        # Barrier-free async mode (BLUEFOG_TPU_ASYNC): arm the window
+        # layer's bounded-staleness fold and this family's fence-free
+        # stepping.  Off (default): one config check, the flag stays
+        # False and every path below is bit-identical to the lockstep
+        # tree.
+        self._async_on = W.configure_async()
         leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
         rows = leaves[0].shape[0]
         if any(x.shape[0] != rows for x in leaves):
@@ -321,6 +340,33 @@ class _WindowOptimizerBase:
                 f"{type(self).__name__}.step: this rank was evicted by "
                 f"membership consensus (epoch {view.epoch}); exit the "
                 "training loop — the survivors have re-planned without it")
+
+    _async_on = False
+
+    def _async_step_begin(self, t: int) -> None:
+        """Async-mode step bookkeeping: publish my step clock (staleness
+        ages count against it; both trace-tag encoders stamp it as the
+        wire origin step) and the ``bf_async_step_lag{rank}`` gauge — my
+        step vs the freshest peer step seen through sampled tags.
+        No-op outside async mode."""
+        if not self._async_on:
+            return
+        W.set_async_step(t)
+        from bluefog_tpu.utils import telemetry
+        telemetry.set_gauge("bf_async_step_lag", float(W.async_step_lag()),
+                            rank=str(basics.rank()))
+
+    def _async_collect_due(self, t: int) -> bool:
+        """True when this async step is the periodic exact-collect
+        backstop (``BLUEFOG_TPU_ASYNC_COLLECT_EVERY``): fence the
+        transport, fold the stale residuals back in, collect exactly —
+        bounding both the parameter drift and the step lag a straggler
+        can accumulate (fast ranks wait here, and only here)."""
+        if not self._async_on or W._store.distrib is None:
+            return False
+        from bluefog_tpu.utils import config as _config
+        every = _config.get().async_collect_every
+        return every > 0 and (t + 1) % every == 0
 
     @staticmethod
     def _step_timer():
@@ -483,6 +529,7 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
              dst_weights=None, require_mutex: bool = True):
         t0 = self._step_timer()
         self._maybe_churn_step(int(state.step))
+        self._async_step_begin(int(state.step))
         new_params, base_state = self._local_adapt(params, grads, state)
         t = int(state.step)
         if (t + 1) % self.num_steps_per_communication == 0:
@@ -495,7 +542,13 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
                                       dst_weights=dst_weights,
                                       require_mutex=require_mutex)
                 for name, payload in zip(self._names, payloads)]
-            if self.overlap:
+            # Async mode implies overlap: the put must not block the
+            # step on a slow peer's wire — the next step's win_update
+            # combines whatever has arrived (the put family's natural
+            # barrier-free operating mode; the staleness policy and the
+            # residual store are push-sum/accumulate concepts and do not
+            # apply to overwrite puts).
+            if self.overlap or self._async_on:
                 # Overlapped puts flush themselves when their worker-pool
                 # job finishes; kick the transport NOW (non-blocking — the
                 # per-peer senders flush on their own threads) so gossip
@@ -545,6 +598,10 @@ class DistributedPullGetOptimizer(_WindowOptimizerBase):
              src_weights=None, require_mutex: bool = True):
         t0 = self._step_timer()
         self._maybe_churn_step(int(state.step))
+        # Pull-style steps stay request/reply (a get cannot fold "whatever
+        # arrived" — it asks NOW), but the step clock + lag gauge still
+        # publish so a pull gang's telemetry shows who runs ahead.
+        self._async_step_begin(int(state.step))
         new_params, base_state = self._local_adapt(params, grads, state)
         t = int(state.step)
         if (t + 1) % self.num_steps_per_communication == 0:
@@ -620,25 +677,39 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
              dst_weights=None, require_mutex: bool = True):
         t0 = self._step_timer()
         self._maybe_churn_step(int(state.step))
+        self._async_step_begin(int(state.step))
         new_params, base_state = self._local_adapt(params, grads, state)
         if dst_weights is None:
             dst_weights = self._outgoing_weights()
         self_share = self._self_share()
         t = int(state.step)
-        # Flow control: every ``auto_collect_rounds`` communication rounds
-        # the step fences the transport before folding — no process can run
-        # more than that many rounds ahead of a stalled peer (the fence is a
-        # barrier), so the fraction of a rank's P mass that can ever be in
-        # flight is bounded and de-bias stays well-conditioned WITHOUT
-        # caller-side periodic collect().  The reference gets the analogous
-        # bound for free from MPI's passive-target progress/ordering
-        # (``mpi_controller.cc:953-1034``); a TCP transport must make it
-        # explicit.  The fence is collective — every process calls step the
-        # same number of times (the SPMD training loop), so the fences line
-        # up.  auto_collect_rounds=0 disables.
-        fence_now = (self.auto_collect_rounds > 0
+        # Flow control, lockstep mode: every ``auto_collect_rounds``
+        # communication rounds the step fences the transport before
+        # folding — no process can run more than that many rounds ahead of
+        # a stalled peer (the fence is a barrier), so the fraction of a
+        # rank's P mass that can ever be in flight is bounded and de-bias
+        # stays well-conditioned WITHOUT caller-side periodic collect().
+        # The reference gets the analogous bound for free from MPI's
+        # passive-target progress/ordering (``mpi_controller.cc:953-1034``);
+        # a TCP transport must make it explicit.  The fence is collective —
+        # every process calls step the same number of times (the SPMD
+        # training loop), so the fences line up.  auto_collect_rounds=0
+        # disables.
+        #
+        # Async mode (BLUEFOG_TPU_ASYNC=1) replaces this coupling
+        # entirely: NO per-cadence fence — ranks accumulate at their own
+        # pace, the fold takes whatever has arrived (push-sum associated-P
+        # corrects for in-flight mass), the bounded-staleness policy
+        # rejects/downweights over-age contributions into the stale-
+        # residual store, and the only barrier left is the periodic exact
+        # collect (``BLUEFOG_TPU_ASYNC_COLLECT_EVERY``) that folds those
+        # residuals back in — a straggler costs its contributions'
+        # freshness, not the fleet's throughput.
+        fence_now = (not self._async_on
+                     and self.auto_collect_rounds > 0
                      and W._store.distrib is not None
                      and (t + 1) % self.auto_collect_rounds == 0)
+        backstop_now = self._async_collect_due(t)
         handles = []
         payloads = self._payloads(new_params)
         for name, payload in zip(self._names, payloads):
@@ -651,8 +722,15 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
                 dst_weights=dst_weights, require_mutex=require_mutex))
         for h in handles:
             W.win_wait(h)
-        if fence_now:
+        if fence_now or backstop_now:
             W.win_fence()
+            if backstop_now:
+                # Post-fence nothing is in flight: folding the stale
+                # residuals here and collecting restores EXACT push-sum
+                # conservation, including every contribution the
+                # staleness policy held back since the last backstop.
+                for name in self._names:
+                    W.win_fold_stale_residuals(name)
         collected = [W.win_update_then_collect(name,
                                                require_mutex=require_mutex)
                      for name in self._names]
@@ -677,6 +755,12 @@ class DistributedPushSumOptimizer(_WindowOptimizerBase):
         gathered P sums to ``n`` and the P-weighted average equals the true
         network average."""
         W.win_fence()
+        # Async mode: the bounded-staleness policy may be holding
+        # rejected/downweighted mass in the stale-residual store — fold
+        # it back in post-fence so THIS collect is exact too (no-op with
+        # empty stores, i.e. always outside async mode).
+        for name in self._names:
+            W.win_fold_stale_residuals(name)
         collected = [W.win_update_then_collect(name,
                                                require_mutex=require_mutex)
                      for name in self._names]

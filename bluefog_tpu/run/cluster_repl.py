@@ -100,6 +100,21 @@ def bfstat_text() -> str:
             f"{a.get('stalest_sec', 0):.3f}s"
             for s, a in sorted(ages.items(), key=lambda kv: int(kv[0])))
         lines.append(f"[bfstat] contribution age: {parts}")
+    a = health.get("async")
+    if a:
+        # Barrier-free async mode: my step clock vs the freshest peer,
+        # the staleness policy in force, and how much mass it has held
+        # back — the line an operator reads to see whether a straggler
+        # is being absorbed (stale counters ticking) or the fleet is
+        # actually coupled (lag pinned near 0 by the backstop).
+        rej = sum(a.get("stale_rejected", {}).values())
+        dwn = sum(a.get("stale_downweighted", {}).values())
+        lines.append(
+            f"[bfstat] async: step {a['step']}, lag {a['step_lag']}, "
+            f"bound {a['staleness_steps']} steps ({a['policy']}), "
+            f"collect every {a['collect_every']}"
+            + (f"; stale rejected {rej:g}" if rej else "")
+            + (f", downweighted {dwn:g}" if dwn else ""))
     straggler = health.get("straggler")
     if straggler:
         slow = straggler["slowest_rank"]

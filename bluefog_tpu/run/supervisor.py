@@ -209,8 +209,11 @@ class ChurnSupervisor:
                 self._d.transport.drop_peer(*addr)
         # Gauge hygiene (the orphan-series class drop_peer already clears
         # for bf_win_tx_queue_depth): a dead peer's per-edge contribution
-        # -age gauges must not linger as live staleness claims.
+        # -age gauges must not linger as live staleness claims — nor may
+        # its async step/age estimates keep inflating bf_async_step_lag
+        # or its per-src stale-rejection counters survive it.
         self._W.clear_contribution_age(dead_ranks)
+        self._W.clear_async_staleness(dead_ranks)
         W = self._W
         snaps: Dict[str, dict] = {}
         for name in W.get_current_created_window_names():

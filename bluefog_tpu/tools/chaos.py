@@ -3,6 +3,28 @@
     python -m bluefog_tpu.tools chaos [--np 4] [--steps 360] \
         [--kill-rank 3] [--kill-step 40] [--smoke]
 
+Delay scenario (``--delay-smoke`` / ``--delay``): the same gang with a
+``delay:`` fault instead of a kill, run TWICE — synchronous gossip (a
+step barrier every step: the lockstep coupling the window family had
+before async mode) and barrier-free async gossip (``BLUEFOG_TPU_ASYNC=1``
+push-sum accumulates, bounded-staleness fold, exact-collect backstop).
+Asserts the tentpole's operational claim end to end:
+
+  * sync mode DEGRADES toward the slowest rank: the survivors' step time
+    during the fault rises to the delayed rank's cadence;
+  * async mode holds survivor step throughput at the no-fault baseline
+    (bounded ratio) — a straggler costs its contributions' freshness,
+    not the fleet's throughput;
+  * the delayed rank is NOT evicted when it is merely slow, even with
+    ``BLUEFOG_TPU_CHURN_STRAGGLER_STEPS`` armed (the staleness policy,
+    not membership, absorbs it — the widened async step-lag bound);
+  * both modes reach the same consensus optimum (matched final loss):
+    push-sum mass conservation holds through rejection + the backstop.
+
+The step barrier rides the jax coordinator's KV store (pure gRPC), like
+the exit barrier — no jax collective is ever issued across processes, so
+the harness runs on stock CPU containers.
+
 Launches a CPU multi-process gang under ``bfrun --chaos`` running a small
 decentralized-optimization workload over the one-sided window path (each
 rank descends toward its own target and neighbor-averages through
@@ -222,6 +244,298 @@ def worker_main(args) -> int:
     os._exit(0)
 
 
+def _parse_results(stdout: str) -> dict:
+    """Collect every CHAOS_RESULT record from the gang's multiplexed
+    stdout.  bfrun interleaves the processes' output: several records can
+    land on ONE physical line (no newline in between) and a record can
+    carry trailing bytes from another stream — split on the tag itself
+    and raw_decode exactly one JSON object per fragment."""
+    results = {}
+    for line in stdout.splitlines():
+        parts = line.split(_RESULT_TAG)
+        for frag in parts[1:]:
+            try:
+                rec, _end = json.JSONDecoder().raw_decode(frag)
+            except json.JSONDecodeError:
+                continue  # torn record (process died mid-write)
+            results[rec["rank"]] = rec
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Delay-scenario worker (sync vs async gossip under a straggler fault)
+# ---------------------------------------------------------------------------
+
+def _kv_barrier(tag: str, my_proc: int, n: int,
+                timeout_ms: int = 180_000) -> None:
+    """All-process rendezvous over the coordinator's KV store (pure gRPC
+    — the chaos gangs never issue a jax collective).  ``tag`` must be
+    unique per barrier instance (KV keys are write-once)."""
+    from jax._src import distributed as _dist
+    client = _dist.global_state.client
+    client.key_value_set(f"bf/sbar/{tag}/{my_proc}", "1")
+    for p in range(n):
+        if p != my_proc:
+            client.blocking_key_value_get(f"bf/sbar/{tag}/{p}", timeout_ms)
+
+
+def delay_worker_main(args) -> int:
+    """One rank of the delay-scenario gang: scalar push-sum consensus
+    over ``win_accumulate`` / ``win_update_then_collect`` (owned layout,
+    associated-P on), descending toward this rank's own target — the
+    network optimum is the mean of the targets, so the final de-biased
+    value is the matched-loss oracle both modes must reach.
+
+    ``--mode sync``: a KV step barrier EVERY step — lockstep gossip, the
+    whole gang steps at the slowest rank's cadence.  ``--mode async``:
+    no per-step barrier; the only rendezvous is the exact-collect
+    backstop every ``BLUEFOG_TPU_ASYNC_COLLECT_EVERY`` steps (flush +
+    barrier + stale-residual fold), mirroring the optimizer family's
+    backstop."""
+    os.environ.setdefault("BLUEFOG_TPU_TELEMETRY", "1")
+    _init_rendezvous()
+    import jax
+    import numpy as np
+
+    import bluefog_tpu as bf
+    from bluefog_tpu.ops import window as W
+    from bluefog_tpu.run.supervisor import ChurnSupervisor
+    from bluefog_tpu.utils import config
+    config.reload()
+    bf.init()
+    W.init_transport()      # arms BLUEFOG_TPU_ASYNC from config
+    me = bf.rank()
+    n = bf.size()
+    nproc = jax.process_count()
+    my_proc = jax.process_index()
+    W.turn_on_win_ops_with_associated_p()
+    target = float(me)
+    x = np.zeros(args.dim, np.float32) + target
+    name = "delay_x"
+    W.win_create(np.zeros((1, args.dim), np.float32), name, zero_init=True)
+    # Seed the window's exposed memory with my starting value (P = 1).
+    win = W._store.get(name)
+    with win.lock:
+        win.main[me][:] = x
+    sup = ChurnSupervisor()
+    outs = sorted(bf.out_neighbor_ranks(me))
+    share = 1.0 / (len(outs) + 1.0)
+    dst_w = {o: share for o in outs}
+    every = config.get().async_collect_every if args.mode == "async" else 0
+
+    def settle(tag):
+        """Flush + rendezvous + drain-settle + residual fold: the chaos
+        gang's stand-in for win_fence (whose trailing barrier is a jax
+        collective these gangs cannot issue)."""
+        W.win_flush()
+        _kv_barrier(tag, my_proc, nproc)
+        time.sleep(0.05)    # peers' blocking sends are on TCP; let the
+        _kv_barrier(tag + "b", my_proc, nproc)  # drain threads apply
+        W.win_fold_stale_residuals(name)
+
+    from bluefog_tpu.utils import telemetry
+    times = []
+    view = None
+    for step in range(args.steps):
+        t0 = time.perf_counter()
+        change = sup.step(step)
+        if change is not None:
+            view = change
+            if change.evicted:
+                break
+        if args.mode == "async":
+            # Publish the step clock (what the optimizer family's
+            # _async_step_begin does): trace tags carry it as the origin
+            # step, so receivers age this rank's gossip exactly.
+            W.set_async_step(step)
+            telemetry.set_gauge("bf_async_step_lag",
+                                float(W.async_step_lag()), rank=str(me))
+        # Subgradient-push: descend the numerator at the de-biased point,
+        # then one column-stochastic accumulate round + collect.
+        p = max(W.win_associated_p(name, me), 1e-3)
+        z = x / p
+        x = x - args.lr * (z - target) * p
+        W.win_accumulate(x[None], name, self_weight=share,
+                         dst_weights=dst_w)
+        if args.mode == "sync":
+            _kv_barrier(f"s{step}", my_proc, nproc)
+        elif every and (step + 1) % every == 0:
+            settle(f"c{step}")
+        x = np.asarray(W.win_update_then_collect(name))[0]
+        times.append(time.perf_counter() - t0)
+        if args.pace_ms:
+            time.sleep(args.pace_ms / 1e3)
+
+    evicted = bool(view is not None and view.evicted)
+    info = sup.info()
+    if not evicted:
+        # Final exact collect: after the settle nothing is in flight and
+        # every policy-held residual is folded, so the de-biased value is
+        # the exact conserved estimate (the matched-loss oracle).
+        settle("final")
+        x = np.asarray(W.win_update_then_collect(name))[0]
+    z = x / max(W.win_associated_p(name, me), 1e-3)
+    snap = telemetry.snapshot()
+    stale = {k: v for k, v in snap.items()
+             if k.startswith("bf_win_stale_")}
+    lo, hi = args.fault_step, args.fault_step + args.fault_steps
+    pre = times[max(2, lo - 40):lo]
+    fault = times[lo:hi]
+    print(_RESULT_TAG + json.dumps({
+        "rank": me,
+        "proc": my_proc,
+        "mode": args.mode,
+        "epoch": info["epoch"],
+        "changes_total": info["changes_total"],
+        "active_ranks": info["active_ranks"],
+        "evicted": evicted,
+        "steps": len(times),
+        "z_mean": float(z.mean()),
+        "pre_median_ms": round(_median_ms(pre), 3),
+        "fault_median_ms": round(_median_ms(fault), 3),
+        "stale_counters": stale,
+        "async_step_lag": snap.get(f'bf_async_step_lag{{rank="{me}"}}'),
+    }), flush=True)
+    active_procs = set() if evicted else set(range(nproc))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    _done_barrier(active_procs, my_proc, args.grace)
+    os._exit(0)
+
+
+def run_delay_demo(args) -> int:
+    """Launch the delay gang twice — sync then async — and judge the
+    tentpole's operational claims (see the worker docstring)."""
+    n = args.np
+    delay_rank = (n - 1) if args.delay_rank is None else args.delay_rank
+    if delay_rank == 0:
+        raise SystemExit("chaos: rank 0 hosts the rendezvous coordinator; "
+                         "delay any other rank")
+    spec = (f"delay:rank={delay_rank}:step={args.fault_step}"
+            f":steps={args.fault_steps}:ms={args.delay_ms}")
+    target_mean = sum(range(n)) / n
+
+    def leg(mode):
+        cmd = [sys.executable, "-m", "bluefog_tpu.run", "-np", str(n),
+               "--devices-per-proc", "1", "--chaos", spec, "--",
+               sys.executable, "-m", "bluefog_tpu.tools", "chaos",
+               "--worker", "--mode", mode,
+               "--steps", str(args.steps), "--dim", str(args.dim),
+               "--lr", str(args.lr), "--pace-ms", str(args.pace_ms),
+               "--grace", str(args.grace),
+               "--fault-step", str(args.fault_step),
+               "--fault-steps", str(args.fault_steps)]
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "BLUEFOG_TPU_CHURN": "1",
+            "BLUEFOG_TPU_CHURN_HEARTBEAT_MS": "80",
+            "BLUEFOG_TPU_CHURN_SUSPECT_MS": "800",
+            "BLUEFOG_TPU_TELEMETRY": "1",
+            # Step-lag eviction ARMED: the async leg must prove a
+            # merely-slow rank survives it (the widened bound).
+            "BLUEFOG_TPU_CHURN_STRAGGLER_STEPS": "10",
+            "BLUEFOG_TPU_TRACE_SAMPLE": "2",
+        })
+        if mode == "async":
+            env.update({
+                "BLUEFOG_TPU_ASYNC": "1",
+                "BLUEFOG_TPU_ASYNC_STALENESS_STEPS": "8",
+                "BLUEFOG_TPU_ASYNC_STALENESS_POLICY": "reject",
+                "BLUEFOG_TPU_ASYNC_COLLECT_EVERY":
+                    str(args.collect_every),
+            })
+        else:
+            env.pop("BLUEFOG_TPU_ASYNC", None)
+        print(f"chaos delay: launching {n}-process {mode} gang, {spec} "
+              f"({args.steps} steps)...", flush=True)
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=args.timeout)
+        return proc, _parse_results(proc.stdout)
+
+    failures = []
+    t0 = time.perf_counter()
+    legs = {mode: leg(mode) for mode in ("sync", "async")}
+    wall = time.perf_counter() - t0
+    floor = args.pace_ms + 2.0
+    survivor_ratio = {}
+    for mode, (proc, results) in legs.items():
+        if proc.returncode != 0:
+            _fail(failures, f"{mode}: bfrun exited {proc.returncode}")
+            print(f"\n{mode} gang stderr tail:\n"
+                  + "\n".join(proc.stderr.splitlines()[-30:]),
+                  file=sys.stderr)
+            continue
+        if sorted(results) != list(range(n)):
+            _fail(failures, f"{mode}: expected reports from all {n} ranks,"
+                            f" got {sorted(results)}")
+            continue
+        ratios = []
+        for rank, r in sorted(results.items()):
+            line = (f"  [{mode}] rank {rank}: step ms pre/fault "
+                    f"{r['pre_median_ms']:.2f}/{r['fault_median_ms']:.2f},"
+                    f" z_mean {r['z_mean']:.4f} (target {target_mean:.4f})"
+                    f", epoch {r['epoch']}"
+                    + (f", lag {r['async_step_lag']}"
+                       if r.get("async_step_lag") is not None else ""))
+            print(line, flush=True)
+            # Matched final loss: both modes reach the consensus optimum.
+            if abs(r["z_mean"] - target_mean) > args.loss_tol:
+                _fail(failures,
+                      f"{mode} rank {rank}: consensus {r['z_mean']:.4f} "
+                      f"is {abs(r['z_mean'] - target_mean):.4f} from the "
+                      f"optimum {target_mean:.4f} (tol {args.loss_tol})")
+            # The merely-slow rank must never be voted out — in EITHER
+            # mode (async proves the widened step-lag bound).
+            if r["evicted"] or r["epoch"] != 0 or r["changes_total"] != 0:
+                _fail(failures,
+                      f"{mode} rank {rank}: membership changed (epoch "
+                      f"{r['epoch']}, changes {r['changes_total']}, "
+                      f"evicted {r['evicted']}) — a merely-slow rank was "
+                      "treated as churn")
+            if rank != delay_rank:
+                pre = max(r["pre_median_ms"], floor)
+                ratios.append(max(r["fault_median_ms"], floor) / pre)
+        if ratios:
+            survivor_ratio[mode] = max(ratios)
+    if "sync" in survivor_ratio and "async" in survivor_ratio:
+        sr, ar = survivor_ratio["sync"], survivor_ratio["async"]
+        print(f"chaos delay: survivor fault/pre step-time ratio — "
+              f"sync {sr:.2f}x vs async {ar:.2f}x "
+              f"(delay {args.delay_ms}ms, pace {args.pace_ms}ms)",
+              flush=True)
+        # Sync gossip degrades toward the slowest rank's cadence...
+        if sr < args.sync_degrade:
+            _fail(failures,
+                  f"sync survivors did not degrade (ratio {sr:.2f}x < "
+                  f"{args.sync_degrade}x) — the lockstep leg is not "
+                  "measuring the coupling")
+        # ...while async survivors hold the no-fault baseline.
+        if ar > args.async_ratio:
+            _fail(failures,
+                  f"async survivors degraded {ar:.2f}x > bound "
+                  f"{args.async_ratio}x — barrier-free gossip is not "
+                  "holding throughput under the straggler")
+        async_results = legs["async"][1]
+        if not any(r.get("stale_counters")
+                   for r in async_results.values()):
+            _fail(failures,
+                  "async leg never exercised the staleness policy (no "
+                  "bf_win_stale_* counters ticked) — the bound/delay "
+                  "parameters are not producing stale contributions")
+    if failures:
+        print("\nchaos delay FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"chaos delay OK: rank {delay_rank} delayed "
+          f"{args.delay_ms}ms x {args.fault_steps} steps — sync degraded "
+          f"{survivor_ratio['sync']:.2f}x, async held "
+          f"{survivor_ratio['async']:.2f}x, no eviction, matched loss "
+          f"(wall {wall:.1f}s)", flush=True)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
@@ -292,17 +606,7 @@ def run_demo(args) -> int:
     proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
                           timeout=args.timeout)
     wall = time.perf_counter() - t0
-    results = {}
-    for line in proc.stdout.splitlines():
-        if line.startswith(_RESULT_TAG):
-            # bfrun multiplexes the gang's stdout; another process's line
-            # can land on the same physical line without a newline in
-            # between.  The record is one JSON object — parse exactly it
-            # and ignore any interleaved trailing bytes (observed flaky
-            # in CI as "Extra data" JSONDecodeError).
-            rec, _end = json.JSONDecoder().raw_decode(
-                line[len(_RESULT_TAG):])
-            results[rec["rank"]] = rec
+    results = _parse_results(proc.stdout)
 
     failures = []
     if proc.returncode != 0:
@@ -407,6 +711,34 @@ def main(argv=None) -> int:
     p.add_argument("--worker", action="store_true",
                    help="internal: run as one gang rank (launched by the "
                         "driver through bfrun)")
+    p.add_argument("--mode", default=None, choices=["sync", "async"],
+                   help="internal (with --worker): delay-scenario gossip "
+                        "mode — sync steps behind a per-step barrier, "
+                        "async is barrier-free push-sum")
+    p.add_argument("--delay", action="store_true",
+                   help="run the delay scenario (sync + async legs) "
+                        "instead of the kill scenario")
+    p.add_argument("--delay-smoke", action="store_true",
+                   help="CI smoke profile of the delay scenario")
+    p.add_argument("--delay-rank", type=int, default=None,
+                   help="rank the delay fault targets (default: the "
+                        "last one)")
+    p.add_argument("--delay-ms", type=float, default=60.0,
+                   help="per-step sleep the fault injects (default 60)")
+    p.add_argument("--fault-step", type=int, default=60,
+                   help="first delayed step (past warm-up)")
+    p.add_argument("--fault-steps", type=int, default=25,
+                   help="how many consecutive steps stay delayed")
+    p.add_argument("--collect-every", type=int, default=50,
+                   help="async leg's exact-collect backstop cadence")
+    p.add_argument("--sync-degrade", type=float, default=3.0,
+                   help="sync survivors' fault/pre step-time ratio must "
+                        "EXCEED this (proof the lockstep leg couples)")
+    p.add_argument("--async-ratio", type=float, default=1.5,
+                   help="async survivors' fault/pre step-time ratio must "
+                        "stay UNDER this (the ~10%% claim, widened for "
+                        "shared-CI noise; the tight bound belongs to the "
+                        "quiet multi-host rig)")
     p.add_argument("--np", type=int, default=4,
                    help="gang size (default 4)")
     p.add_argument("--steps", type=int, default=360,
@@ -441,7 +773,15 @@ def main(argv=None) -> int:
                    help="CI smoke profile (same assertions, smaller run)")
     args = p.parse_args(argv)
     if args.worker:
+        if args.mode is not None:
+            return delay_worker_main(args)
         return worker_main(args)
+    if args.delay or args.delay_smoke:
+        if args.delay_smoke:
+            args.steps = min(args.steps, 160)
+            args.dim = min(args.dim, 32)
+            args.pace_ms = min(args.pace_ms, 3.0)
+        return run_delay_demo(args)
     if args.smoke:
         args.steps = min(args.steps, 300)
         args.dim = min(args.dim, 64)

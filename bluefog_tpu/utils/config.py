@@ -26,7 +26,11 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_DECODE_THREADS | auto | drain-side decode pool size (native path); 0 = inline single-thread decode; auto sizes from the host core count |
 | BLUEFOG_TPU_WIN_RETRIES       | 1     | transient-send retries before ConnectionError (0=none) |
 | BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS | 50 | base of the jittered exponential retry backoff |
-| BLUEFOG_TPU_TRACE_SAMPLE      | 0     | wire trace-tag sampling: "1/N" (or plain "N") tags every Nth put/accumulate with a (src, seq, origin-time) trailer; 0/unset = off, wire bitwise identical |
+| BLUEFOG_TPU_TRACE_SAMPLE      | 0     | wire trace-tag sampling: "1/N" (or plain "N") tags every Nth put/accumulate with a (src, seq, origin-time, origin-step) trailer; 0/unset = off, wire bitwise identical |
+| BLUEFOG_TPU_ASYNC             | 0     | 1: barrier-free async window-optimizer mode — no per-step transport fence, fold whatever has arrived, bounded-staleness policy; 0 = bitwise legacy lockstep |
+| BLUEFOG_TPU_ASYNC_STALENESS_STEPS | 0 | staleness bound k (origin steps): contributions older than k steps at commit hit the staleness policy; 0 = unbounded (accept everything) |
+| BLUEFOG_TPU_ASYNC_STALENESS_POLICY | reject | what happens to an over-bound contribution: reject (full mass to the stale-residual store) or downweight:<alpha> (alpha enters staging, 1-alpha to the store) |
+| BLUEFOG_TPU_ASYNC_COLLECT_EVERY | 64  | drift backstop: every N async steps the optimizer fences the transport, folds the stale residuals back in and performs an exact collect; 0 = never |
 | BLUEFOG_TPU_FLIGHT_RECORDER   | 0     | 1: record transport events (enqueue/flush/sendmsg/drain/decode/fold/commit) into the native in-memory ring, dumped to flightrec.<rank>.bin on fatal transport error / eviction / bf.flight_recorder_dump() |
 | BLUEFOG_TPU_FLIGHT_RECORDER_EVENTS | 65536 | flight-recorder ring capacity (events; oldest overwritten) |
 | BLUEFOG_TPU_FLIGHT_RECORDER_PATH | flightrec | dump path prefix (files are <prefix>.<rank>.bin) |
@@ -76,7 +80,8 @@ from dataclasses import dataclass
 from typing import Optional
 
 __all__ = ["Config", "get", "reload", "COMPRESSION_VOCAB",
-           "parse_sparse_frac", "compression_byte_factor"]
+           "parse_sparse_frac", "compression_byte_factor",
+           "parse_staleness_policy"]
 
 
 # The one wire-compression vocabulary (window transport + hierarchical
@@ -127,6 +132,41 @@ def _validated_compression(value: str, var: str =
         f"{var}={value!r} is not supported; expected one of "
         f"{', '.join(COMPRESSION_VOCAB)} (a typo here would otherwise "
         "silently disable compression)")
+
+
+def parse_staleness_policy(value: str):
+    """Parse ``BLUEFOG_TPU_ASYNC_STALENESS_POLICY`` into ``(kind, alpha)``:
+    ``("reject", 0.0)`` or ``("downweight", alpha)`` with alpha in (0, 1).
+    A typo fails loudly — a silently-misread policy would either drop
+    fresh gossip or admit arbitrarily stale mass."""
+    if value == "reject":
+        return ("reject", 0.0)
+    if value.startswith("downweight"):
+        if ":" not in value:
+            raise ValueError(
+                f"malformed {value!r}: use 'downweight:<alpha>' "
+                "(e.g. 'downweight:0.25')")
+        try:
+            alpha = float(value.split(":", 1)[1])
+        except ValueError:
+            raise ValueError(
+                f"malformed {value!r}: the alpha must be a float in "
+                "(0, 1), e.g. 'downweight:0.25'") from None
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(
+                f"downweight alpha must be in (0, 1), got {alpha} "
+                "(1.0 would be a no-op — raise "
+                "BLUEFOG_TPU_ASYNC_STALENESS_STEPS instead; 0.0 is "
+                "'reject')")
+        return ("downweight", alpha)
+    raise ValueError(
+        f"BLUEFOG_TPU_ASYNC_STALENESS_POLICY={value!r} is not supported; "
+        "expected 'reject' or 'downweight:<alpha>'")
+
+
+def _validated_staleness_policy(value: str) -> str:
+    parse_staleness_policy(value)  # raises on malformed input
+    return value
 
 
 def _validated_sketch(value: str) -> str:
@@ -257,6 +297,28 @@ class Config:
     # mutation — the wire is bitwise identical to the pre-trace
     # transport.
     trace_sample: int
+    # Barrier-free asynchronous window gossip (optim/window_optimizers.py
+    # + ops/window.py): ranks issue win_accumulate puts at their own
+    # cadence with NO per-step transport fence; each step folds only what
+    # has arrived, push-sum associated-P weights correct for in-flight
+    # mass, and contributions older than async_staleness_steps (origin
+    # steps, from the wire trace tags; wall-clock fallback when a message
+    # is unsampled) are rejected or downweighted per
+    # async_staleness_policy with the diverted mass held in a per-edge
+    # stale-residual store (folded back in at the periodic exact
+    # collect, so push-sum mass conservation holds).  OFF by default:
+    # with async_mode=0 nothing anywhere changes — the lockstep path is
+    # bitwise identical to the pre-async tree.
+    async_mode: bool
+    async_staleness_steps: int
+    async_staleness_policy: str
+    # Every N async steps the optimizer fences the transport, folds the
+    # stale residuals back into staging and performs an exact collect —
+    # the drift backstop bounding both parameter drift and the step lag
+    # a straggler can accumulate (the membership controller widens its
+    # straggler threshold by exactly this much).  0 = no backstop (lag
+    # is unbounded by design; step-lag eviction disables itself).
+    async_collect_every: int
     # Native transport flight recorder (winsvc.cc bf_rec_*): a fixed-size
     # in-memory ring of enqueue/flush/sendmsg/drain/decode/fold/commit
     # events keyed (window, peer, stripe, seq), ~tens of ns per event,
@@ -383,6 +445,14 @@ class Config:
                 "BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS", "50")),
             trace_sample=_parse_trace_sample(
                 os.environ.get("BLUEFOG_TPU_TRACE_SAMPLE")),
+            async_mode=_flag("BLUEFOG_TPU_ASYNC"),
+            async_staleness_steps=int(os.environ.get(
+                "BLUEFOG_TPU_ASYNC_STALENESS_STEPS", "0")),
+            async_staleness_policy=_validated_staleness_policy(
+                os.environ.get("BLUEFOG_TPU_ASYNC_STALENESS_POLICY",
+                               "reject").lower()),
+            async_collect_every=int(os.environ.get(
+                "BLUEFOG_TPU_ASYNC_COLLECT_EVERY", "64")),
             flight_recorder=_flag("BLUEFOG_TPU_FLIGHT_RECORDER"),
             flight_recorder_events=int(os.environ.get(
                 "BLUEFOG_TPU_FLIGHT_RECORDER_EVENTS", "65536")),

@@ -130,6 +130,18 @@ def set_gauge(name: str, value: float, **labels) -> None:
         _registry.gauges[key] = float(value)
 
 
+def clear_counter(name: str, **labels) -> None:
+    """Drop one counter series — the same churn-hygiene escape hatch as
+    :func:`clear_gauge`, for per-peer counters whose label names a rank
+    that no longer exists (a dead rank's series is not "still counting",
+    it is an orphan claim about a peer the gang evicted).  Runs even when
+    telemetry is disabled, like :func:`clear_gauge` — a stale key must go
+    regardless."""
+    key = _key(name, labels)
+    with _registry.lock:
+        _registry.counters.pop(key, None)
+
+
 def clear_gauge(name: str, **labels) -> None:
     """Drop a gauge series, if present — for gauges describing a subsystem
     that has been deactivated, where a stale last value would misreport
@@ -523,6 +535,26 @@ def health() -> dict:
                   if k[0] == "bf_win_host_copy_bytes_total" and k[1]}
     if copies:
         body["win_host_copy_bytes"] = copies
+    # Barrier-free async gossip (BLUEFOG_TPU_ASYNC): my step clock, the
+    # freshest-seen peer step lag, the staleness bound/policy in force
+    # and the per-src reject/downweight tallies.  Absent entirely when
+    # the async mode is not armed — no block, no key, nothing.
+    try:
+        from bluefog_tpu.ops import window as _window
+        async_block = _window.async_info()
+    except Exception:  # noqa: BLE001 — health must render regardless
+        async_block = None
+    if async_block is not None:
+        with _registry.lock:
+            rej = {k[1][0][1]: v for k, v in _registry.counters.items()
+                   if k[0] == "bf_win_stale_rejected_total" and k[1]}
+            dwn = {k[1][0][1]: v for k, v in _registry.counters.items()
+                   if k[0] == "bf_win_stale_downweighted_total" and k[1]}
+        if rej:
+            async_block["stale_rejected"] = rej
+        if dwn:
+            async_block["stale_downweighted"] = dwn
+        body["async"] = async_block
     # Churn-controller membership (ops/membership.py): which ranks are in
     # the gang, the committed epoch, and any live suspicion.  Absent
     # entirely when BLUEFOG_TPU_CHURN is off — no block, no key, nothing.

@@ -1,0 +1,526 @@
+"""Barrier-free asynchronous window gossip (BLUEFOG_TPU_ASYNC) — the
+bounded-staleness fold, stale-residual mass conservation, the fence-free
+optimizer step and its exact-collect backstop.
+
+Covers the tentpole's contract surface:
+  * knob parsing (`BLUEFOG_TPU_ASYNC_STALENESS_POLICY` validation);
+  * fake-clock staleness-policy unit tests across all three commit paths
+    (per-message, batched, native-folded entries): exact age-in-steps
+    from tagged messages, wall-clock fallback for step-less tags,
+    edge-estimate inheritance for unsampled messages;
+  * mass conservation under random reject/downweight sequences —
+    staging + stale residual == input mass at every point, restored
+    EXACTLY into staging by win_fold_stale_residuals;
+  * the equivalence oracle: ASYNC=1 with staleness bound infinity and a
+    collect cadence matching the legacy fence cadence is BITWISE
+    identical to the legacy lockstep path; ASYNC=0 is untouched;
+  * churn soundness: the membership controller's step-lag eviction
+    threshold widens by the collect-backstop cadence in async mode and
+    disables itself without a backstop;
+  * telemetry: per-src stale counters, the /healthz "async" block, the
+    bf_async_step_lag gauge, churn hygiene (clear_async_staleness), and
+    the BLUEFOG_TPU_TELEMETRY=0 zero-mutation guard;
+  * checkpoint: the stale-residual store survives a
+    win_state_dict/win_load_state_dict round trip.
+"""
+
+import threading
+import types
+
+import numpy as np
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as topo
+from bluefog_tpu.ops import transport as T
+from bluefog_tpu.ops import window as W
+from bluefog_tpu.utils import config, telemetry
+
+
+@pytest.fixture
+def env(monkeypatch):
+    """Set knobs + reload config; restores (and reloads + disarms the
+    async mode) afterwards."""
+    def set_env(**kv):
+        for k, v in kv.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, str(v))
+        config.reload()
+    yield set_env
+    config.reload()
+    W.configure_async()        # ASYNC unset again -> disarmed, state clear
+    W.clear_async_staleness()
+    T.set_trace_origin_step(-1)
+    telemetry.reset()
+
+
+def _tag(src, seq=1, step=-1, unix_us=None):
+    """A synthetic 5-tuple wire trace tag (what trace_strip returns)."""
+    import time
+    if unix_us is None:
+        unix_us = time.time_ns() // 1000
+    return (src, seq, 0, unix_us, step)
+
+
+def _mk_window(name="async_w", n=8, dim=5):
+    """A ring window with every rank owned (single process) plus a fake
+    multi-process directory, so `_apply_inbound` treats messages as
+    transport-applied contributions (the path the policy guards)."""
+    bf.init(lambda: topo.RingGraph(n))
+    rows = np.zeros((n, dim), np.float32)
+    assert bf.win_create(rows, name, zero_init=True)
+    saved = W._store.distrib
+    W._store.distrib = W._Distrib(
+        types.SimpleNamespace(), rank_owner={r: 0 for r in range(n)},
+        proc_addr={0: ("127.0.0.1", 1)}, my_proc=0)
+    return name, saved
+
+
+def _teardown(name, saved):
+    W._store.distrib = saved
+    bf.win_free(name)
+
+
+# ---------------------------------------------------------------------------
+# Knob parsing
+# ---------------------------------------------------------------------------
+
+def test_staleness_policy_parse():
+    assert config.parse_staleness_policy("reject") == ("reject", 0.0)
+    assert config.parse_staleness_policy("downweight:0.25") == \
+        ("downweight", 0.25)
+    for bad in ("downweight", "downweight:x", "downweight:0",
+                "downweight:1.0", "downweight:1.5", "keep", ""):
+        with pytest.raises(ValueError):
+            config.parse_staleness_policy(bad)
+
+
+def test_async_knob_defaults(env):
+    env(BLUEFOG_TPU_ASYNC=None, BLUEFOG_TPU_ASYNC_STALENESS_STEPS=None,
+        BLUEFOG_TPU_ASYNC_STALENESS_POLICY=None,
+        BLUEFOG_TPU_ASYNC_COLLECT_EVERY=None)
+    cfg = config.get()
+    assert not cfg.async_mode
+    assert cfg.async_staleness_steps == 0
+    assert cfg.async_staleness_policy == "reject"
+    assert cfg.async_collect_every == 64
+    assert not W.configure_async()
+    assert W.async_info() is None
+
+
+# ---------------------------------------------------------------------------
+# Fake-clock staleness policy (all three commit paths)
+# ---------------------------------------------------------------------------
+
+def test_policy_reject_per_message(env):
+    """A tagged contribution older than the bound is diverted whole into
+    the stale-residual store; a fresh one takes the exact legacy path."""
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_STALENESS_STEPS="3")
+    name, saved = _mk_window()
+    try:
+        W.configure_async()
+        W.set_async_step(10)
+        win = W._store.get(name)
+        fresh = np.arange(5, dtype=np.float32) + 1
+        stale = np.full(5, 8.0, np.float32)
+        # Fresh: origin step 9, age 1 <= 3.
+        W._apply_inbound(
+            T.OP_ACCUMULATE | T.OP_TRACE_FLAG, name, 1, 0, 1.0, 0.0,
+            fresh.tobytes() + T.TRACE_TRAILER.pack(1, 1, 0, 1, 9))
+        # Stale: origin step 2, age 8 > 3 -> rejected.
+        W._apply_inbound(
+            T.OP_ACCUMULATE | T.OP_TRACE_FLAG, name, 1, 0, 1.0, 0.0,
+            stale.tobytes() + T.TRACE_TRAILER.pack(1, 2, 0, 1, 2))
+        np.testing.assert_array_equal(win.staging[(0, 1)], fresh)
+        np.testing.assert_array_equal(win.stale_residual[(0, 1)], stale)
+        snap = telemetry.snapshot()
+        assert snap.get('bf_win_stale_rejected_total{src="1"}') == 1
+        # The freshest-seen peer step fed the lag estimate.
+        assert W._async.peer_step[1] == 9
+        assert W.async_step_lag() == 9 - 10
+    finally:
+        _teardown(name, saved)
+
+
+def test_policy_downweight_and_wallclock_fallback(env):
+    """downweight:<alpha> admits alpha and diverts the complement; a tag
+    WITHOUT an origin step falls back to wall-clock age through the
+    step-period EWMA."""
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_STALENESS_STEPS="2",
+        BLUEFOG_TPU_ASYNC_STALENESS_POLICY="downweight:0.5")
+    name, saved = _mk_window()
+    try:
+        W.configure_async()
+        W.set_async_step(100)
+        with W._async.lock:
+            W._async.step_period = 0.010   # fake clock: 10 ms per step
+        row = np.full(5, 4.0, np.float32)
+        import time
+        old_us = time.time_ns() // 1000 - 50_000   # 50 ms = 5 steps > 2
+        W._apply_inbound(
+            T.OP_ACCUMULATE | T.OP_TRACE_FLAG, "async_w", 1, 0, 1.0, 0.0,
+            row.tobytes() + T.TRACE_TRAILER.pack(1, 1, 0, old_us, -1))
+        win = W._store.get(name)
+        np.testing.assert_array_equal(win.staging[(0, 1)], row * 0.5)
+        np.testing.assert_array_equal(win.stale_residual[(0, 1)], row * 0.5)
+        snap = telemetry.snapshot()
+        assert snap.get('bf_win_stale_downweighted_total{src="1"}') == 1
+    finally:
+        _teardown(name, saved)
+
+
+def test_unsampled_inherits_edge_estimate(env):
+    """An untagged contribution on an edge whose last SAMPLED message was
+    stale inherits that estimate (staleness is a sender property); on a
+    never-sampled edge it is optimistically fresh."""
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_STALENESS_STEPS="3")
+    name, saved = _mk_window()
+    try:
+        W.configure_async()
+        W.set_async_step(20)
+        win = W._store.get(name)
+        row = np.ones(5, np.float32)
+        # Never-sampled edge (2 -> 1): untagged is admitted.
+        W._apply_inbound(T.OP_ACCUMULATE, name, 2, 1, 1.0, 0.0,
+                         row.tobytes())
+        np.testing.assert_array_equal(win.staging[(1, 2)], row)
+        # Edge 7 -> 0: one stale sample (age 15), then an untagged
+        # message — it inherits the stale estimate and is rejected too.
+        W._apply_inbound(
+            T.OP_ACCUMULATE | T.OP_TRACE_FLAG, name, 7, 0, 1.0, 0.0,
+            row.tobytes() + T.TRACE_TRAILER.pack(7, 1, 0, 1, 5))
+        W._apply_inbound(T.OP_ACCUMULATE, name, 7, 0, 1.0, 0.0,
+                         (row * 7).tobytes())
+        np.testing.assert_array_equal(win.staging[(0, 7)],
+                                      np.zeros(5, np.float32))
+        np.testing.assert_array_equal(win.stale_residual[(0, 7)],
+                                      row + row * 7)
+    finally:
+        _teardown(name, saved)
+
+
+def test_policy_applies_on_batched_and_native_paths(env):
+    """The batched-run and native-folded commit paths enforce the same
+    policy as the per-message path."""
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_STALENESS_STEPS="3")
+    name, saved = _mk_window()
+    try:
+        W.configure_async()
+        W.set_async_step(50)
+        win = W._store.get(name)
+        row = np.full(5, 2.0, np.float32)
+        stale_tagged = row.tobytes() + T.TRACE_TRAILER.pack(1, 1, 0, 1, 10)
+        # Batched path: one fresh put run + one stale accumulate.
+        W._apply_inbound_batch([
+            (T.OP_PUT, name, 1, 0, 1.0, 0.0, row.tobytes()),
+            (T.OP_ACCUMULATE | T.OP_TRACE_FLAG, name, 1, 0, 1.0, 0.0,
+             stale_tagged),
+        ])
+        np.testing.assert_array_equal(win.staging[(0, 1)], row)  # put only
+        np.testing.assert_array_equal(win.stale_residual[(0, 1)], row)
+        # Native-folded path: a non-replace entry with a stale trace.
+        W._commit_native_run(name, [
+            (name, False, 2, 1, 0.0, 0, 1, row * 3, row.nbytes,
+             (2, 5, 0, 1, 40)),
+        ])
+        np.testing.assert_array_equal(win.staging[(1, 2)],
+                                      np.zeros(5, np.float32))
+        np.testing.assert_array_equal(win.stale_residual[(1, 2)], row * 3)
+        snap = telemetry.snapshot()
+        assert snap.get('bf_win_stale_rejected_total{src="1"}') == 1
+        assert snap.get('bf_win_stale_rejected_total{src="2"}') == 1
+    finally:
+        _teardown(name, saved)
+
+
+def test_async_off_is_inert(env):
+    """ASYNC=0 (default): arbitrarily old tags are admitted untouched —
+    the policy machinery never engages (the bitwise-legacy guarantee)."""
+    env(BLUEFOG_TPU_ASYNC=None, BLUEFOG_TPU_ASYNC_STALENESS_STEPS="1")
+    name, saved = _mk_window()
+    try:
+        W.configure_async()
+        win = W._store.get(name)
+        row = np.full(5, 3.0, np.float32)
+        W._apply_inbound(
+            T.OP_ACCUMULATE | T.OP_TRACE_FLAG, name, 1, 0, 1.0, 0.0,
+            row.tobytes() + T.TRACE_TRAILER.pack(1, 1, 0, 1, 0))
+        np.testing.assert_array_equal(win.staging[(0, 1)], row)
+        assert not win.stale_residual
+        assert not [k for k in telemetry.snapshot()
+                    if k.startswith("bf_win_stale")]
+    finally:
+        _teardown(name, saved)
+
+
+# ---------------------------------------------------------------------------
+# Mass conservation (the tested push-sum invariant)
+# ---------------------------------------------------------------------------
+
+def test_mass_conservation_random_policy_sequence(env):
+    """Under a random mix of fresh/rejected/downweighted accumulates,
+    staging + stale residual == total input mass at every point (value
+    AND associated-P), and win_fold_stale_residuals restores everything
+    into staging exactly."""
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_STALENESS_STEPS="5",
+        BLUEFOG_TPU_ASYNC_STALENESS_POLICY="downweight:0.5")
+    name, saved = _mk_window(dim=4)
+    W.turn_on_win_ops_with_associated_p()
+    try:
+        W.configure_async()
+        W.set_async_step(1000)
+        win = W._store.get(name)
+        rng = np.random.RandomState(17)
+        key = (0, 1)
+        total = np.zeros(4, np.float64)
+        p_total = 0.0
+        for i in range(40):
+            # Powers of two keep alpha=0.5 splits and the running sums
+            # exact in f32/f64 — the invariant is tested BITWISE.
+            row = (2.0 ** rng.randint(-2, 3, size=4)).astype(np.float32)
+            age = int(rng.randint(0, 12))       # mix: fresh and stale
+            p_w = float(2.0 ** rng.randint(-3, 2))
+            W._apply_inbound(
+                T.OP_ACCUMULATE | T.OP_TRACE_FLAG, name, 1, 0, 1.0, p_w,
+                row.tobytes() + T.TRACE_TRAILER.pack(1, i + 1, 0, 1,
+                                                     1000 - age))
+            total += row
+            p_total += p_w
+            with win.lock:
+                have = win.staging[key].astype(np.float64) + \
+                    win.stale_residual.get(
+                        key, np.zeros(4, np.float32)).astype(np.float64)
+                p_have = win.p_staging[key] + \
+                    win.p_stale_residual.get(key, 0.0)
+            np.testing.assert_array_equal(have, total)
+            assert p_have == p_total
+        assert win.stale_residual, "sequence never triggered the policy"
+        folded = W.win_fold_stale_residuals(name)
+        assert folded == 1
+        np.testing.assert_array_equal(
+            win.staging[key].astype(np.float64), total)
+        assert win.p_staging[key] == p_total
+        assert not win.stale_residual and not win.p_stale_residual
+    finally:
+        W.turn_off_win_ops_with_associated_p()
+        _teardown(name, saved)
+
+
+def test_stale_residual_survives_state_dict_roundtrip(env):
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_STALENESS_STEPS="1")
+    name, saved = _mk_window()
+    try:
+        W.configure_async()
+        W.set_async_step(10)
+        row = np.full(5, 6.0, np.float32)
+        W._apply_inbound(
+            T.OP_ACCUMULATE | T.OP_TRACE_FLAG, name, 1, 0, 1.0, 0.0,
+            row.tobytes() + T.TRACE_TRAILER.pack(1, 1, 0, 1, 0))
+        snap = W.win_state_dict(name)
+        assert "0:1" in snap["stale_residual"]
+        win = W._store.get(name)
+        with win.lock:
+            win.stale_residual.clear()
+            win.p_stale_residual.clear()
+        W.win_load_state_dict(name, snap)
+        np.testing.assert_array_equal(win.stale_residual[(0, 1)], row)
+        # Snapshots predating async mode restore cleanly too.
+        legacy = {k: v for k, v in snap.items()
+                  if k not in ("stale_residual", "p_stale_residual")}
+        W.win_load_state_dict(name, legacy)
+        assert not win.stale_residual
+    finally:
+        _teardown(name, saved)
+
+
+# ---------------------------------------------------------------------------
+# Equivalence oracle: ASYNC=1 @ bound infinity == legacy, bitwise
+# ---------------------------------------------------------------------------
+
+def _run_pushsum(steps=8, auto_collect_rounds=2):
+    bf.init(lambda: topo.RingGraph(8, connect_style=1))
+    opt = bf.optim.DistributedPushSumOptimizer(
+        optax.sgd(0.05), auto_collect_rounds=auto_collect_rounds)
+    params = {"w": np.random.RandomState(3).randn(8, 6).astype(np.float32)}
+    state = opt.init(params)
+    traj = []
+    for _ in range(steps):
+        grads = {"w": np.asarray(params["w"]) * np.float32(0.1)}
+        params, state = opt.step(params, grads, state)
+        traj.append(np.asarray(params["w"]).copy())
+    out = np.asarray(opt.debias(params)["w"]).copy()
+    opt.free()
+    return traj, out
+
+
+def test_equivalence_oracle_bitwise(env):
+    """ASYNC=1 with staleness bound infinity (0) and a collect cadence
+    equal to the legacy fence cadence is BITWISE identical to the legacy
+    lockstep path, and ASYNC=0 reproduces itself exactly."""
+    env(BLUEFOG_TPU_ASYNC=None)
+    legacy_traj, legacy_out = _run_pushsum()
+    legacy2_traj, _ = _run_pushsum()
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_STALENESS_STEPS="0",
+        BLUEFOG_TPU_ASYNC_COLLECT_EVERY="2")
+    async_traj, async_out = _run_pushsum(auto_collect_rounds=2)
+    for i, (a, b, c) in enumerate(zip(legacy_traj, legacy2_traj,
+                                      async_traj)):
+        np.testing.assert_array_equal(a, b, err_msg=f"legacy step {i}")
+        np.testing.assert_array_equal(a, c, err_msg=f"async step {i}")
+    np.testing.assert_array_equal(legacy_out, async_out)
+
+
+def test_winput_async_implies_overlap(env):
+    """ASYNC=1 makes the put family step without waiting on its puts
+    (the overlap path), and convergence survives."""
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_COLLECT_EVERY="0")
+    bf.init(lambda: topo.ExponentialGraph(8))
+    opt = bf.optim.DistributedWinPutOptimizer(optax.sgd(0.2))
+    assert not opt.overlap
+    params = {"w": np.random.RandomState(5).randn(8, 4).astype(np.float32)}
+    state = opt.init(params)
+    targets = np.arange(8, dtype=np.float32)[:, None]
+    for _ in range(60):
+        grads = {"w": np.asarray(params["w"]) - targets}
+        params, state = opt.step(params, grads, state)
+    # The overlap path engaged: the last step's puts are still pending
+    # (a non-async, non-overlap optimizer always waits them out).
+    assert opt._pending
+    w = np.asarray(params["w"])
+    opt.free()
+    spread = np.abs(w - w.mean(axis=0, keepdims=True)).max()
+    assert spread < 1.0, f"async win-put failed to mix: spread {spread}"
+
+
+# ---------------------------------------------------------------------------
+# Churn soundness: legitimate run-ahead must not read as straggling
+# ---------------------------------------------------------------------------
+
+def _controller(env_set, **cfg_env):
+    from bluefog_tpu.ops.membership import MembershipController
+    env_set(BLUEFOG_TPU_CHURN="1", **cfg_env)
+    return MembershipController(
+        n_procs=3, my_proc=0, rank_owner={0: 0, 1: 1, 2: 2},
+        send_fn=lambda p, b: None, probe_fn=lambda p: True)
+
+
+def test_straggler_threshold_widens_in_async_mode(env):
+    sync = _controller(env, BLUEFOG_TPU_CHURN_STRAGGLER_STEPS="10",
+                       BLUEFOG_TPU_ASYNC=None)
+    assert sync._straggler_bound() == 10
+    wide = _controller(env, BLUEFOG_TPU_CHURN_STRAGGLER_STEPS="10",
+                       BLUEFOG_TPU_ASYNC="1",
+                       BLUEFOG_TPU_ASYNC_COLLECT_EVERY="40")
+    assert wide._straggler_bound() == 50
+    off = _controller(env, BLUEFOG_TPU_CHURN_STRAGGLER_STEPS="10",
+                      BLUEFOG_TPU_ASYNC="1",
+                      BLUEFOG_TPU_ASYNC_COLLECT_EVERY="0")
+    assert off._straggler_bound() == 0
+    none = _controller(env, BLUEFOG_TPU_CHURN_STRAGGLER_STEPS="0",
+                       BLUEFOG_TPU_ASYNC="1")
+    assert none._straggler_bound() == 0
+
+
+def test_async_lag_within_backstop_not_suspected(env):
+    """A peer lagging more than CHURN_STRAGGLER_STEPS but less than the
+    widened async bound stays un-suspected; beyond the widened bound the
+    eviction policy still fires."""
+    c = _controller(env, BLUEFOG_TPU_CHURN_STRAGGLER_STEPS="10",
+                    BLUEFOG_TPU_ASYNC="1",
+                    BLUEFOG_TPU_ASYNC_COLLECT_EVERY="40")
+    now = c.now_fn()
+    c.last_seen = {1: now, 2: now}
+    c.note_step(100)
+    c.peer_step = {1: 70, 2: 30}    # lag 30 (legit) and 70 (over bound)
+    suspects = c._suspects(now)
+    assert 1 not in suspects and 2 in suspects
+
+
+# ---------------------------------------------------------------------------
+# Telemetry surfaces + hygiene
+# ---------------------------------------------------------------------------
+
+def test_healthz_async_block_and_hygiene(env):
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_STALENESS_STEPS="3",
+        BLUEFOG_TPU_ASYNC_COLLECT_EVERY="16")
+    name, saved = _mk_window()
+    try:
+        W.configure_async()
+        W.set_async_step(7)
+        row = np.ones(5, np.float32)
+        W._apply_inbound(
+            T.OP_ACCUMULATE | T.OP_TRACE_FLAG, name, 1, 0, 1.0, 0.0,
+            row.tobytes() + T.TRACE_TRAILER.pack(1, 1, 0, 1, 1))
+        body = telemetry.health()
+        a = body.get("async")
+        assert a and a["step"] == 7 and a["staleness_steps"] == 3
+        assert a["collect_every"] == 16
+        assert a["step_lag"] == 1 - 7
+        assert a["stale_rejected"] == {"1": 1.0}
+        from bluefog_tpu.run.cluster_repl import bfstat_text
+        assert "[bfstat] async: step 7" in bfstat_text()
+        # Churn hygiene: a committed membership change drops the dead
+        # rank's estimates + counters.
+        W.clear_async_staleness([1])
+        assert 1 not in W._async.peer_step
+        assert not [k for k in telemetry.snapshot()
+                    if k.startswith("bf_win_stale")]
+        assert telemetry.health()["async"]["step_lag"] == 0
+    finally:
+        _teardown(name, saved)
+
+
+def test_telemetry_off_zero_mutation(env):
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_ASYNC_STALENESS_STEPS="1",
+        BLUEFOG_TPU_TELEMETRY="0")
+    name, saved = _mk_window()
+    try:
+        W.configure_async()
+        W.set_async_step(10)
+        row = np.ones(5, np.float32)
+        W._apply_inbound(
+            T.OP_ACCUMULATE | T.OP_TRACE_FLAG, name, 1, 0, 1.0, 0.0,
+            row.tobytes() + T.TRACE_TRAILER.pack(1, 1, 0, 1, 0))
+        win = W._store.get(name)
+        # The POLICY still applies (it is state, not telemetry)...
+        np.testing.assert_array_equal(win.stale_residual[(0, 1)], row)
+        # ...but the registry is untouched.
+        assert telemetry.snapshot() == {}
+    finally:
+        _teardown(name, saved)
+
+
+def test_step_clock_reaches_wire_tags(env):
+    """set_async_step publishes the origin-step both encoders stamp: the
+    Python trailer carries it, and a loopback store commit feeds it back
+    into the freshest-peer estimate."""
+    env(BLUEFOG_TPU_ASYNC="1", BLUEFOG_TPU_TRACE_SAMPLE="1")
+    W.configure_async()
+    W.set_async_step(123)
+    tag = T.make_trace_tag(0)
+    assert T.TRACE_TRAILER.unpack(tag)[4] == 123
+    from bluefog_tpu import native
+    if native.available() and native.has_win_native():
+        assert native.lib().bf_trace_step() == 123
+
+
+# ---------------------------------------------------------------------------
+# Full gang (slow tier; `make chaos-smoke` runs the same harness in CI):
+# the multi-process CPU convergence test — a real bfrun gang under an
+# injected delay fault, sync vs async legs, matched final loss, no
+# eviction of the merely-slow rank, async survivor throughput held.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_delay_scenario_end_to_end():
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.tools", "chaos",
+         "--delay-smoke"],
+        capture_output=True, text=True, timeout=400)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "chaos delay OK" in r.stdout

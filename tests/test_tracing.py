@@ -78,8 +78,9 @@ def test_trailer_roundtrip_and_sampling(trace_env):
     body = b"\x01\x02\x03\x04"
     stripped, tag = T.trace_strip(body + hits[0])
     assert bytes(stripped) == body
-    src, seq, mono, unix = tag
+    src, seq, mono, unix, step = tag
     assert src == 7 and seq == 1 and mono > 0 and unix > mono  # unix >> mono
+    assert step == -1  # no step clock published in this test
     # Sequences are unique and monotonic across samples.
     seqs = [T.TRACE_TRAILER.unpack(t)[1] for t in hits]
     assert seqs == [1, 2, 3]
