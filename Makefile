@@ -165,9 +165,18 @@ ffi-smoke:
 # time, the merely-slow rank is NOT evicted even with step-lag eviction
 # armed (the widened async bound), and both modes reach the same
 # consensus optimum (matched final loss through rejection + backstop).
+# The JOIN leg (elastic scale-up, ops/gang.py) runs a coordinator-free
+# `bfrun --elastic` gang, kills rank 2 mid-training, admits a fresh
+# `bfrun --join` process through the persisted endpoint directory and
+# asserts exactly one committed grow epoch + convergence to the
+# FULL-gang optimum; the KILL-RANK-0 leg kills rank 0 instead — the
+# gang must survive (membership/bootstrap never touch a coordinator)
+# and admit a replacement for rank 0 the same way.
 chaos-smoke:
 	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --smoke
 	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --delay-smoke
+	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --join-smoke
+	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --kill0-smoke
 
 # Full interactive chaos demo (same harness, bigger run; see
 # `python -m bluefog_tpu.tools chaos --help` for kill/delay/partition

@@ -45,6 +45,7 @@ from bluefog_tpu.basics import (  # noqa: F401
     placement_info,
     synthesis_info,
     membership_info,
+    gang_info,
     load_topology,
     load_machine_topology,
     in_neighbor_ranks,
@@ -146,6 +147,10 @@ from bluefog_tpu.utils.telemetry import telemetry_snapshot  # noqa: F401
 # in-memory event ring to flightrec.<rank>.bin — the gossip black box
 # `python -m bluefog_tpu.tools trace-gossip` merges across ranks.
 from bluefog_tpu.utils.flightrec import dump as flight_recorder_dump  # noqa: F401,E501
+# Elastic scale-up / coordinator-free bootstrap (BLUEFOG_TPU_ELASTIC_JOIN):
+# bf.gang.init_elastic() / bf.gang.join_gang() — see docs/operations.md
+# "Growing the gang".
+from bluefog_tpu.ops import gang  # noqa: F401
 
 from bluefog_tpu.utils import profiler  # noqa: F401
 from bluefog_tpu.utils.profiler import step_profile  # noqa: F401

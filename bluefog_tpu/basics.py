@@ -781,6 +781,16 @@ def membership_info() -> Optional[dict]:
     return membership.health_summary()
 
 
+def gang_info() -> Optional[dict]:
+    """Summary of the gang join/bootstrap directory (``ops/gang.py``) —
+    committed epoch, active processes, vacant-rank pool, grant tally
+    (None when ``BLUEFOG_TPU_ELASTIC_JOIN`` is off or no gang service is
+    installed).  Mirrors the ``/healthz`` "gang_directory" block; see
+    the "Growing the gang" runbook in ``docs/operations.md``."""
+    from bluefog_tpu.ops import gang
+    return gang.health_summary()
+
+
 def load_topology() -> nx.DiGraph:
     return _require_init().topology
 

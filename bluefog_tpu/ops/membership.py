@@ -61,18 +61,28 @@ __all__ = ["MembershipView", "MembershipController", "survivor_topology",
 
 class MembershipView:
     """One committed membership epoch: which processes (and therefore which
-    ranks) are in the gang, and what the commit removed."""
+    ranks) are in the gang, and what the commit removed or admitted."""
 
     def __init__(self, epoch: int, active_procs: Tuple[int, ...],
                  active_ranks: Tuple[int, ...],
                  removed_procs: Tuple[int, ...] = (),
                  removed_ranks: Tuple[int, ...] = (),
-                 evicted: bool = False):
+                 evicted: bool = False,
+                 added_procs: Tuple[int, ...] = (),
+                 added_ranks: Tuple[int, ...] = (),
+                 added_endpoints: Optional[Dict[int, str]] = None):
         self.epoch = epoch
         self.active_procs = tuple(sorted(active_procs))
         self.active_ranks = tuple(sorted(active_ranks))
         self.removed_procs = tuple(sorted(removed_procs))
         self.removed_ranks = tuple(sorted(removed_ranks))
+        # Elastic scale-UP (ops/gang.py): processes admitted BY this
+        # commit, the ranks they took over, and their transport
+        # endpoints ("host:port") — what the supervisor's growth
+        # recovery needs to extend the rank directory before re-planning.
+        self.added_procs = tuple(sorted(added_procs))
+        self.added_ranks = tuple(sorted(added_ranks))
+        self.added_endpoints = dict(added_endpoints or {})
         # True when THIS process is the one voted out: it must stop
         # gossiping and exit gracefully, not re-plan around itself.
         self.evicted = evicted
@@ -80,6 +90,8 @@ class MembershipView:
     def __repr__(self):
         return (f"MembershipView(epoch={self.epoch}, "
                 f"active_ranks={list(self.active_ranks)}"
+                + (f", added={list(self.added_ranks)}"
+                   if self.added_ranks else "")
                 + (", EVICTED" if self.evicted else "") + ")")
 
 
@@ -97,7 +109,9 @@ class MembershipController:
                  probe_fn: Optional[Callable[[int], bool]] = None,
                  now_fn: Callable[[], float] = time.monotonic,
                  suspect_sec: Optional[float] = None,
-                 straggler_steps: Optional[int] = None):
+                 straggler_steps: Optional[int] = None,
+                 active=None, epoch: int = 0, joining: bool = False,
+                 my_join_ranks=(), my_endpoint: Optional[str] = None):
         cfg = config.get()
         self.n_procs = n_procs
         self.my_proc = my_proc
@@ -122,16 +136,36 @@ class MembershipController:
         self._async_mode = cfg.async_mode
         self._async_collect_every = cfg.async_collect_every
         self._lock = threading.RLock()
-        self.epoch = 0
+        self.epoch = int(epoch)
         self._warned_lag_eviction_off = False
-        self.active: frozenset = frozenset(range(n_procs))
+        # `active` defaults to every process (the classic fixed-gang
+        # construction); a JOINING process seeds it from its join grant —
+        # the committed survivor set it is asking to be admitted into.
+        self.active: frozenset = (frozenset(active) if active is not None
+                                  else frozenset(range(n_procs)))
+        # Elastic scale-up state (ops/gang.py).  `joining`: this process
+        # is a granted-but-uncommitted joiner — it proposes
+        # `active | {me}` and heartbeats with its rank/endpoint claim
+        # until a commit admits it.  `pending_joins`: granted joiners
+        # heard from (proc -> (ranks, endpoint, first-heard monotonic));
+        # they enter every proposal while their heartbeats stay fresh.
+        # `joined_info`: permanent record of admitted joiners' rank/
+        # endpoint claims; `joined_at_epoch`: procs admitted by the
+        # CURRENT epoch's commit, gossiped so a behind peer can adopt a
+        # grown view it never saw the joiner's own heartbeats for.
+        self.joining = bool(joining)
+        self.my_join_ranks = tuple(int(r) for r in my_join_ranks)
+        self.my_endpoint = my_endpoint
+        self.pending_joins: Dict[int, tuple] = {}
+        self.joined_info: Dict[int, tuple] = {}
+        self.joined_at_epoch: frozenset = frozenset()
         self.evicted = False
         self.changes_total = 0
         self.last_change_unix: Optional[float] = None
         # Liveness bookkeeping.  last_seen starts at construction time so a
         # peer that NEVER heartbeats (died during init) still ages out.
         now = now_fn()
-        self.last_seen: Dict[int, float] = {p: now for p in range(n_procs)
+        self.last_seen: Dict[int, float] = {p: now for p in self.active
                                             if p != my_proc}
         self.peer_step: Dict[int, int] = {}
         self.my_step = 0
@@ -163,14 +197,48 @@ class MembershipController:
     # -- wire --------------------------------------------------------------
 
     def _payload(self, prop: Optional[frozenset]) -> bytes:
-        return json.dumps({
+        body = {
             "k": "hb",
             "proc": self.my_proc,
             "epoch": self.epoch,
             "step": self.my_step,
             "active": sorted(self.active),
             "prop": None if prop is None else sorted(prop),
-        }).encode()
+        }
+        # Join keys ride the heartbeat ONLY when a join is actually in
+        # flight or was just committed — with no joins anywhere the
+        # payload stays byte-identical to the pre-join wire (tested).
+        if self.joining:
+            body["join"] = list(self.my_join_ranks)
+            if self.my_endpoint:
+                body["ep"] = self.my_endpoint
+        if self.joined_at_epoch:
+            # Enough for a peer that never heard the joiner directly to
+            # adopt the grown view: who joined, which ranks they own, and
+            # where their transport listens.
+            body["joined"] = sorted(self.joined_at_epoch)
+            body["joined_ranks"] = {
+                str(p): list(self.joined_info[p][0])
+                for p in sorted(self.joined_at_epoch)
+                if p in self.joined_info}
+            body["joined_eps"] = {
+                str(p): self.joined_info[p][1]
+                for p in sorted(self.joined_at_epoch)
+                if p in self.joined_info and self.joined_info[p][1]}
+        return json.dumps(body).encode()
+
+    def _adopt_joined_info(self, msg: dict) -> None:
+        """Fold a heartbeat's joined-proc claims (ranks + endpoints) into
+        ``joined_info`` so an adopted grown view can extend ``rank_owner``
+        even when this process never saw the joiner's own heartbeats
+        (caller holds the lock)."""
+        ranks = msg.get("joined_ranks") or {}
+        eps = msg.get("joined_eps") or {}
+        for p_s, rr in ranks.items():
+            p = int(p_s)
+            if p not in self.joined_info:
+                self.joined_info[p] = (tuple(int(r) for r in rr),
+                                       eps.get(p_s))
 
     def on_message(self, msg: dict) -> None:
         """Apply one inbound membership message (drain-thread entry: takes
@@ -185,6 +253,10 @@ class MembershipController:
             self.last_seen[p] = now
             if "step" in msg:
                 self.peer_step[p] = int(msg["step"])
+            self._adopt_joined_info(msg)
+            if "join" in msg and p not in self.active:
+                self._note_pending_join(
+                    p, msg.get("join") or [], msg.get("ep"), now)
             their_epoch = int(msg.get("epoch", 0))
             their_active = frozenset(int(x) for x in msg.get("active", []))
             if their_epoch > self.epoch and their_active:
@@ -192,9 +264,15 @@ class MembershipController:
                 # still in flight when it crossed the threshold).  The
                 # commit rule is deterministic, so adopting its view is the
                 # same commit we were about to make — unless the view
-                # excludes us, which is the eviction verdict.
+                # excludes us, which is the eviction verdict.  A JOINING
+                # process is different: it was never a member, so a newer
+                # view without it (the gang shrank again while its
+                # admission was in flight) is not a verdict — it adopts
+                # the view as its new base and keeps proposing itself.
                 if self.my_proc in their_active:
                     self._commit(their_epoch, their_active)
+                elif self.joining:
+                    self._rebase_while_joining(their_epoch, their_active)
                 else:
                     self._evict()
                 return
@@ -202,15 +280,27 @@ class MembershipController:
                     and their_active and their_active != self.active):
                 # Same-epoch divergent views: two processes raced their
                 # commits from proposal snapshots taken at different
-                # instants.  Reconcile by INTERSECTION — monotone (views
-                # only shrink), deterministic, and both sides converge to
-                # the same set under continuous heartbeats.  Nonempty by
-                # construction: each committer's rule required agreement
-                # from every member of its view, so the two views share
-                # at least their committers.
-                merged = self.active & their_active
+                # instants.  Reconcile INCUMBENTS by INTERSECTION —
+                # monotone (a proc both sides already carried survives
+                # only in both), deterministic, both sides converge under
+                # continuous heartbeats — and JOINERS by UNION: a proc
+                # admitted at this epoch appears in a view precisely
+                # because its committer verified full agreement including
+                # the joiner, and the join announcement may simply not
+                # have reached the other committer before its snapshot.
+                # (The superset extension of the PR-7 intersection rule:
+                # with no joins the union term is empty and the rule is
+                # exactly the old one.)
+                their_joined = frozenset(
+                    int(x) for x in msg.get("joined") or [])
+                joiners = ((self.joined_at_epoch | their_joined)
+                           & (self.active | their_active))
+                merged = (self.active & their_active) | joiners
                 if self.my_proc not in merged:
-                    self._evict()
+                    if self.joining:
+                        self._rebase_while_joining(self.epoch, merged)
+                    else:
+                        self._evict()
                 elif merged and merged != self.active:
                     self._commit(self.epoch, merged)
                 return
@@ -226,6 +316,79 @@ class MembershipController:
                     # evaluated against a lingering withdrawn proposal
                     # could evict a live rank on votes already retracted.
                     self.proposals.pop(p, None)
+
+    # -- elastic scale-up (ops/gang.py) ------------------------------------
+
+    def _note_pending_join(self, proc: int, ranks, endpoint,
+                           now: float) -> None:
+        """Register a granted joiner's admission claim (lock held).  The
+        claim is validated against the live world: its ranks must be
+        VACANT (owned by no active proc) and must not collide with an
+        earlier pending claim — a colliding later claim is ignored (the
+        grantor-side reservation makes collisions a cross-grantor race,
+        and dropping the newcomer deterministically keeps every
+        controller's proposal convergent)."""
+        ranks = tuple(int(r) for r in ranks)
+        if proc in self.pending_joins:
+            # Refresh liveness only; the claim itself is immutable.
+            old = self.pending_joins[proc]
+            self.pending_joins[proc] = (old[0], endpoint or old[1], old[2])
+            return
+        active_ranks = set(self.active_ranks())
+        claimed = {r for info in self.pending_joins.values()
+                   for r in info[0]}
+        if (set(ranks) & active_ranks) or (set(ranks) & claimed) \
+                or not ranks:
+            from bluefog_tpu.utils.logging import get_logger
+            get_logger().warning(
+                "membership: join claim from proc %d for ranks %s "
+                "collides with live or already-claimed ranks — ignored",
+                proc, list(ranks))
+            return
+        self.pending_joins[proc] = (ranks, endpoint, now)
+
+    def _rebase_while_joining(self, epoch: int, active: frozenset) -> None:
+        """The gang committed past us while our admission was in flight
+        (lock held): adopt the newer survivor set as the join's new base
+        — no view is emitted (we were never a member, there is nothing to
+        recover) and the next tick proposes ``active | {me}`` again."""
+        self.epoch = int(epoch)
+        self.active = frozenset(active)
+        self.proposals.clear()
+        now = self.now_fn()
+        for p in self.active:
+            if p != self.my_proc:
+                self.last_seen.setdefault(p, now)
+        from bluefog_tpu.utils.logging import get_logger
+        get_logger().info(
+            "membership: gang committed epoch %d while this process was "
+            "still joining — rebasing the join on the new survivor set "
+            "%s", self.epoch, sorted(self.active))
+
+    def note_join(self, proc: int, ranks, endpoint: Optional[str]) -> None:
+        """Grantor-side entry: record the joiner this process just granted
+        so it enters our proposals immediately (its own heartbeats will
+        reach the rest of the gang)."""
+        with self._lock:
+            if self.evicted or proc in self.active:
+                return
+            self._note_pending_join(proc, ranks, endpoint, self.now_fn())
+
+    def peer_endpoint_hint(self, proc: int) -> Optional[tuple]:
+        """(host, port) of a proc known only through the join protocol —
+        what the supervisor's send path falls back to for peers not yet in
+        the transport directory (pending or freshly admitted joiners)."""
+        with self._lock:
+            info = self.pending_joins.get(proc) \
+                or self.joined_info.get(proc)
+        ep = info[1] if info else None
+        if not ep:
+            return None
+        try:
+            from bluefog_tpu.ops.gang import _ep_addr
+            return _ep_addr(ep)
+        except ValueError:
+            return None
 
     # -- detection + consensus tick ---------------------------------------
 
@@ -341,13 +504,26 @@ class MembershipController:
                 return
             now = self.now_fn()
             suspects = self._suspects(now, probes)
-            prop = frozenset(self.active - suspects) if suspects else None
+            # A granted joiner that died (or went silent) before its
+            # commit simply ages out of the pending set — its claim must
+            # not keep every survivor proposing a grown view forever.
+            fresh_cut = now - self.suspect_sec
+            for p in [p for p, info in self.pending_joins.items()
+                      if max(info[2], self.last_seen.get(p, 0.0))
+                      < fresh_cut]:
+                self.pending_joins.pop(p, None)
+            joins = frozenset(self.pending_joins)
+            prop = None
+            if suspects or joins or self.joining:
+                prop = frozenset((self.active - suspects) | joins
+                                 | ({self.my_proc} if self.joining
+                                    else frozenset()))
             if prop is not None:
                 self.proposals[self.my_proc] = (self.epoch, prop, now)
             else:
                 self.proposals.pop(self.my_proc, None)
             payload = self._payload(prop)
-            targets = [p for p in sorted(self.active)
+            targets = [p for p in sorted(self.active | joins)
                        if p != self.my_proc and p not in suspects]
             if prop is not None:
                 self._maybe_commit(prop)
@@ -383,10 +559,50 @@ class MembershipController:
 
     def _commit(self, epoch: int, active: frozenset) -> None:
         removed = frozenset(self.active) - active
+        added = frozenset(active) - self.active
+        now = self.now_fn()
+        added_eps: Dict[int, str] = {}
+        admission_secs = []
+        for p in sorted(added):
+            # The admitted proc's rank/endpoint claim: from its own join
+            # heartbeats (pending_joins), from a peer's gossip about an
+            # earlier commit (joined_info), or — when WE are the joiner —
+            # from the grant itself.
+            info = self.pending_joins.pop(p, None)
+            if info is not None:
+                ranks, ep, heard = info
+                admission_secs.append(max(0.0, now - heard))
+            elif p == self.my_proc:
+                ranks, ep = self.my_join_ranks, self.my_endpoint
+            elif p in self.joined_info:
+                ranks, ep = self.joined_info[p]
+            else:
+                from bluefog_tpu.utils.logging import get_logger
+                get_logger().warning(
+                    "membership: adopted a view admitting proc %d with no "
+                    "rank claim on record — its ranks stay unowned until "
+                    "its gossip arrives", p)
+                continue
+            for r in ranks:
+                self.rank_owner[r] = p
+            self.joined_info[p] = (tuple(ranks), ep)
+            if ep:
+                added_eps[p] = ep
+            self.last_seen[p] = now
+        self.joined_at_epoch = added
+        if self.my_proc in added:
+            self.joining = False
         view = MembershipView(
             epoch, tuple(active), self.active_ranks(active),
             removed_procs=tuple(removed),
-            removed_ranks=self.active_ranks(removed))
+            # After the reassignment above, so a rank revived by this
+            # very commit is never reported as removed.
+            removed_ranks=self.active_ranks(removed),
+            added_procs=tuple(added),
+            added_ranks=tuple(sorted(
+                r for p in added for r in self.joined_info.get(p, ((),))[0]
+            )),
+            added_endpoints=added_eps)
         self.epoch = epoch
         self.active = frozenset(active)
         self.proposals.clear()
@@ -394,12 +610,15 @@ class MembershipController:
         self.last_change_unix = time.time()
         self._pending.append(view)
         self._notify_removed = sorted(removed)
-        self._publish_telemetry()
+        self._publish_telemetry(n_joins=len(added),
+                                admission_secs=admission_secs)
         from bluefog_tpu.utils.logging import get_logger
         get_logger().warning(
             "membership: epoch %d committed — active ranks %s (removed "
-            "ranks %s)", epoch, list(view.active_ranks),
-            list(view.removed_ranks))
+            "ranks %s%s)", epoch, list(view.active_ranks),
+            list(view.removed_ranks),
+            f", added ranks {list(view.added_ranks)}"
+            if view.added_ranks else "")
 
     def _evict(self) -> None:
         self.evicted = True
@@ -423,7 +642,8 @@ class MembershipController:
 
     # -- telemetry ---------------------------------------------------------
 
-    def _publish_telemetry(self) -> None:
+    def _publish_telemetry(self, n_joins: int = 0,
+                           admission_secs=()) -> None:
         if current() is not self:
             # Only the process's INSTALLED controller owns the process-wide
             # gauges (hermetic tests wire several controllers in one
@@ -433,6 +653,13 @@ class MembershipController:
         telemetry.inc("bf_membership_changes_total")
         telemetry.set_gauge("bf_active_ranks", len(self.active_ranks()))
         telemetry.set_gauge("bf_membership_epoch", self.epoch)
+        if n_joins:
+            telemetry.inc("bf_membership_joins_total", float(n_joins))
+        for sec in admission_secs:
+            # First-heard join claim -> committed grow epoch, as observed
+            # by this survivor: the admission latency an operator tunes
+            # heartbeat/suspect windows against.
+            telemetry.observe("bf_join_admission_seconds", float(sec))
         if self.last_change_unix is not None:
             telemetry.set_gauge("bf_churn_last_change_timestamp",
                                 self.last_change_unix)
@@ -446,7 +673,7 @@ class MembershipController:
             now = self.now_fn()
             suspects = sorted(self._suspects(now, {})) \
                 if not self.evicted else []
-            return {
+            out = {
                 "epoch": self.epoch,
                 "active_ranks": list(self.active_ranks()),
                 "world_ranks": len(self.rank_owner),
@@ -457,6 +684,16 @@ class MembershipController:
                 "evicted": self.evicted,
                 "last_change_unix": self.last_change_unix,
             }
+            if self.pending_joins:
+                # Admission in flight: the ranks granted joiners are
+                # claiming — what /healthz shows between the grant and
+                # the committed grow epoch.
+                out["pending_join_ranks"] = sorted(
+                    r for info in self.pending_joins.values()
+                    for r in info[0])
+            if self.joining:
+                out["joining"] = True
+            return out
 
 
 # ---------------------------------------------------------------------------

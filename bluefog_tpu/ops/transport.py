@@ -94,6 +94,13 @@ OP_BATCH = 10
 # FIFO streams as gossip, so a peer whose data path is wedged cannot look
 # healthy through a side channel the data never takes.
 OP_MEMBER = 11
+# Gang join/bootstrap control plane (ops/gang.py): join requests/grants
+# and the gossip-replicated endpoint-directory anti-entropy of the
+# elastic scale-UP subsystem (BLUEFOG_TPU_ELASTIC_JOIN).  JSON payloads
+# on the same FIFO streams as gossip and membership — a joining process
+# rendezvouses with ANY live member over the data path itself, so no
+# coordinator (and no rank-0 host) is load-bearing for bootstrap.
+OP_GANG = 12
 # Flag bit ORed into the op byte when the payload is bf16-compressed (an f32
 # window row shipped as bfloat16).  An explicit wire flag — never inferred
 # from payload size — so a future partial-row or batched payload can't be
@@ -122,7 +129,7 @@ OP_FLAG_MASK = OP_BF16_FLAG | OP_SPARSE_FLAG | OP_TRACE_FLAG
 __all__ = ["WindowTransport", "OP_PUT", "OP_ACCUMULATE", "OP_GET_REQ",
            "OP_GET_REPLY", "OP_FENCE_REQ", "OP_FENCE_ACK", "OP_MUTEX_ACQ",
            "OP_MUTEX_GRANT", "OP_MUTEX_REL", "OP_BATCH", "OP_MEMBER",
-           "OP_BF16_FLAG", "OP_SPARSE_FLAG", "OP_TRACE_FLAG",
+           "OP_GANG", "OP_BF16_FLAG", "OP_SPARSE_FLAG", "OP_TRACE_FLAG",
            "OP_FLAG_MASK", "TRACE_TRAILER", "make_trace_tag",
            "trace_strip", "set_trace_origin_step", "trace_origin_step",
            "sparse_encode", "sparse_decode", "stripe_for",
@@ -133,17 +140,19 @@ _OP_NAMES = {OP_PUT: "put", OP_ACCUMULATE: "accumulate",
              OP_FENCE_REQ: "fence_req", OP_FENCE_ACK: "fence_ack",
              OP_MUTEX_ACQ: "mutex_acq", OP_MUTEX_GRANT: "mutex_grant",
              OP_MUTEX_REL: "mutex_rel", OP_BATCH: "batch",
-             OP_MEMBER: "member"}
+             OP_MEMBER: "member", OP_GANG: "gang"}
 
 # Ops whose latency is on a waiter's critical path (fence acks, mutex
 # grants, get replies): they flush the peer's queue immediately instead of
 # waiting out the linger, and — being enqueued AFTER any pending data —
 # certify that data once answered (the FIFO property win_fence needs).
 # Membership messages are urgent too: a heartbeat sitting out a linger
-# behind a slow batch would read as churn where there is none.
+# behind a slow batch would read as churn where there is none.  Gang
+# join/directory traffic likewise — a join grant waiting out a linger
+# would stretch every admission by the coalesce window for no benefit.
 _URGENT_OPS = frozenset((OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
                          OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT,
-                         OP_MUTEX_REL, OP_MEMBER))
+                         OP_MUTEX_REL, OP_MEMBER, OP_GANG))
 
 
 def _op_label(op: int) -> str:

@@ -72,7 +72,7 @@ __all__ = [
 from bluefog_tpu.ops.transport import (  # noqa: E402
     OP_PUT, OP_ACCUMULATE, OP_GET_REQ, OP_GET_REPLY, OP_FENCE_REQ,
     OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL, OP_MEMBER,
-    OP_BF16_FLAG, OP_SPARSE_FLAG, OP_TRACE_FLAG, OP_FLAG_MASK,
+    OP_GANG, OP_BF16_FLAG, OP_SPARSE_FLAG, OP_TRACE_FLAG, OP_FLAG_MASK,
     make_trace_tag, trace_strip, sparse_encode, sparse_decode)
 from bluefog_tpu.utils import flightrec  # noqa: E402
 # Zero-copy XLA put path (BLUEFOG_TPU_WIN_XLA): plan-compiled dispatch of
@@ -309,7 +309,10 @@ def _shutdown_transport() -> None:
         # No transport, no edges: per-edge staleness gauges describing a
         # dead wire must not linger as live series (churn hygiene class),
         # and the async per-peer step/age estimates describe peers that
-        # no longer exist.
+        # no longer exist.  The gang join/directory service rode this
+        # transport too — uninstall it so a later re-init starts clean.
+        from bluefog_tpu.ops import gang as _gang
+        _gang.install(None)
         clear_contribution_age()
         clear_async_staleness()
 
@@ -423,6 +426,43 @@ def _exchange_endpoints(me: str, n_procs: int, my_proc: int) -> list:
             for p in range(gathered.shape[0])]
 
 
+def make_transport(port: int = 0):
+    """One window transport wired to this store's apply callbacks but with
+    no rank directory yet — the raw listener a coordinator-free bootstrap
+    (``ops/gang.py``) builds before it knows who its peers are.  Inbound
+    data messages buffer in ``preinit_msgs`` until ``install_distrib``;
+    OP_GANG control frames are consumed immediately (a joining process
+    receives its grant here)."""
+    from bluefog_tpu.ops.transport import WindowTransport
+    return WindowTransport(_apply_inbound,
+                           apply_batch=_apply_inbound_batch,
+                           apply_items=_apply_inbound_items, port=port)
+
+
+def install_distrib(transport, rank_owner: Dict[int, int],
+                    proc_addr: Dict[int, tuple], my_proc: int) -> None:
+    """Install the multi-process rank directory over a live transport and
+    replay any messages that raced ahead of it — the shared tail of every
+    bootstrap path (coordinator KV, allgather, or the gang directory)."""
+    with _store.lock:
+        # Install the directory and replay messages that raced ahead of it
+        # under one lock hold, so the drain thread (blocked on this lock in
+        # its preinit check) cannot interleave a newer message first.
+        _store.distrib = _Distrib(transport, dict(rank_owner),
+                                  dict(proc_addr), my_proc)
+        pending, _store.preinit_msgs = _store.preinit_msgs, []
+        for msg in pending:
+            _apply_inbound(*msg)
+    # Stall warnings can now name unreachable peers (reference
+    # ``operations.cc:417-429`` lists missing ranks per stalled tensor).
+    from bluefog_tpu.utils import stall
+    stall.set_peer_probe(_probe_missing_ranks)
+    # Barrier-free async mode (BLUEFOG_TPU_ASYNC): arm the bounded-
+    # staleness fold with the transport — with the knob off this is one
+    # config check and the flag stays False (bitwise legacy paths).
+    configure_async()
+
+
 def init_transport() -> bool:
     """Start the DCN window transport and exchange the rank directory.
 
@@ -437,10 +477,7 @@ def init_transport() -> bool:
         return True
     if jax.process_count() == 1:
         return False
-    from bluefog_tpu.ops.transport import WindowTransport
-    transport = WindowTransport(_apply_inbound,
-                                apply_batch=_apply_inbound_batch,
-                                apply_items=_apply_inbound_items)
+    transport = make_transport()
     me = f"{_local_host_addr()}:{transport.port}"
     addrs = _exchange_endpoints(me, jax.process_count(),
                                 jax.process_index())
@@ -450,23 +487,7 @@ def init_transport() -> bool:
         proc_addr[p] = (host, int(port))
     rank_owner = {i: d.process_index
                   for i, d in enumerate(basics._ctx.devices)}
-    with _store.lock:
-        # Install the directory and replay messages that raced ahead of it
-        # under one lock hold, so the drain thread (blocked on this lock in
-        # its preinit check) cannot interleave a newer message first.
-        _store.distrib = _Distrib(transport, rank_owner, proc_addr,
-                                  jax.process_index())
-        pending, _store.preinit_msgs = _store.preinit_msgs, []
-        for msg in pending:
-            _apply_inbound(*msg)
-    # Stall warnings can now name unreachable peers (reference
-    # ``operations.cc:417-429`` lists missing ranks per stalled tensor).
-    from bluefog_tpu.utils import stall
-    stall.set_peer_probe(_probe_missing_ranks)
-    # Barrier-free async mode (BLUEFOG_TPU_ASYNC): arm the bounded-
-    # staleness fold with the transport — with the knob off this is one
-    # config check and the flag stays False (bitwise legacy paths).
-    configure_async()
+    install_distrib(transport, rank_owner, proc_addr, jax.process_index())
     return True
 
 
@@ -1188,6 +1209,15 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
         # cadence, so nothing is lost).
         from bluefog_tpu.ops import membership
         membership.handle_wire(payload)
+        return
+    if (op & ~OP_FLAG_MASK) == OP_GANG:
+        # Gang join/bootstrap control plane (ops/gang.py): same contract
+        # as OP_MEMBER — consumed immediately, dropped when the subsystem
+        # is not installed (BLUEFOG_TPU_ELASTIC_JOIN off).  Routed BEFORE
+        # the directory check: a joining process receives its grant on a
+        # transport that has no rank directory yet.
+        from bluefog_tpu.ops import gang
+        gang.handle_wire(payload)
         return
     orig_op = op  # parked/replayed messages must keep the wire flag bits
     compressed = bool(op & OP_BF16_FLAG)

@@ -87,9 +87,26 @@ def bfstat_text() -> str:
             f"active {member['active_ranks']}"
             + (f"; suspects {member['suspect_ranks']}"
                if member.get("suspect_ranks") else "")
+            + (f"; admitting ranks {member['pending_join_ranks']}"
+               if member.get("pending_join_ranks") else "")
+            + (" (JOINING)" if member.get("joining") else "")
             + (" (EVICTED)" if member.get("evicted") else "")
             + (f"; last change {datetime.datetime.fromtimestamp(when):%H:%M:%S}"
                if when else ""))
+    gd = health.get("gang_directory")
+    if gd:
+        # Elastic scale-up (ops/gang.py): the replicated endpoint
+        # directory this process would serve a joining replacement from.
+        lines.append(
+            f"[bfstat] gang directory: epoch {gd['epoch']}, "
+            f"{len(gd.get('active_procs', []))} procs / "
+            f"{gd.get('endpoints', 0)} endpoints"
+            + (f"; vacant ranks {gd['vacant_ranks']}"
+               if gd.get("vacant_ranks") else "")
+            + (f"; grants {gd['grants_total']}"
+               if gd.get("grants_total") else "")
+            + (f"; persisted @{gd['persist_prefix']}"
+               if gd.get("persist_prefix") else ""))
     ages = health.get("contribution_age")
     if ages:
         # Per-edge gossip staleness (wire trace tags): how old each

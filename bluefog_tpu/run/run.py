@@ -267,6 +267,44 @@ def build_parser() -> argparse.ArgumentParser:
                         "steps — pair with --timeline and `python -m "
                         "bluefog_tpu.tools trace-merge` for a merged "
                         "per-rank trace")
+    p.add_argument("--elastic", action="store_true",
+                   help="coordinator-free gang bootstrap (ops/gang.py): "
+                        "pre-assign one window-transport port per rank, "
+                        "export the complete endpoint list to every rank "
+                        "as BFTPU_GANG_PEERS, and enable "
+                        "BLUEFOG_TPU_ELASTIC_JOIN (+ BLUEFOG_TPU_CHURN) — "
+                        "membership and bootstrap ride the gossip-"
+                        "replicated endpoint directory, so no process "
+                        "(rank 0 included) is a bootstrap single point of "
+                        "failure.  The program should call "
+                        "bf.gang.init_elastic() instead of relying on the "
+                        "jax coordinator")
+    p.add_argument("--join", default=None, metavar="TARGET",
+                   help="launch ONE process that JOINS a live gang "
+                        "(requires -np 1): TARGET is any live member's "
+                        "window-transport endpoint host:port, or "
+                        "@<prefix> naming a persisted gang-directory "
+                        "prefix (BLUEFOG_TPU_GANG_DIR_PATH) whose live "
+                        "members are tried in turn.  With "
+                        "--devices-per-proc N, N is the WORLD rank count "
+                        "(the joiner sees the whole virtual mesh).  "
+                        "Exported to the child as BFTPU_GANG_JOIN; the "
+                        "program calls bf.gang.join_gang()")
+    p.add_argument("--join-want", type=int, default=None, metavar="N",
+                   help="with --join/--grow: how many vacant ranks the "
+                        "joining process claims (default 1; a replacement "
+                        "for a multi-rank process should claim its whole "
+                        "seat count).  Exported as BFTPU_GANG_JOIN_WANT")
+    p.add_argument("--grow", type=float, default=None, metavar="SECONDS",
+                   help="spawn one extra joining process SECONDS after "
+                        "launch (requires --elastic): the late process "
+                        "gets BFTPU_GANG_JOIN=@<gang-dir> and is "
+                        "supervised like any gang rank — its exit reason "
+                        "appears in the gang summary")
+    p.add_argument("--gang-dir", default=None, metavar="PREFIX",
+                   help="gang-directory persistence prefix "
+                        "(BLUEFOG_TPU_GANG_DIR_PATH); default with "
+                        "--elastic: a fresh /tmp prefix per incarnation")
     p.add_argument("--chaos", default=None, metavar="SPEC",
                    help="fault-injection spec for the gang (utils/chaos.py "
                         "grammar): comma-separated kill:rank=K:step=N / "
@@ -287,15 +325,42 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _child_env(args, coord: str, rank: int, local_rank: int = 0,
-               local_size: int = 1) -> dict:
+               local_size: int = 1, gang_peers: str = None,
+               gang_dir: str = None, join_target: str = None,
+               join_world: int = None) -> dict:
     env = dict(os.environ)
     env["BFTPU_COORDINATOR"] = coord
     env["BFTPU_NUM_PROCESSES"] = str(args.num_proc)
     env["BFTPU_PROCESS_ID"] = str(rank)
     env["BFTPU_LOCAL_ID"] = str(local_rank)
     env["BFTPU_LOCAL_SIZE"] = str(local_size)
+    elastic = gang_peers is not None or join_target is not None
     if args.devices_per_proc:
-        virtual_mesh_env(env, args.devices_per_proc)
+        if elastic:
+            # Elastic/join processes see the WHOLE virtual world (rank
+            # ownership is per-process through the gang directory, not
+            # through jax.distributed's device spanning): each founding
+            # member of a 4-rank gang forges 4 virtual devices, not 1.
+            # For a top-level --join, --devices-per-proc NAMES the world
+            # size; a --grow joiner inherits the gang's (join_world).
+            if join_target is not None:
+                n = join_world or args.devices_per_proc
+            else:
+                n = args.num_proc * args.devices_per_proc
+            virtual_mesh_env(env, n)
+        else:
+            virtual_mesh_env(env, args.devices_per_proc)
+    if elastic:
+        env.setdefault("BLUEFOG_TPU_ELASTIC_JOIN", "1")
+        env.setdefault("BLUEFOG_TPU_CHURN", "1")
+        if gang_dir:
+            env.setdefault("BLUEFOG_TPU_GANG_DIR_PATH", gang_dir)
+    if gang_peers is not None:
+        env["BFTPU_GANG_PEERS"] = gang_peers
+    if join_target is not None:
+        env["BFTPU_GANG_JOIN"] = join_target
+        if getattr(args, "join_want", None):
+            env["BFTPU_GANG_JOIN_WANT"] = str(args.join_want)
     if args.timeline:
         env["BLUEFOG_TIMELINE"] = args.timeline
     if args.telemetry or args.telemetry_port is not None or args.profile:
@@ -307,12 +372,19 @@ def _child_env(args, coord: str, rank: int, local_rank: int = 0,
         # port is logged by the endpoint at init).
         env["BLUEFOG_TPU_TELEMETRY_PORT"] = str(
             args.telemetry_port + rank if args.telemetry_port else 0)
-    if args.chaos:
+    if args.chaos and join_target is None:
         # Ranks self-inject (the launcher cannot know when "step N"
         # happens); chaos without the churn controller would just be a
         # crashed gang, so --chaos implies churn unless explicitly pinned.
         env["BLUEFOG_TPU_CHAOS"] = args.chaos
         env.setdefault("BLUEFOG_TPU_CHURN", "1")
+    if join_target is not None:
+        # A replacement spawned into a chaos gang must NOT re-execute the
+        # fault that vacated its seat: a joiner adopting the killed
+        # rank's id would otherwise SIGKILL itself at the same step.
+        env.pop("BLUEFOG_TPU_CHAOS", None)
+        if args.chaos:
+            env.setdefault("BLUEFOG_TPU_CHURN", "1")
     return env
 
 
@@ -328,6 +400,15 @@ def main(argv=None) -> int:
         print("bfrun: -np must be >= 1", file=sys.stderr)
         return 2
 
+    if args.join is not None and args.num_proc != 1:
+        print("bfrun: --join launches exactly one joining process; "
+              "use -np 1", file=sys.stderr)
+        return 2
+    if args.grow is not None and not args.elastic:
+        print("bfrun: --grow requires --elastic (the joiner bootstraps "
+              "from the gang directory)", file=sys.stderr)
+        return 2
+
     if args.hosts:
         try:
             placement = parse_hosts(args.hosts, args.num_proc)
@@ -336,6 +417,17 @@ def main(argv=None) -> int:
             return 2
     else:
         placement = [("127.0.0.1", i) for i in range(args.num_proc)]
+
+    if args.grow is not None and args.gang_dir is None \
+            and any(not is_local_host(h) for h, _ in placement):
+        # The default gang-dir is a launcher-local /tmp prefix, but
+        # remote members persist their replicas on THEIR hosts — the
+        # locally-spawned joiner would find nothing and its failure
+        # would tear down the healthy gang.
+        print("bfrun: --grow with remote hosts needs --gang-dir on "
+              "storage shared with this machine (the joiner bootstraps "
+              "from the persisted directory replicas)", file=sys.stderr)
+        return 2
 
     tolerate = frozenset()
     if args.chaos:
@@ -370,25 +462,65 @@ def main(argv=None) -> int:
         # appears on remote command lines and `pkill -f <tag>` can reach
         # ranks whose local ssh client we can only disconnect, not signal.
         tag = f"bfrun-gang-{uuid.uuid4().hex[:12]}"
+        gang_peers = None
+        gang_dir = args.gang_dir
+        if args.elastic:
+            # One pinned window-transport port per rank, exported to the
+            # whole gang: with the complete endpoint map known at launch
+            # there is no key-value exchange to run and no coordinator to
+            # lose — gossip anti-entropy keeps the map live from here on.
+            # (Ports are probed free locally; for remote hosts the probe
+            # is best-effort — a collision surfaces as that rank failing
+            # to bind, which the restart budget covers.)
+            win_ports = [_free_port() for _ in placement]
+            gang_peers = ",".join(
+                f"{host}:{p}" for (host, _), p in zip(placement, win_ports))
+            if gang_dir is None:
+                import tempfile
+                gang_dir = os.path.join(
+                    tempfile.mkdtemp(prefix="bf-gang-"), "gang")
+        if args.join is not None and gang_dir is None \
+                and args.join.startswith("@"):
+            gang_dir = args.join[1:]
         entries = []  # (Popen, host, is_remote)
+
+        def _spawn_member(rank, host, env):
+            env["BFTPU_GANG_TAG"] = tag
+            if is_local_host(host):
+                proc = (_spawn_tagged(cmd, env, rank) if args.tag_output
+                        else subprocess.Popen(cmd, env=env))
+                entries.append((proc, host, False))
+            else:
+                remote = _launch_shell(tag, rank, remote_run_cmd(env, cmd))
+                rsh_cmd = rsh + [host, remote]
+                proc = (_spawn_tagged(rsh_cmd, None, rank)
+                        if args.tag_output
+                        else subprocess.Popen(rsh_cmd))
+                entries.append((proc, host, True))
+
+        grow = []
+        if args.grow is not None:
+            def _spawn_joiner():
+                rank = len(entries)
+                env = _child_env(args, coord, rank, 0, 1,
+                                 gang_dir=gang_dir,
+                                 join_target=f"@{gang_dir}",
+                                 join_world=args.num_proc
+                                 * (args.devices_per_proc or 1))
+                print(f"bfrun: growing the gang — spawning a joining "
+                      f"process as rank {rank} (@{gang_dir})",
+                      file=sys.stderr)
+                _spawn_member(rank, "127.0.0.1", env)
+            grow = [(time.monotonic() + args.grow, _spawn_joiner)]
         try:
             for rank, (host, local_rank) in enumerate(placement):
                 env = _child_env(args, coord, rank, local_rank,
-                                 host_slots[host])
-                env["BFTPU_GANG_TAG"] = tag
-                if is_local_host(host):
-                    proc = (_spawn_tagged(cmd, env, rank) if args.tag_output
-                            else subprocess.Popen(cmd, env=env))
-                    entries.append((proc, host, False))
-                else:
-                    remote = _launch_shell(tag, rank, remote_run_cmd(env,
-                                                                     cmd))
-                    rsh_cmd = rsh + [host, remote]
-                    proc = (_spawn_tagged(rsh_cmd, None, rank)
-                            if args.tag_output
-                            else subprocess.Popen(rsh_cmd))
-                    entries.append((proc, host, True))
-            rc = _wait_gang(entries, rsh, tag, tolerate=tolerate)
+                                 host_slots[host], gang_peers=gang_peers,
+                                 gang_dir=gang_dir,
+                                 join_target=args.join)
+                _spawn_member(rank, host, env)
+            rc = _wait_gang(entries, rsh, tag, tolerate=tolerate,
+                            grow=grow)
         except KeyboardInterrupt:
             print("bfrun: interrupted; stopping the gang", file=sys.stderr)
             _kill_gang(entries, rsh, tag)
@@ -494,18 +626,43 @@ def _kill_gang(entries, rsh: list, tag: str,
 
 
 def _wait_gang(entries, rsh: list, tag: str,
-               tolerate=frozenset()) -> int:
+               tolerate=frozenset(), grow=()) -> int:
     """Wait for all processes; any nonzero exit kills the survivors —
     except ranks in ``tolerate`` (chaos-injected deaths), whose exits are
     expected and must leave the survivors running so recovery can be
-    observed.  The gang still waits for EVERY process to finish."""
-    procs = [p for p, _, _ in entries]
+    observed.  The gang still waits for EVERY process to finish.
+
+    The gang may GROW mid-wait (elastic scale-up): ``grow`` is a list of
+    ``(fire_monotonic, spawn_fn)`` entries; when an entry's time comes,
+    its ``spawn_fn`` appends a new ``(proc, host, is_remote)`` member to
+    ``entries`` and from then on the joined process is supervised exactly
+    like a founding rank — its nonzero exit kills the gang and its exit
+    reason appears in the summary (mirroring the kill-toleration the loop
+    already has for shrink)."""
+    pending_grow = sorted(grow, key=lambda g: g[0])
     while True:
-        rcs = [p.poll() for p in procs]
+        while pending_grow and time.monotonic() >= pending_grow[0][0]:
+            _, spawn_fn = pending_grow.pop(0)
+            try:
+                spawn_fn()  # appends to `entries`; supervised below
+            except Exception as e:  # noqa: BLE001 — a failed grow is fatal
+                print(f"bfrun: failed to grow the gang: {e}",
+                      file=sys.stderr)
+                _kill_gang(entries, rsh, tag)
+                _join_tag_pumps(entries)
+                return 1
+        rcs = [p.poll() for p, _, _ in entries]
         bad = next((r for i, r in enumerate(rcs)
                     if r not in (None, 0) and i not in tolerate), None)
         if bad is None:
             if all(r is not None for r in rcs):
+                if pending_grow:
+                    # Every rank already finished cleanly: there is no
+                    # gang left to grow into — spawning the joiner now
+                    # would only manufacture a failure.
+                    print(f"bfrun: gang finished before "
+                          f"{len(pending_grow)} scheduled --grow "
+                          "spawn(s); skipping them", file=sys.stderr)
                 _join_tag_pumps(entries)
                 return 0
             time.sleep(0.2)

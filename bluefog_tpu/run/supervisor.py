@@ -63,7 +63,7 @@ class ChurnSupervisor:
                 "the churn controller must be an explicit operational "
                 "decision, never ambient)")
         from bluefog_tpu import basics
-        from bluefog_tpu.ops import membership
+        from bluefog_tpu.ops import gang, membership
         from bluefog_tpu.ops import window as W
         from bluefog_tpu.ops.transport import OP_MEMBER
         d = W._store.distrib
@@ -84,18 +84,44 @@ class ChurnSupervisor:
         self._probe_timeout = probe_timeout
         self._hb_sec = (max(0.01, cfg.churn_heartbeat_ms / 1e3)
                         if heartbeat_sec is None else heartbeat_sec)
+        # Elastic scale-up (BLUEFOG_TPU_ELASTIC_JOIN, ops/gang.py): adopt
+        # the gang service a coordinator-free bootstrap or a join already
+        # installed, or — when the gang came up through the classic
+        # coordinator exchange with joins enabled — build the replicated
+        # directory from the live transport maps, so this member can
+        # grant joins and serve bootstrap replicas too.
+        self._gang = gang.current() if cfg.elastic_join else None
+        if cfg.elastic_join and self._gang is None:
+            directory = gang.GangDirectory(
+                self._n,
+                {p: f"{a[0]}:{a[1]}" for p, a in d.proc_addr.items()},
+                epoch=0, active=sorted(d.proc_addr),
+                rank_owner=dict(d.rank_owner))
+            self._gang = gang.GangService(directory)
+            gang.install(self._gang)
+            self._gang.persist()
+        grant = self._gang.pending_grant if self._gang is not None else None
+        seed = {}
+        if grant is not None:
+            # This process IS a granted joiner: seed the controller with
+            # the committed view from the grant and propose our own
+            # admission until the gang commits the grow epoch.
+            seed = dict(active=grant.active, epoch=grant.epoch,
+                        joining=True, my_join_ranks=grant.ranks,
+                        my_endpoint=grant.my_endpoint)
         self.ctrl = membership.MembershipController(
             n_procs=len(d.proc_addr), my_proc=d.my_proc,
             rank_owner=dict(d.rank_owner),
-            send_fn=self._send, probe_fn=self._probe)
+            send_fn=self._send, probe_fn=self._probe, **seed)
         membership.install(self.ctrl)
         from bluefog_tpu.utils import chaos, telemetry
         self.chaos = chaos.ChaosInjector(
             my_ranks=[r for r, p in d.rank_owner.items() if p == d.my_proc],
             transport=d.transport,
             peer_addrs=[a for p, a in d.proc_addr.items() if p != d.my_proc])
-        telemetry.set_gauge("bf_active_ranks", self._n)
-        telemetry.set_gauge("bf_membership_epoch", 0)
+        telemetry.set_gauge("bf_active_ranks",
+                            len(self.ctrl.active_ranks()))
+        telemetry.set_gauge("bf_membership_epoch", self.ctrl.epoch)
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._hb_loop, daemon=True, name="bf-churn-hb")
@@ -103,8 +129,20 @@ class ChurnSupervisor:
 
     # -- plumbing ----------------------------------------------------------
 
+    def _addr_of(self, proc: int):
+        """A peer's transport endpoint: the rank directory, with the
+        membership layer's join-claim hints as fallback — a pending or
+        freshly admitted joiner is reachable before the grow recovery has
+        extended ``proc_addr``."""
+        addr = self._d.proc_addr.get(proc)
+        if addr is None:
+            addr = self.ctrl.peer_endpoint_hint(proc)
+        if addr is None:
+            raise ConnectionError(f"no known endpoint for proc {proc}")
+        return addr
+
     def _send(self, proc: int, payload: bytes) -> None:
-        host, port = self._d.proc_addr[proc]
+        host, port = self._addr_of(proc)
         # Striped transport: membership traffic fans out across EVERY
         # stripe, preserving the PR-7 invariant that a peer whose data
         # path is wedged cannot look healthy through a side channel the
@@ -123,19 +161,29 @@ class ChurnSupervisor:
 
     def _probe(self, proc: int) -> bool:
         try:
-            socket.create_connection(self._d.proc_addr[proc],
+            socket.create_connection(self._addr_of(proc),
                                      timeout=self._probe_timeout).close()
             return True
-        except OSError:
+        except (OSError, ConnectionError):
             return False
 
     def _hb_loop(self) -> None:
+        ticks = 0
         while not self._stop.wait(self._hb_sec):
             try:
                 self.ctrl.tick()
             except Exception:  # noqa: BLE001 — the heartbeat must survive
                 from bluefog_tpu.utils.logging import get_logger
                 get_logger().exception("churn supervisor heartbeat failed")
+            ticks += 1
+            if self._gang is not None and ticks % 8 == 0:
+                # Directory anti-entropy at 1/8th the heartbeat cadence:
+                # state-based and idempotent, so the only cost of a slow
+                # push is how long a freshly persisted replica lags.
+                try:
+                    self._gang.announce()
+                except Exception:  # noqa: BLE001
+                    pass
             if self.ctrl.evicted:
                 return
 
@@ -201,6 +249,24 @@ class ChurnSupervisor:
         # even though the dead peer will never write its own.
         flightrec.dump(reason=f"membership change to epoch {view.epoch}")
         t0 = time.perf_counter()
+        # GROWTH first (elastic scale-up, ops/gang.py): extend the
+        # transport's rank directory with the admitted joiners — their
+        # endpoints from the commit view, their rank takeover from the
+        # consensus-updated ownership map — BEFORE the re-plan, so the
+        # grown topology's new edges resolve to live endpoints.
+        from bluefog_tpu.ops.gang import _ep_addr
+        for proc in view.added_procs:
+            ep = view.added_endpoints.get(proc)
+            if ep and proc not in self._d.proc_addr:
+                try:
+                    self._d.proc_addr[proc] = _ep_addr(ep)
+                except ValueError:
+                    pass
+        if view.added_ranks:
+            for r in view.added_ranks:
+                owner = self.ctrl.rank_owner.get(r)
+                if owner is not None:
+                    self._d.rank_owner[r] = owner
         dead_ranks = [r for r, p in self._d.rank_owner.items()
                       if p in set(view.removed_procs)]
         for proc in view.removed_procs:
@@ -236,13 +302,21 @@ class ChurnSupervisor:
                 for r, p in snap["p_main"].items():
                     if r in win.p_main:
                         win.p_main[r] = p
+        if self._gang is not None:
+            # Fold the commit into the replicated endpoint directory and
+            # persist the new replica (what a future joiner bootstraps
+            # from), then push it — freshly admitted members included.
+            self._gang.on_commit(view, self._d.rank_owner)
+            self._gang.announce()
         dt = time.perf_counter() - t0
         telemetry.observe("bf_churn_recovery_seconds", dt)
         from bluefog_tpu.utils.logging import get_logger
         get_logger().warning(
-            "churn: recovered in %.3fs — epoch %d, %d/%d ranks active, "
-            "%d window(s) re-planned", dt, view.epoch,
-            len(view.active_ranks), self._n, len(snaps))
+            "churn: recovered in %.3fs — epoch %d, %d/%d ranks active"
+            "%s, %d window(s) re-planned", dt, view.epoch,
+            len(view.active_ranks), self._n,
+            f" (admitted ranks {list(view.added_ranks)})"
+            if view.added_ranks else "", len(snaps))
 
     # -- lifecycle / introspection ----------------------------------------
 

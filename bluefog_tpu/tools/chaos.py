@@ -263,6 +263,400 @@ def _parse_results(stdout: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Elastic gang workers (coordinator-free bootstrap + mid-run join)
+# ---------------------------------------------------------------------------
+# The join/kill0 legs run the SAME decentralized-optimization workload as
+# the kill leg, but the gang bootstraps through ops/gang.py's replicated
+# endpoint directory instead of the jax coordinator: no jax.distributed
+# init at all, so killing rank 0's host removes one gossip peer, not the
+# rendezvous service.  A fresh process joins mid-run (`bfrun --join
+# @<prefix>`), is granted the vacant rank(s) placement-aware, and the gang
+# commits exactly one grow epoch — convergence then targets the FULL-gang
+# optimum again.
+
+
+def _gossip_loop(args, sup, W, name, me, x, steps, step0=0,
+                 deadline=None):
+    """The shared descend + win_put + combine-what-you-have loop; returns
+    (x, times, recovery_step, last_view, put_errors, epochs).
+
+    ``deadline`` (unix seconds) aligns loop ENDS across the gang: the
+    founding members and a late-admitted joiner start at different wall
+    times, but everyone must stop gossiping together — a member that
+    keeps descending against a joiner's frozen last value would drift
+    off the consensus optimum the assertions check."""
+    import numpy as np
+    times = []
+    recovery_step = None
+    view = None
+    put_errors = 0
+    epochs = []
+    target = float(me)
+    seen_srcs = set()
+    for step in range(step0, step0 + steps):
+        if deadline is not None and time.time() >= deadline:
+            break
+        t0 = time.perf_counter()
+        change = sup.step(step)
+        if change is not None:
+            view = change
+            epochs.append(change.epoch)
+            if change.evicted:
+                break
+            recovery_step = step
+            seen_srcs.clear()  # fresh window, fresh staging
+        x = x - args.lr * (x - target)
+        try:
+            W.win_put(x[None], name)
+        except ConnectionError:
+            put_errors += 1  # a dead peer not yet voted out
+        seen_srcs.update(
+            s for s, v in W.get_win_version(name, me).items() if v > 0)
+        if seen_srcs:
+            w = 1.0 / (len(seen_srcs) + 1)
+            out = W.win_update(name, self_weight=w,
+                               neighbor_weights={s: w for s in seen_srcs})
+            x = np.asarray(out)[0].astype(np.float32)
+        times.append(time.perf_counter() - t0)
+        if args.pace_ms:
+            time.sleep(args.pace_ms / 1e3)
+    return x, times, recovery_step, view, put_errors, epochs
+
+
+def _elastic_report(role, me, proc, sup, x, extra):
+    """One CHAOS_RESULT record for the elastic legs (shared shape between
+    founding members and the joiner)."""
+    import bluefog_tpu as bf
+    info = sup.info()
+    rec = {
+        "role": role,
+        "rank": me,
+        "proc": proc,
+        "epoch": info["epoch"],
+        "active_ranks": info["active_ranks"],
+        "changes_total": info["changes_total"],
+        "x_mean": float(x.mean()),
+        "gang": bf.gang_info(),
+    }
+    rec.update(extra)
+    print(_RESULT_TAG + json.dumps(rec), flush=True)
+
+
+def elastic_worker_main(args) -> int:
+    """One FOUNDING member of a coordinator-free gang: bootstraps from the
+    pre-assigned endpoint list (``bfrun --elastic``), never touches
+    jax.distributed, serves join grants, and survives any peer's death —
+    rank 0's included."""
+    os.environ.setdefault("BLUEFOG_TPU_TELEMETRY", "1")
+    import numpy as np
+
+    import bluefog_tpu as bf
+    from bluefog_tpu.ops import gang
+    from bluefog_tpu.ops import window as W
+    from bluefog_tpu.run.supervisor import ChurnSupervisor
+    from bluefog_tpu.utils import config
+    config.reload()
+    bf.init()
+    gang.init_elastic()
+    d = W._store.distrib
+    me = d.my_rank
+    x = np.full(args.dim, float(me), np.float32)
+    name = "gang_x"
+    W.win_create(x[None].copy(), name, zero_init=True)
+    sup = ChurnSupervisor()
+    x, times, recovery_step, view, put_errors, epochs = _gossip_loop(
+        args, sup, W, name, me, x, args.steps, deadline=args.deadline)
+    evicted = bool(view is not None and view.evicted)
+    pre = times[max(2, args.kill_step - 60):args.kill_step] \
+        if args.kill_step < len(times) else times[2:]
+    post = (times[recovery_step + 2:]
+            if recovery_step is not None else [])
+    _elastic_report("member", me, d.my_proc, sup, x, {
+        "evicted": evicted,
+        "steps": len(times),
+        "recovery_step": recovery_step,
+        "epochs": epochs,
+        "put_errors": put_errors,
+        "pre_median_ms": round(_median_ms(pre), 3),
+        "post_median_ms": round(_median_ms(post), 3),
+    })
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # No coordinator, no exit barrier needed: keep heartbeating (and
+    # serving gossip) through the grace window so slower finishers — the
+    # late-admitted joiner above all — converge before we disappear.
+    time.sleep(args.grace)
+    os._exit(0)
+
+
+def join_worker_main(args) -> int:
+    """The JOINING process: contacts any live member through the persisted
+    directory (``BFTPU_GANG_JOIN=@<prefix>``), waits for the grow epoch to
+    commit, creates its windows from the granted owned-row snapshot, and
+    gossips as a full member from then on."""
+    os.environ.setdefault("BLUEFOG_TPU_TELEMETRY", "1")
+    import numpy as np
+
+    import bluefog_tpu as bf
+    from bluefog_tpu.ops import gang
+    from bluefog_tpu.ops import window as W
+    from bluefog_tpu.run.supervisor import ChurnSupervisor
+    from bluefog_tpu.utils import config
+    config.reload()
+    bf.init()
+    target_spec = os.environ.get("BFTPU_GANG_JOIN")
+    if not target_spec:
+        raise SystemExit("chaos --role joiner needs BFTPU_GANG_JOIN "
+                         "(launch through `bfrun --join`)")
+    grant = gang.join_gang(target_spec)
+    sup = ChurnSupervisor()
+    admitted_after = None
+    t0 = time.monotonic()
+    step = 0
+    view = None
+    while time.monotonic() - t0 < args.join_wait:
+        change = sup.step(step)
+        if change is not None:
+            view = change
+        step += 1
+        if not sup.ctrl.joining:
+            admitted_after = round(time.monotonic() - t0, 3)
+            break
+        time.sleep(0.05)
+    me = min(grant.ranks)
+    if admitted_after is None:
+        _elastic_report("joiner", me, grant.proc, sup,
+                        np.zeros(1, np.float32),
+                        {"admitted": False, "steps": 0})
+        sys.stdout.flush()
+        os._exit(1)
+    # The grow epoch is committed and the survivor topology re-planned
+    # (sup.step ran the growth recovery): materialize the windows from
+    # the grant's owned-row snapshot — a survivor's consensus estimate —
+    # and gossip as an ordinary member.  Peers' puts that raced ahead of
+    # win_create were parked and replay in arrival order.
+    name = "gang_x"
+    w = grant.windows.get(name)
+    if w is None:
+        rows = np.zeros((len(grant.ranks), args.dim), np.float32)
+    else:
+        rows = np.stack([np.asarray(w["rows"][r], dtype=w["dtype"])
+                         for r in sorted(grant.ranks)])
+    W.win_create(rows.copy(), name, zero_init=True)
+    x = rows[0].astype(np.float32).copy()
+    print(f"chaos joiner: entering gossip loop at {time.time():.3f} "
+          f"(deadline {args.deadline}, steps cap {args.steps}, "
+          f"step0 {step})", file=sys.stderr, flush=True)
+    x2, times, _rec, view, put_errors, epochs = _gossip_loop(
+        args, sup, W, name, me, x, args.steps, step0=step,
+        deadline=args.deadline)
+    _elastic_report("joiner", me, grant.proc, sup, x2, {
+        "admitted": True,
+        "admitted_after_sec": admitted_after,
+        "grant_epoch": grant.epoch,
+        "granted_ranks": list(grant.ranks),
+        "evicted": bool(view is not None and view.evicted),
+        "steps": len(times),
+        "epochs": epochs,
+        "put_errors": put_errors,
+    })
+    sys.stdout.flush()
+    sys.stderr.flush()
+    time.sleep(min(args.grace, 2.0))
+    os._exit(0)
+
+
+def run_elastic_demo(args, kill_rank: int) -> int:
+    """Driver for the join and kill-rank-0 legs: launch a coordinator-free
+    gang under ``bfrun --elastic --chaos kill:...``, wait for the shrink
+    epoch to land in the persisted directory, then admit a replacement
+    through ``bfrun --join @<prefix>`` and judge the whole promise:
+
+      * the gang survives the kill (rank 0's included — no coordinator);
+      * the directory serves the joiner's bootstrap from disk;
+      * exactly ONE grow epoch commits (epoch 2: shrink then grow);
+      * every member — the joiner included — converges to the FULL-gang
+        optimum (matched final loss vs a never-shrunk run).
+    """
+    import tempfile
+
+    from bluefog_tpu.ops.gang import GangDirectory
+    n = args.np
+    spec = f"kill:rank={kill_rank}:step={args.kill_step}"
+    survivors = sorted(set(range(n)) - {kill_rank})
+    tmpdir = tempfile.mkdtemp(prefix="bf-gang-demo-")
+    prefix = os.path.join(tmpdir, "gang")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BLUEFOG_TPU_CHURN": "1",
+        "BLUEFOG_TPU_ELASTIC_JOIN": "1",
+        "BLUEFOG_TPU_CHURN_HEARTBEAT_MS": "80",
+        "BLUEFOG_TPU_CHURN_SUSPECT_MS": "500",
+        "BLUEFOG_TPU_WIN_RETRIES": "1",
+        "BLUEFOG_TPU_WIN_RETRY_BACKOFF_MS": "25",
+        "BLUEFOG_TPU_TELEMETRY": "1",
+    })
+    # Everyone — founding members and the late joiner — stops gossiping
+    # at one shared wall-clock deadline, so the final iterates are a
+    # joint consensus snapshot, not a race against exit skew.
+    deadline = time.time() + args.run_sec
+    cmd = [sys.executable, "-m", "bluefog_tpu.run", "-np", str(n),
+           "--devices-per-proc", "1", "--elastic", "--gang-dir", prefix,
+           "--chaos", spec, "--",
+           sys.executable, "-m", "bluefog_tpu.tools", "chaos", "--worker",
+           "--role", "member", "--steps", str(args.steps),
+           "--dim", str(args.dim), "--lr", str(args.lr),
+           "--pace-ms", str(args.pace_ms), "--grace", str(args.grace),
+           "--kill-step", str(args.kill_step),
+           "--deadline", repr(deadline)]
+    leg = "kill-rank-0" if kill_rank == 0 else "join"
+    print(f"chaos {leg}: launching {n}-process coordinator-free gang, "
+          f"{spec} ({args.steps} steps, directory @{prefix})...",
+          flush=True)
+    t_start = time.perf_counter()
+    # Output to FILES, not pipes: the driver must keep polling the
+    # directory while the gang runs, and four ranks' stderr would fill a
+    # pipe long before the run ends.
+    gang_out = open(os.path.join(tmpdir, "gang.out"), "w+")
+    gang_err = open(os.path.join(tmpdir, "gang.err"), "w+")
+    gang_proc = subprocess.Popen(cmd, env=env, stdout=gang_out,
+                                 stderr=gang_err, text=True)
+    failures = []
+    join_results = {}
+    join_stderr = ""
+    try:
+        # Phase 1: the kill lands and the survivors commit the shrink
+        # epoch — observable from OUTSIDE through the persisted replicas.
+        poll_deadline = time.monotonic() + args.timeout / 2
+        shrunk = False
+        while time.monotonic() < poll_deadline:
+            if gang_proc.poll() is not None:
+                break
+            try:
+                merged = GangDirectory.load_any(prefix)
+                if merged.epoch >= 1 and merged.vacant_ranks():
+                    shrunk = True
+                    break
+            except (FileNotFoundError, OSError):
+                pass
+            time.sleep(0.2)
+        if not shrunk:
+            _fail(failures, "the persisted gang directory never reached a "
+                            "committed shrink epoch with a vacant rank")
+        else:
+            # Phase 2: admit a replacement through the directory — the
+            # exact bootstrap path an operator's replacement pod takes.
+            join_cmd = [sys.executable, "-m", "bluefog_tpu.run", "-np",
+                        "1", "--devices-per-proc", str(n),
+                        "--join", f"@{prefix}", "--gang-dir", prefix,
+                        "--",
+                        sys.executable, "-m", "bluefog_tpu.tools",
+                        "chaos", "--worker", "--role", "joiner",
+                        "--steps", str(args.steps),
+                        "--dim", str(args.dim), "--lr", str(args.lr),
+                        "--pace-ms", str(args.pace_ms),
+                        "--grace", str(args.grace),
+                        "--join-wait", str(args.join_wait),
+                        "--deadline", repr(deadline)]
+            join_proc = subprocess.run(
+                join_cmd, env=env, capture_output=True, text=True,
+                timeout=args.timeout / 2)
+            join_results = _parse_results(join_proc.stdout)
+            join_stderr = join_proc.stderr
+            if join_proc.returncode != 0:
+                _fail(failures,
+                      f"join bfrun exited {join_proc.returncode}")
+        rc = gang_proc.wait(timeout=args.timeout)
+        if rc != 0:
+            _fail(failures, f"gang bfrun exited {rc} (the chaos kill must "
+                            "be tolerated, any other failure is real)")
+    finally:
+        if gang_proc.poll() is None:
+            gang_proc.kill()
+            gang_proc.wait(timeout=30)
+        gang_out.seek(0)
+        gang_stdout = gang_out.read()
+        gang_err.seek(0)
+        gang_stderr = gang_err.read()
+        gang_out.close()
+        gang_err.close()
+    wall = time.perf_counter() - t_start
+    results = _parse_results(gang_stdout)
+    members = {r: v for r, v in results.items()
+               if v.get("role") == "member"}
+    joiners = [v for v in join_results.values()
+               if v.get("role") == "joiner"]
+    if sorted(members) != survivors:
+        _fail(failures, f"expected member reports from survivors "
+                        f"{survivors}, got {sorted(members)}")
+    if not joiners:
+        _fail(failures, "no report from the joining process")
+    # Full-gang optimum: the joiner revives the killed rank's seat (and
+    # its target), so the network optimum is the NEVER-SHRUNK mean.
+    target_mean = sum(range(n)) / n
+    reports = ([(f"rank {r} (member)", v) for r, v in sorted(
+        members.items())]
+        + [(f"rank {v.get('rank')} (joiner)", v) for v in joiners])
+    for label, r in reports:
+        line = (f"  {label}: epoch {r['epoch']}, active "
+                f"{r['active_ranks']}, x_mean {r['x_mean']:.4f} "
+                f"(target {target_mean:.4f}), changes "
+                f"{r['changes_total']}")
+        if r.get("admitted_after_sec") is not None:
+            line += f", admitted after {r['admitted_after_sec']}s"
+        line += f", {r.get('steps', '?')} steps"
+        print(line, flush=True)
+        if r.get("evicted"):
+            _fail(failures, f"{label}: evicted")
+        # Exactly one shrink + exactly one grow epoch, gang-wide (the
+        # joiner entered at the shrink epoch, so it sees one commit).
+        want_changes = 2 if r.get("role") == "member" else 1
+        if r["epoch"] != 2 or r["changes_total"] != want_changes:
+            _fail(failures,
+                  f"{label}: expected exactly one shrink + one grow "
+                  f"epoch (epoch 2, {want_changes} change(s)), got epoch "
+                  f"{r['epoch']} with {r['changes_total']} changes")
+        if sorted(r["active_ranks"]) != list(range(n)):
+            _fail(failures,
+                  f"{label}: final active ranks {r['active_ranks']} != "
+                  f"the full gang {list(range(n))}")
+        if abs(r["x_mean"] - target_mean) > args.loss_tol:
+            _fail(failures,
+                  f"{label}: consensus {r['x_mean']:.4f} is "
+                  f"{abs(r['x_mean'] - target_mean):.4f} from the "
+                  f"full-gang optimum {target_mean:.4f} "
+                  f"(tol {args.loss_tol})")
+    for v in joiners:
+        if not v.get("admitted"):
+            _fail(failures, "the joiner was never admitted (no grow "
+                            "epoch committed)")
+        elif sorted(v.get("granted_ranks", [])) != [kill_rank]:
+            _fail(failures,
+                  f"joiner was granted {v.get('granted_ranks')}, expected "
+                  f"the vacant rank [{kill_rank}]")
+    if failures:
+        print(f"\nchaos {leg} FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        tail = "\n".join(gang_stderr.splitlines()[-40:])
+        print(f"\ngang stderr tail:\n{tail}", file=sys.stderr)
+        jtail = "\n".join(join_stderr.splitlines()[-25:])
+        if jtail:
+            print(f"\njoiner stderr tail:\n{jtail}", file=sys.stderr)
+        return 1
+    import shutil
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    print(f"chaos {leg} OK: rank {kill_rank} killed at step "
+          f"{args.kill_step}, survivors committed the shrink, a fresh "
+          f"process bootstrapped from the directory, took rank "
+          f"{kill_rank} via one grow epoch, and the gang converged to "
+          f"the full-gang optimum {target_mean:.3f} (wall {wall:.1f}s)",
+          flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # Delay-scenario worker (sync vs async gossip under a straggler fault)
 # ---------------------------------------------------------------------------
 
@@ -715,6 +1109,32 @@ def main(argv=None) -> int:
                    help="internal (with --worker): delay-scenario gossip "
                         "mode — sync steps behind a per-step barrier, "
                         "async is barrier-free push-sum")
+    p.add_argument("--role", default=None, choices=["member", "joiner"],
+                   help="internal (with --worker): elastic-leg role — "
+                        "member = coordinator-free founding rank, joiner "
+                        "= mid-run join via BFTPU_GANG_JOIN")
+    p.add_argument("--join-wait", type=float, default=30.0,
+                   help="joiner: seconds to wait for the grow epoch to "
+                        "commit after the grant")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="internal: shared unix-time gossip stop point "
+                        "for the elastic legs")
+    p.add_argument("--run-sec", type=float, default=30.0,
+                   help="elastic legs: wall-clock gossip budget (the "
+                        "shared deadline every worker stops at)")
+    p.add_argument("--join-leg", action="store_true",
+                   help="run the elastic JOIN leg: coordinator-free "
+                        "4-proc gang, kill a non-zero rank, admit a "
+                        "fresh process through the persisted directory, "
+                        "assert one grow epoch + full-gang convergence")
+    p.add_argument("--kill0-leg", action="store_true",
+                   help="run the elastic KILL-RANK-0 leg: same gang, "
+                        "SIGKILL rank 0 — the gang must survive (no "
+                        "coordinator) and admit a replacement for rank 0")
+    p.add_argument("--join-smoke", action="store_true",
+                   help="CI smoke profile of the join leg")
+    p.add_argument("--kill0-smoke", action="store_true",
+                   help="CI smoke profile of the kill-rank-0 leg")
     p.add_argument("--delay", action="store_true",
                    help="run the delay scenario (sync + async legs) "
                         "instead of the kill scenario")
@@ -773,9 +1193,39 @@ def main(argv=None) -> int:
                    help="CI smoke profile (same assertions, smaller run)")
     args = p.parse_args(argv)
     if args.worker:
+        if args.role == "member" and os.environ.get("BFTPU_GANG_JOIN"):
+            # `bfrun --elastic --grow S` relaunches the SAME command for
+            # the late joiner, distinguished only by BFTPU_GANG_JOIN —
+            # the same branch a real join-aware training program makes.
+            args.role = "joiner"
+        if args.role == "member":
+            return elastic_worker_main(args)
+        if args.role == "joiner":
+            return join_worker_main(args)
         if args.mode is not None:
             return delay_worker_main(args)
         return worker_main(args)
+    if args.join_leg or args.join_smoke or args.kill0_leg \
+            or args.kill0_smoke:
+        if args.join_smoke or args.kill0_smoke:
+            args.run_sec = min(args.run_sec, 24.0)
+            args.dim = min(args.dim, 32)
+            args.pace_ms = min(args.pace_ms, 3.0)
+            args.kill_step = min(args.kill_step, 80)
+        args.steps = max(args.steps, 100_000)  # the deadline governs
+        # The combine-what-you-have workload oscillates around the
+        # optimum (each step descends before averaging); the elastic
+        # legs judge the GANG's mean, so individual ranks get a bit more
+        # slack than the kill leg's post-recovery steady state.
+        args.loss_tol = max(args.loss_tol, 0.2)
+        if args.kill0_leg or args.kill0_smoke:
+            return run_elastic_demo(args, kill_rank=0)
+        kill_rank = ((args.np - 2 if args.np > 2 else 1)
+                     if args.kill_rank is None else args.kill_rank)
+        if kill_rank == 0:
+            raise SystemExit("chaos --join-leg: use --kill0-leg for the "
+                             "rank-0 scenario")
+        return run_elastic_demo(args, kill_rank=kill_rank)
     if args.delay or args.delay_smoke:
         if args.delay_smoke:
             args.steps = min(args.steps, 160)

@@ -38,6 +38,9 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_CHURN_HEARTBEAT_MS | 250  | membership heartbeat period |
 | BLUEFOG_TPU_CHURN_SUSPECT_MS  | 1500  | heartbeat silence before a peer is suspected |
 | BLUEFOG_TPU_CHURN_STRAGGLER_STEPS | 0 | step lag that marks a live peer a straggler suspect (0=off) |
+| BLUEFOG_TPU_ELASTIC_JOIN      | 0     | 1: enable the gossip-native join/bootstrap subsystem (ops/gang.py) — wired joins, the replicated endpoint directory, coordinator-free gang bootstrap; 0 = every legacy path bit-identical |
+| BLUEFOG_TPU_GANG_DIR_PATH     | unset | endpoint-directory persistence prefix (files are <prefix>.<proc>.json, beside owned_ranks.json when pointed at the checkpoint dir); unset = in-memory only |
+| BLUEFOG_TPU_JOIN_TIMEOUT_MS   | 30000 | how long a joining process waits for a join grant per contacted endpoint |
 | BLUEFOG_TPU_CHAOS             | unset | fault-injection spec (set by bfrun --chaos) |
 | BLUEFOG_TPU_TELEMETRY         | 1     | 0: disable the metric registry entirely |
 | BLUEFOG_TPU_TELEMETRY_PORT    | unset | serve /metrics + /healthz (0=ephemeral) |
@@ -340,6 +343,21 @@ class Config:
     # proposed for eviction as a persistent straggler.  0 (default)
     # disables straggler eviction — dead/unreachable peers only.
     churn_straggler_steps: int
+    # Gossip-native join/bootstrap subsystem (ops/gang.py): wired joins
+    # (`bfrun --join` processes admitted into a live gang over the window
+    # transport, placement-aware rank assignment, one committed grow
+    # epoch) and the gossip-replicated endpoint directory that replaces
+    # the jax-coordinator KV store for bootstrap (`bfrun --elastic`).
+    # OFF by default: with elastic_join=0 no directory exists, OP_GANG
+    # frames are dropped on receipt, and every wire byte and committed
+    # state is bit-identical to the pre-join tree.
+    elastic_join: bool
+    # Directory persistence prefix; each process writes
+    # <prefix>.<proc>.json atomically on every directory change, so a
+    # fresh process can bootstrap from disk with no live coordinator.
+    gang_dir_path: Optional[str]
+    # Per-endpoint grant wait for a joining process.
+    join_timeout_ms: float
     # Fault-injection spec (utils/chaos.py grammar), normally set for a
     # gang by `bfrun --chaos`; unset = no injection.
     chaos: Optional[str]
@@ -465,6 +483,10 @@ class Config:
                 "BLUEFOG_TPU_CHURN_SUSPECT_MS", "1500")),
             churn_straggler_steps=int(os.environ.get(
                 "BLUEFOG_TPU_CHURN_STRAGGLER_STEPS", "0")),
+            elastic_join=_flag("BLUEFOG_TPU_ELASTIC_JOIN"),
+            gang_dir_path=os.environ.get("BLUEFOG_TPU_GANG_DIR_PATH"),
+            join_timeout_ms=float(os.environ.get(
+                "BLUEFOG_TPU_JOIN_TIMEOUT_MS", "30000")),
             chaos=os.environ.get("BLUEFOG_TPU_CHAOS"),
             telemetry=_flag("BLUEFOG_TPU_TELEMETRY", default=True),
             telemetry_port=(

@@ -125,7 +125,14 @@ def _write_owned_ranks(proc_dir: str) -> None:
     """Persist this process's rank-ownership alongside its checkpoints so a
     world-size resume can attribute rank-major rows to their authoritative
     owner even under non-uniform ``--hosts h1:3,h2:1`` placements (where an
-    even ``array_split`` would take rows from the wrong process)."""
+    even ``array_split`` would take rows from the wrong process).
+
+    The file also stamps the GEOMETRY it was written under (``nproc``), so
+    a later resume at a different process count — a shrink, or a gang that
+    GREW through the elastic join path — can tell a current map from a
+    stale one instead of discovering the mismatch as a silently broken
+    partition (see :func:`_invalidate_stale_owned_ranks`).  Pre-stamp
+    files (a bare JSON list) keep being read."""
     import json
     try:
         # The framework's own rank directory (honors bf.init(devices=...)
@@ -138,8 +145,17 @@ def _write_owned_ranks(proc_dir: str) -> None:
     os.makedirs(proc_dir, exist_ok=True)
     tmp = os.path.join(proc_dir, _OWNED_FILE + ".tmp")
     with open(tmp, "w") as fh:
-        json.dump(owned, fh)
+        json.dump({"ranks": owned, "nproc": jax.process_count()}, fh)
     os.replace(tmp, os.path.join(proc_dir, _OWNED_FILE))
+
+
+def _parse_owned_map(raw):
+    """One persisted ownership map: ``(ranks, nproc)`` — ``nproc`` None
+    for pre-geometry-stamp files (a bare list)."""
+    if isinstance(raw, dict):
+        return ([int(r) for r in raw.get("ranks", [])],
+                int(raw["nproc"]) if "nproc" in raw else None)
+    return ([int(r) for r in raw], None)
 
 
 def _owned_rows_of(dirs, n_rows: int):
@@ -160,9 +176,9 @@ def _owned_rows_of(dirs, n_rows: int):
         for fname in (_OWNED_FILE, _OWNED_FILE + ".stale"):
             try:
                 with open(os.path.join(d, fname)) as fh:
-                    maps.append([int(r) for r in json.load(fh)])
+                    maps.append(_parse_owned_map(json.load(fh))[0])
                 break
-            except (OSError, ValueError):
+            except (OSError, ValueError, TypeError):
                 continue
         else:
             maps.append(None)
@@ -186,12 +202,24 @@ def _owned_rows_of(dirs, n_rows: int):
 
 
 def _invalidate_stale_owned_ranks(base: str, nproc: int) -> None:
-    """Shrink-resume hygiene: proc dirs beyond the NEW process count keep
-    the old geometry's ``owned_ranks.json``; once the surviving dirs are
-    rewritten for the new geometry, the combined maps would no longer
-    partition ``range(n)`` and ``_owned_rows_of`` would silently fall back
-    to even blocks on the next world-size resume.  Rename the stale files
-    aside (kept as ``.stale`` for forensics) and warn."""
+    """World-size-resume hygiene, both directions.
+
+    SHRINK: proc dirs beyond the NEW process count keep the old geometry's
+    ``owned_ranks.json``; once the surviving dirs are rewritten for the
+    new geometry, the combined maps would no longer partition ``range(n)``
+    and ``_owned_rows_of`` would silently fall back to even blocks on the
+    next world-size resume.
+
+    GROWTH (elastic join): a surviving dir's map may carry a geometry
+    stamp from BEFORE the gang grew — e.g. the 3-process post-shrink map
+    a resume at 4 processes must not resurrect, because under the grown
+    gang that process no longer owns the revived ranks.  Any map stamped
+    with a different ``nproc`` than the resuming world is invalidated.
+
+    Stale files are renamed aside (kept as ``.stale`` for forensics — the
+    stitch path still reads them for cross-geometry row attribution) and
+    warned about."""
+    import json
     stale = []
     for d in _proc_dirs(base):
         try:
@@ -199,19 +227,34 @@ def _invalidate_stale_owned_ranks(base: str, nproc: int) -> None:
         except ValueError:
             continue
         f = os.path.join(d, _OWNED_FILE)
-        if idx >= nproc and os.path.exists(f):
+        if not os.path.exists(f):
+            continue
+        drop = idx >= nproc
+        why = "beyond the new process count"
+        if not drop:
+            try:
+                with open(f) as fh:
+                    file_nproc = _parse_owned_map(json.load(fh))[1]
+            except (OSError, ValueError, TypeError):
+                file_nproc = None
+            if file_nproc is not None and file_nproc != nproc:
+                drop = True
+                why = (f"stamped for a {file_nproc}-process geometry "
+                       f"(resuming at {nproc})")
+        if drop:
             try:
                 os.replace(f, f + ".stale")
             except OSError:
                 continue
-            stale.append(os.path.basename(d))
+            stale.append((os.path.basename(d), why))
     if stale:
         get_logger().warning(
-            "elastic: world size shrank to %d processes; invalidated the "
+            "elastic: world size changed to %d processes; invalidated the "
             "stale owned_ranks.json in %s (their ownership maps described "
-            "the previous geometry and would have silently degraded future "
-            "world-size resumes to even-block row attribution)",
-            nproc, ", ".join(stale))
+            "a previous geometry — a resume after a join or shrink must "
+            "not resurrect them, or future world-size resumes would "
+            "silently degrade to even-block row attribution)",
+            nproc, ", ".join(f"{d} [{w}]" for d, w in stale))
 
 
 def _stitch(base: str, step: int):

@@ -567,6 +567,16 @@ def health() -> dict:
         body["membership"] = member
         if member.get("suspect_ranks") or member.get("evicted"):
             body["status"] = "degraded"
+    # Gang join/bootstrap directory (ops/gang.py): the replicated
+    # endpoint directory's committed epoch, vacancy pool and grant tally.
+    # Absent entirely when BLUEFOG_TPU_ELASTIC_JOIN is off.
+    try:
+        from bluefog_tpu.ops import gang
+        gd = gang.health_summary()
+    except Exception:  # noqa: BLE001 — health must render regardless
+        gd = None
+    if gd is not None:
+        body["gang_directory"] = gd
     probe = stall._peer_probe
     if probe is not None:
         try:

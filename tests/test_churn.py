@@ -90,11 +90,16 @@ def test_chaos_injector_ignores_other_ranks():
 
 class _Gang:
     """In-memory membership gang: n controllers, fake clock, losable
-    links, scriptable probe."""
+    links, scriptable probe, mid-run joiners (elastic scale-up)."""
 
-    def __init__(self, n, suspect_sec=1.0, straggler_steps=0):
+    def __init__(self, n, suspect_sec=1.0, straggler_steps=0,
+                 drop_prob=0.0, rng=None):
+        self.n = n
+        self.suspect_sec = suspect_sec
         self.clock = 0.0
         self.dead = set()
+        self.drop_prob = drop_prob
+        self.rng = rng
         self.ctrls = {}
         for p in range(n):
             self.ctrls[p] = M.MembershipController(
@@ -107,9 +112,29 @@ class _Gang:
 
     def _send_from(self, p):
         def send(q, payload):
+            if self.drop_prob and self.rng is not None \
+                    and self.rng.random() < self.drop_prob:
+                return  # lossy link: the state-based protocol must heal
             if q not in self.dead and q in self.ctrls:
                 self.ctrls[q].on_message(json.loads(payload.decode()))
         return send
+
+    def add_joiner(self, ranks, grantor: int, endpoint=None):
+        """A fresh process granted ``ranks`` by ``grantor``, seeded from
+        the grantor's CURRENT view — exactly the gang.py grant contract
+        (the grant may race an uncommitted shrink; the protocol heals)."""
+        p = max(self.ctrls) + 1
+        base = self.ctrls[grantor]
+        endpoint = endpoint or f"j:{p}"
+        self.ctrls[p] = M.MembershipController(
+            self.n, p, dict(base.rank_owner),
+            send_fn=self._send_from(p),
+            probe_fn=lambda q: q not in self.dead,
+            now_fn=lambda: self.clock, suspect_sec=self.suspect_sec,
+            active=tuple(base.active), epoch=base.epoch, joining=True,
+            my_join_ranks=tuple(ranks), my_endpoint=endpoint)
+        base.note_join(p, tuple(ranks), endpoint)
+        return p
 
     def run(self, seconds, dt=0.25):
         t = 0.0
@@ -121,7 +146,8 @@ class _Gang:
                     c.tick()
 
     def alive(self):
-        return [c for p, c in self.ctrls.items() if p not in self.dead]
+        return [c for p, c in self.ctrls.items()
+                if p not in self.dead and not c.evicted]
 
 
 def test_consensus_commits_identical_view_on_all_survivors():
@@ -310,6 +336,152 @@ def test_handle_wire_drops_garbage_and_without_controller():
         {"k": "hb", "proc": 1, "epoch": 0, "step": 7,
          "active": [0, 1], "prop": None}).encode())
     assert g.ctrls[0].peer_step[1] == 7
+
+
+# ---------------------------------------------------------------------------
+# Elastic scale-up: join proposals through the same consensus
+# ---------------------------------------------------------------------------
+
+def test_join_commits_single_grow_epoch_on_all_members():
+    g = _Gang(4)
+    g.dead.add(2)
+    g.run(5.0)
+    j = g.add_joiner([2], grantor=0)
+    g.run(3.0)
+    for c in g.alive():
+        assert c.epoch == 2
+        assert c.view().active_ranks == (0, 1, 2, 3)
+        assert c.rank_owner[2] == j
+    joiner = g.ctrls[j]
+    assert not joiner.joining
+    # Members saw two commits (shrink + grow); the grow view names the
+    # admitted proc, its ranks and its endpoint.
+    views = []
+    while True:
+        v = g.ctrls[0].poll_change()
+        if v is None:
+            break
+        views.append(v)
+    assert [v.epoch for v in views] == [1, 2]
+    assert views[1].added_procs == (j,)
+    assert views[1].added_ranks == (2,)
+    assert views[1].added_endpoints == {j: f"j:{j}"}
+
+
+def test_join_heartbeats_are_byte_identical_without_joins():
+    """BLUEFOG_TPU_ELASTIC_JOIN=0 oracle: with no join anywhere in
+    flight, the membership wire payload is byte-for-byte the PR-14
+    format — the new keys only appear when a join is live."""
+    c = M.MembershipController(3, 1, {r: r for r in range(3)},
+                               send_fn=lambda q, p: None)
+    c.my_step = 7
+    legacy = json.dumps({"k": "hb", "proc": 1, "epoch": 0, "step": 7,
+                         "active": [0, 1, 2], "prop": None}).encode()
+    assert c._payload(None) == legacy
+    legacy_prop = json.dumps({"k": "hb", "proc": 1, "epoch": 0, "step": 7,
+                              "active": [0, 1, 2],
+                              "prop": [0, 1]}).encode()
+    assert c._payload(frozenset({0, 1})) == legacy_prop
+
+
+def test_same_epoch_superset_views_reconcile_by_joiner_union():
+    """The intersection-reconcile rule extended to supersets: a proc
+    admitted AT the contested epoch rides the union (its committer
+    verified full agreement), while incumbents still intersect."""
+    def mk(my):
+        c = M.MembershipController(
+            4, my, {r: r for r in range(4)}, send_fn=lambda q, p: None,
+            probe_fn=lambda q: True, now_fn=lambda: 0.0)
+        return c
+
+    # A committed {0,1,3} at epoch 2 without the joiner; B committed
+    # {0,1,3,4} at epoch 2 WITH joiner 4 (joined at this epoch, owning
+    # rank 2).  A must fold the joiner in, not drop it.
+    a = mk(0)
+    a.epoch, a.active = 2, frozenset({0, 1, 3})
+    a.on_message({"k": "hb", "proc": 1, "epoch": 2, "step": 0,
+                  "active": [0, 1, 3, 4], "prop": None,
+                  "joined": [4], "joined_ranks": {"4": [2]},
+                  "joined_eps": {"4": "j:4"}})
+    assert a.epoch == 2
+    assert a.active == frozenset({0, 1, 3, 4})
+    assert a.rank_owner[2] == 4
+    v = a.poll_change()
+    assert v is not None and v.added_procs == (4,)
+    # And the mirror: B hears A's joiner-less epoch-2 view — the joiner
+    # stays (B's own joined_at_epoch rides the union term).
+    b = mk(1)
+    b.epoch, b.active = 2, frozenset({0, 1, 3, 4})
+    b.joined_at_epoch = frozenset({4})
+    b.joined_info[4] = ((2,), "j:4")
+    b.rank_owner[2] = 4
+    b.on_message({"k": "hb", "proc": 0, "epoch": 2, "step": 0,
+                  "active": [0, 1, 3], "prop": None})
+    assert b.active == frozenset({0, 1, 3, 4})
+
+
+def test_epoch_ahead_heartbeat_adopts_grown_view_with_rank_claims():
+    """A peer that slept through the whole join adopts the grown view —
+    including the joiner's rank takeover — from one heartbeat."""
+    c = M.MembershipController(4, 3, {r: r for r in range(4)},
+                               send_fn=lambda q, p: None)
+    c.on_message({"k": "hb", "proc": 0, "epoch": 2, "step": 0,
+                  "active": [0, 1, 3, 4], "prop": None,
+                  "joined": [4], "joined_ranks": {"4": [2]},
+                  "joined_eps": {"4": "10.0.0.9:7001"}})
+    assert c.epoch == 2
+    assert c.rank_owner[2] == 4
+    assert c.view().active_ranks == (0, 1, 2, 3)
+    assert c.peer_endpoint_hint(4) == ("10.0.0.9", 7001)
+
+
+def test_joining_process_rebases_instead_of_self_evicting():
+    """A second shrink committing while the join is in flight must not
+    read as an eviction verdict for the joiner — it was never a member.
+    The joiner rebases on the newer survivor set and is admitted into
+    the NEXT epoch."""
+    g = _Gang(4)
+    g.dead.add(3)
+    g.run(5.0)  # epoch 1: {0,1,2}
+    # Grant from a STALE base: proc 0's view BEFORE another kill.
+    j = g.add_joiner([3], grantor=0)
+    g.dead.add(2)
+    g.run(5.0)
+    joiner = g.ctrls[j]
+    assert not joiner.evicted
+    assert not joiner.joining
+    for c in g.alive():
+        assert c.active == frozenset({0, 1, j})
+        assert c.rank_owner[3] == j
+
+
+def test_property_interleaved_joins_and_kills_never_diverge():
+    """Satellite property test: random interleavings of kill + join
+    (including grants raced against uncommitted shrinks and lossy
+    links) always converge every survivor AND the joiner to ONE
+    identical (epoch, active, rank ownership) view — never divergent
+    committed views, never a lost joiner."""
+    import random
+    for seed in range(10):
+        rng = random.Random(seed)
+        g = _Gang(4, drop_prob=0.15, rng=rng)
+        g.run(1.0)
+        victim = rng.choice([1, 2, 3])
+        g.dead.add(victim)
+        # The join lands at a random point relative to the shrink
+        # consensus: sometimes before the commit, sometimes after.
+        g.run(rng.uniform(0.25, 6.0))
+        grantor = rng.choice(sorted(set(g.ctrls) - g.dead))
+        j = g.add_joiner([victim], grantor=grantor)
+        g.run(14.0)
+        alive = g.alive()
+        assert g.ctrls[j] in alive, f"seed {seed}: joiner lost"
+        views = {(c.epoch, c.active) for c in alive}
+        assert len(views) == 1, f"seed {seed}: divergent views {views}"
+        for c in alive:
+            assert c.rank_owner[victim] == j, f"seed {seed}"
+            assert c.view().active_ranks == tuple(range(4)), \
+                f"seed {seed}: {c.view()}"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,534 @@
+"""Gang join/bootstrap subsystem: directory CRDT semantics, persistence,
+placement-aware admission, launcher wiring, owned-ranks invalidation for
+grown gangs, telemetry surfaces.
+
+The full join-a-rank-mid-training path runs as the `make chaos-smoke`
+join/kill-rank-0 legs (and the slow-marked wrappers at the bottom); here
+the pieces are exercised hermetically."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.ops import gang
+from bluefog_tpu.utils import config, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    gang.install(None)
+    telemetry.reset()
+    config.reload()
+
+
+# ---------------------------------------------------------------------------
+# GangDirectory: CRDT merge + persistence
+# ---------------------------------------------------------------------------
+
+def _dir(n=4, eps=None, epoch=0, active=(0, 1, 2, 3), owner=None):
+    eps = eps if eps is not None else {p: f"h:{p + 1}" for p in range(4)}
+    owner = owner if owner is not None else {r: r for r in range(n)}
+    return gang.GangDirectory(n, eps, epoch=epoch, active=active,
+                              rank_owner=owner)
+
+
+def test_directory_round_trips_through_dict():
+    d = _dir(epoch=3, active=(0, 2))
+    d2 = gang.GangDirectory.from_dict(d.to_dict())
+    assert d2.to_dict() == d.to_dict()
+    assert d2.rank_owner == d.rank_owner and d2.epoch == 3
+
+
+def test_directory_merge_unions_endpoints_and_adopts_higher_epoch():
+    a = _dir(eps={0: "h:1", 1: "h:2"}, epoch=1, active=(0, 1))
+    b = _dir(eps={1: "h:2", 4: "h:9"}, epoch=2, active=(0, 1, 4),
+             owner={0: 0, 1: 1, 2: 4, 3: 3})
+    assert a.merge(b) is True
+    assert a.endpoints == {0: "h:1", 1: "h:2", 4: "h:9"}
+    assert a.epoch == 2 and a.active == (0, 1, 4)
+    assert a.rank_owner[2] == 4
+    # Merging an older replica changes nothing (anti-entropy is monotone).
+    old = _dir(eps={0: "h:1"}, epoch=0, active=(0, 1, 2, 3))
+    assert a.merge(old) is False
+    assert a.epoch == 2
+
+
+def test_directory_merge_endpoint_conflict_is_deterministic(caplog):
+    import logging
+
+    from bluefog_tpu.utils.logging import get_logger
+    a = _dir(eps={0: "h:5"})
+    b = _dir(eps={0: "h:2"})
+    log = get_logger()
+    log.addHandler(caplog.handler)  # the package logger does not propagate
+    try:
+        with caplog.at_level(logging.WARNING, logger="bluefog_tpu"):
+            a.merge(b)
+    finally:
+        log.removeHandler(caplog.handler)
+    assert a.endpoints[0] == "h:2"  # lexicographic min, both sides agree
+    b2 = _dir(eps={0: "h:2"})
+    b2.merge(_dir(eps={0: "h:5"}))
+    assert b2.endpoints[0] == "h:2"
+    assert any("conflicting endpoints" in r.message for r in caplog.records)
+
+
+def test_directory_vacant_and_live_endpoints():
+    d = _dir(epoch=1, active=(0, 1, 3))
+    assert d.vacant_ranks() == [2]
+    assert d.live_endpoints() == [("h", 1), ("h", 2), ("h", 4)]
+
+
+def test_directory_persist_load_and_load_any(tmp_path):
+    prefix = str(tmp_path / "gang")
+    a = _dir(epoch=1, active=(0, 1, 3))
+    a.persist(prefix + ".0.json")
+    b = _dir(eps={4: "h:9"}, epoch=2, active=(0, 1, 3, 4),
+             owner={0: 0, 1: 1, 2: 4, 3: 3})
+    b.persist(prefix + ".1.json")
+    assert not os.path.exists(prefix + ".0.json.tmp")  # atomic replace
+    merged = gang.GangDirectory.load_any(prefix)
+    assert merged.epoch == 2            # freshest commit wins
+    assert 4 in merged.endpoints        # endpoints union across replicas
+    assert merged.rank_owner[2] == 4
+    with pytest.raises(FileNotFoundError):
+        gang.GangDirectory.load_any(str(tmp_path / "nope"))
+
+
+def test_directory_load_any_skips_corrupt_replica(tmp_path):
+    prefix = str(tmp_path / "gang")
+    _dir(epoch=1).persist(prefix + ".0.json")
+    with open(prefix + ".1.json", "w") as fh:
+        fh.write("{not json")
+    merged = gang.GangDirectory.load_any(prefix)
+    assert merged.epoch == 1
+
+
+def test_parse_peers():
+    assert gang.parse_peers("h1:10,h2:20") == [("h1", 10), ("h2", 20)]
+    with pytest.raises(ValueError):
+        gang.parse_peers("nocolon")
+    with pytest.raises(ValueError):
+        gang.parse_peers("")
+
+
+# ---------------------------------------------------------------------------
+# Placement-aware admission
+# ---------------------------------------------------------------------------
+
+def test_choose_admission_ranks_without_model_is_lowest_ids():
+    assert gang.choose_admission_ranks([7, 2, 5], 2) == [2, 5]
+    assert gang.choose_admission_ranks([3], 5) == [3]
+
+
+def test_choose_admission_ranks_prices_through_placement_model():
+    """With a live interconnect model, the vacant seat CLOSEST to the
+    active ranks' devices wins — not the lowest id."""
+    from bluefog_tpu.ops import placement
+    model = placement.synthetic_torus((4, 4))  # 16 devices, 4x4 torus
+    placement.set_active(model, None)
+    try:
+        # Active rank on device 0; vacant seats 1 (adjacent) and 10
+        # (diagonally across the torus).
+        d1 = model.distance(1, 0)
+        d10 = model.distance(10, 0)
+        assert d1 < d10  # the oracle the choice must follow
+        picked = gang.choose_admission_ranks([1, 10], 1,
+                                             active_ranks=[0])
+        assert picked == [1]
+        # Equal prices break ties by rank id (deterministic across
+        # processes): devices 1 and 4 are both one hop from 0.
+        assert model.distance(4, 0) == model.distance(1, 0)
+        assert gang.choose_admission_ranks([4, 1], 1,
+                                           active_ranks=[0]) == [1]
+    finally:
+        placement.set_active(None, None)
+
+
+# ---------------------------------------------------------------------------
+# Wire handling / registry
+# ---------------------------------------------------------------------------
+
+def test_handle_wire_drops_garbage_and_without_service():
+    gang.handle_wire(b"not json")       # no service: dropped, no crash
+    gang.handle_wire(b"\xff\xfe junk")  # undecodable: logged, dropped
+    gang.handle_wire(json.dumps({"k": "dir", "dir": {"n_ranks": 4}})
+                     .encode())
+
+
+def test_handle_wire_resolves_join_waiter_without_service():
+    """A joining process has no installed service when its grant lands —
+    the nonce waiter alone must resolve it."""
+    import threading
+    ev = threading.Event()
+    gang._join_waiters["abc"] = [ev, None]
+    try:
+        gang.handle_wire(json.dumps(
+            {"k": "grant", "nonce": "abc", "proc": 4, "ranks": [2],
+             "n_ranks": 4}).encode())
+        assert ev.is_set()
+        assert gang._join_waiters["abc"][1]["proc"] == 4
+    finally:
+        gang._join_waiters.pop("abc", None)
+
+
+def test_grant_decode_round_trip():
+    rows = np.arange(6, dtype=np.float32).reshape(2, 3)
+    import base64
+    msg = {
+        "k": "grant", "proc": 5, "ranks": [2], "epoch": 3,
+        "active": [0, 1, 3], "n_ranks": 4,
+        "rank_owner": {"0": 0, "1": 1, "2": 2, "3": 3},
+        "endpoints": {"0": "h:1", "1": "h:2", "3": "h:4"},
+        "windows": {"w": {"shape": [2, 3], "dtype": "float32",
+                          "rows": {"2": base64.b64encode(
+                              rows.tobytes()).decode()}}},
+    }
+    g = gang._decode_grant(msg, "h:9")
+    assert g.proc == 5 and g.ranks == (2,) and g.epoch == 3
+    assert g.directory.n_ranks == 4
+    np.testing.assert_array_equal(g.windows["w"]["rows"][2], rows)
+
+
+def test_service_summary_and_health(tmp_path):
+    svc = gang.GangService(_dir(epoch=2, active=(0, 1, 3)),
+                           persist_path=str(tmp_path / "g"))
+    gang.install(svc)
+    s = gang.health_summary()
+    assert s["epoch"] == 2 and s["vacant_ranks"] == [2]
+    assert s["active_procs"] == [0, 1, 3]
+    # Surfaced on the operator-facing /healthz body and %bfstat.
+    hz = telemetry.health()
+    assert hz["gang_directory"]["epoch"] == 2
+    from bluefog_tpu.run.cluster_repl import bfstat_text  # noqa: F401
+    svc.persist()
+    snap = telemetry.snapshot()
+    assert snap.get("bf_gang_directory_epoch") == 2.0
+    # With no distrib the replica lands under the bare prefix.
+    assert os.path.exists(str(tmp_path / "g") + ".json")
+    gang.install(None)
+    assert gang.health_summary() is None
+
+
+def test_init_elastic_requires_knob_and_env(monkeypatch):
+    config.reload()
+    with pytest.raises(RuntimeError, match="ELASTIC_JOIN"):
+        gang.init_elastic()
+    monkeypatch.setenv("BLUEFOG_TPU_ELASTIC_JOIN", "1")
+    monkeypatch.delenv("BFTPU_GANG_PEERS", raising=False)
+    config.reload()
+    with pytest.raises(RuntimeError, match="BFTPU_GANG_PEERS"):
+        gang.init_elastic()
+
+
+def test_join_gang_requires_knob():
+    config.reload()
+    with pytest.raises(RuntimeError, match="ELASTIC_JOIN"):
+        gang.join_gang("h:1")
+
+
+# ---------------------------------------------------------------------------
+# Membership integration: grant bookkeeping
+# ---------------------------------------------------------------------------
+
+def test_note_join_validates_rank_claims():
+    from bluefog_tpu.ops import membership as M
+    ctrl = M.MembershipController(
+        4, 0, {r: r for r in range(4)}, send_fn=lambda q, p: None,
+        active=(0, 1, 3), epoch=1)
+    ctrl.note_join(4, (2,), "h:9")
+    assert ctrl.pending_joins[4][0] == (2,)
+    assert ctrl.peer_endpoint_hint(4) == ("h", 9)
+    # A colliding claim from another proc is ignored.
+    ctrl.note_join(5, (2,), "h:10")
+    assert 5 not in ctrl.pending_joins
+    # Claiming a LIVE rank is ignored too.
+    ctrl.note_join(6, (1,), "h:11")
+    assert 6 not in ctrl.pending_joins
+    # Already-active procs can't "join".
+    ctrl.note_join(0, (2,), "h:12")
+
+
+def test_pending_join_expires_when_joiner_dies():
+    from bluefog_tpu.ops import membership as M
+    clock = [0.0]
+    ctrl = M.MembershipController(
+        4, 0, {r: r for r in range(4)}, send_fn=lambda q, p: None,
+        probe_fn=lambda q: True, now_fn=lambda: clock[0],
+        suspect_sec=1.0, active=(0, 1, 3), epoch=1)
+    ctrl.note_join(4, (2,), "h:9")
+    clock[0] = 0.5
+    ctrl.tick()
+    assert 4 in ctrl.pending_joins
+    clock[0] = 2.0  # the joiner never heartbeat: its claim ages out
+    ctrl.tick()
+    assert 4 not in ctrl.pending_joins
+    assert ctrl.epoch == 1  # and no grow epoch ever committed
+
+
+# ---------------------------------------------------------------------------
+# Launcher: --elastic / --join / --grow + gang growth in _wait_gang
+# ---------------------------------------------------------------------------
+
+def test_bfrun_parser_accepts_elastic_flags():
+    from bluefog_tpu.run.run import build_parser
+    a = build_parser().parse_args(
+        ["-np", "4", "--elastic", "--grow", "5", "--gang-dir", "/tmp/g",
+         "python", "x.py"])
+    assert a.elastic and a.grow == 5.0 and a.gang_dir == "/tmp/g"
+    a = build_parser().parse_args(
+        ["-np", "1", "--join", "@/tmp/g", "python", "x.py"])
+    assert a.join == "@/tmp/g"
+
+
+def test_bfrun_rejects_bad_elastic_combos(capsys):
+    from bluefog_tpu.run import run as R
+    assert R.main(["-np", "4", "--join", "h:1", "python", "x.py"]) == 2
+    assert "-np 1" in capsys.readouterr().err
+    assert R.main(["-np", "4", "--grow", "5", "python", "x.py"]) == 2
+    assert "--elastic" in capsys.readouterr().err
+
+
+def test_child_env_elastic_exports(tmp_path):
+    from bluefog_tpu.run import run as R
+    args = R.build_parser().parse_args(
+        ["-np", "2", "--devices-per-proc", "1", "--elastic",
+         "python", "x.py"])
+    env = R._child_env(args, "h:1", 1, gang_peers="h:10,h:11",
+                       gang_dir=str(tmp_path / "g"))
+    assert env["BFTPU_GANG_PEERS"] == "h:10,h:11"
+    assert env["BLUEFOG_TPU_ELASTIC_JOIN"] == "1"
+    assert env["BLUEFOG_TPU_CHURN"] == "1"
+    assert env["BLUEFOG_TPU_GANG_DIR_PATH"] == str(tmp_path / "g")
+    # Every elastic member forges the WHOLE world: 2 procs x 1 device.
+    assert env["BFTPU_LOCAL_DEVICES"] == "2"
+    # A --join child names the world size directly...
+    jargs = R.build_parser().parse_args(
+        ["-np", "1", "--devices-per-proc", "4", "--join", "@/t/g",
+         "python", "x.py"])
+    jenv = R._child_env(jargs, "h:1", 0, join_target="@/t/g")
+    assert jenv["BFTPU_GANG_JOIN"] == "@/t/g"
+    assert jenv["BFTPU_LOCAL_DEVICES"] == "4"
+    # ...while a --grow joiner inherits the gang's world via join_world.
+    genv = R._child_env(args, "h:1", 2, join_target="@/t/g", join_world=2)
+    assert genv["BFTPU_LOCAL_DEVICES"] == "2"
+
+
+class _FakeProc:
+    def __init__(self, rc=None):
+        self.rc = rc
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def terminate(self):
+        pass
+
+    def kill(self):
+        pass
+
+
+def test_wait_gang_supervises_grown_member():
+    """Satellite: _wait_gang tolerates gang GROWTH — a joined process is
+    spawned mid-wait, supervised, and its exit reason reported."""
+    import time as _time
+
+    from bluefog_tpu.run import run as R
+    founder = _FakeProc(None)  # still running when the grow fires
+    entries = [(founder, "127.0.0.1", False)]
+    joined = _FakeProc(None)
+
+    def spawn():
+        entries.append((joined, "127.0.0.1", False))
+        joined.rc = 0   # the joiner finishes clean shortly after
+        founder.rc = 0  # ...and so does the founding rank
+
+    rc = R._wait_gang(entries, ["ssh"], "tag",
+                      grow=[(_time.monotonic() + 0.05, spawn)])
+    assert rc == 0
+    assert len(entries) == 2  # the joined member was supervised
+
+
+def test_wait_gang_skips_grow_after_clean_finish(capsys):
+    """A gang that finished before the scheduled grow has nothing to
+    grow into: the spawn is skipped and the run stays successful."""
+    import time as _time
+
+    from bluefog_tpu.run import run as R
+    entries = [(_FakeProc(0), "127.0.0.1", False)]
+    fired = []
+    rc = R._wait_gang(entries, ["ssh"], "tag",
+                      grow=[(_time.monotonic() + 60.0,
+                             lambda: fired.append(1))])
+    assert rc == 0 and not fired
+    assert "skipping" in capsys.readouterr().err
+
+
+def test_wait_gang_grown_member_failure_kills_gang(capsys):
+    import time as _time
+
+    from bluefog_tpu.run import run as R
+    survivor = _FakeProc(None)
+    entries = [(survivor, "127.0.0.1", False)]
+
+    def spawn():
+        entries.append((_FakeProc(3), "127.0.0.1", False))
+        survivor.rc = 0
+
+    rc = R._wait_gang(entries, ["ssh"], "tag",
+                      grow=[(_time.monotonic(), spawn)])
+    assert rc == 3  # the grown rank's failure is NOT silently ignored
+    assert "rank 1: exit 3" in capsys.readouterr().err
+
+
+def test_wait_gang_failed_grow_spawn_is_fatal(capsys):
+    import time as _time
+
+    from bluefog_tpu.run import run as R
+    entries = [(_FakeProc(None), "127.0.0.1", False)]
+
+    def spawn():
+        raise OSError("no joiner for you")
+
+    rc = R._wait_gang(entries, ["ssh"], "tag",
+                      grow=[(_time.monotonic(), spawn)])
+    assert rc == 1
+    assert "failed to grow" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Satellite: stale owned_ranks.json invalidation for GROWN gangs
+# ---------------------------------------------------------------------------
+
+def _write_map(base, idx, ranks, nproc=None):
+    from bluefog_tpu.utils import elastic
+    d = os.path.join(base, f"proc{idx}")
+    os.makedirs(d, exist_ok=True)
+    body = ranks if nproc is None else {"ranks": ranks, "nproc": nproc}
+    with open(os.path.join(d, elastic._OWNED_FILE), "w") as fh:
+        json.dump(body, fh)
+    return os.path.join(d, elastic._OWNED_FILE)
+
+
+def test_owned_map_parses_both_formats():
+    from bluefog_tpu.utils import elastic
+    assert elastic._parse_owned_map([0, 1]) == ([0, 1], None)
+    assert elastic._parse_owned_map({"ranks": [2], "nproc": 4}) == ([2], 4)
+    assert elastic._parse_owned_map({"ranks": [2]}) == ([2], None)
+
+
+def test_invalidate_owned_ranks_on_growth(tmp_path, caplog):
+    """A resume after a JOIN (3 -> 4 processes) must not resurrect the
+    pre-join ownership maps: surviving dirs stamped nproc=3 are
+    invalidated (renamed .stale + warned), not silently reused."""
+    import logging
+
+    from bluefog_tpu.utils import elastic
+    from bluefog_tpu.utils.logging import get_logger
+    base = str(tmp_path)
+    for i, ranks in enumerate([[0, 1], [2], [3]]):
+        _write_map(base, i, ranks, nproc=3)
+    log = get_logger()
+    log.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.WARNING, logger="bluefog_tpu"):
+            elastic._invalidate_stale_owned_ranks(base, 4)
+    finally:
+        log.removeHandler(caplog.handler)
+    for i in range(3):
+        f = os.path.join(base, f"proc{i}", elastic._OWNED_FILE)
+        assert not os.path.exists(f)
+        assert os.path.exists(f + ".stale")
+    assert any("must not resurrect" in r.message for r in caplog.records)
+
+
+def test_invalidate_owned_ranks_keeps_current_geometry(tmp_path):
+    from bluefog_tpu.utils import elastic
+    base = str(tmp_path)
+    paths = [_write_map(base, i, [i], nproc=2) for i in range(2)]
+    elastic._invalidate_stale_owned_ranks(base, 2)
+    for p in paths:
+        assert os.path.exists(p)  # matching stamp: untouched
+
+
+def test_invalidate_owned_ranks_legacy_files_untouched_below_nproc(
+        tmp_path):
+    """Pre-stamp (bare list) files carry no geometry: within the live
+    process range they are kept (the historical behavior), while dirs
+    beyond the new count are still retired."""
+    from bluefog_tpu.utils import elastic
+    base = str(tmp_path)
+    keep = _write_map(base, 0, [0, 1])           # legacy, idx < nproc
+    drop = _write_map(base, 3, [3], nproc=4)     # beyond the new count
+    elastic._invalidate_stale_owned_ranks(base, 2)
+    assert os.path.exists(keep)
+    assert not os.path.exists(drop)
+    assert os.path.exists(drop + ".stale")
+
+
+def test_owned_rows_of_reads_stamped_maps(tmp_path):
+    from bluefog_tpu.utils import elastic
+    base = str(tmp_path)
+    _write_map(base, 0, [0, 2], nproc=2)
+    _write_map(base, 1, [1, 3], nproc=2)
+    dirs = [os.path.join(base, f"proc{i}") for i in range(2)]
+    assert elastic._owned_rows_of(dirs, 4) == [[0, 2], [1, 3]]
+
+
+# ---------------------------------------------------------------------------
+# Config knobs
+# ---------------------------------------------------------------------------
+
+def test_gang_config_defaults(monkeypatch):
+    cfg = config.reload()
+    assert cfg.elastic_join is False
+    assert cfg.gang_dir_path is None
+    assert cfg.join_timeout_ms == 30000.0
+    monkeypatch.setenv("BLUEFOG_TPU_ELASTIC_JOIN", "1")
+    monkeypatch.setenv("BLUEFOG_TPU_GANG_DIR_PATH", "/tmp/gg")
+    monkeypatch.setenv("BLUEFOG_TPU_JOIN_TIMEOUT_MS", "5000")
+    cfg = config.reload()
+    assert cfg.elastic_join and cfg.gang_dir_path == "/tmp/gg"
+    assert cfg.join_timeout_ms == 5000.0
+
+
+def test_bf_gang_info_export():
+    import bluefog_tpu as bf
+    assert bf.gang_info() is None
+    svc = gang.GangService(_dir(), persist_path=None)
+    gang.install(svc)
+    assert bf.gang_info()["epoch"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Full gang (slow tier; `make chaos-smoke` runs the same harness in CI)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_join_smoke_end_to_end():
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.tools", "chaos",
+         "--join-smoke"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "chaos join OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_kill0_smoke_end_to_end():
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.tools", "chaos",
+         "--kill0-smoke"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "chaos kill-rank-0 OK" in r.stdout
