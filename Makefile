@@ -8,7 +8,7 @@ PYTEST = python -m pytest -q
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
         stripe-smoke tracerec-smoke async-smoke ffi-smoke placement-smoke \
-        synth-smoke hier-smoke chaos-smoke chaos
+        synth-smoke hier-smoke chaos-smoke chaos links-smoke metrics-lint
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -19,7 +19,7 @@ PYTEST = python -m pytest -q
 # every native consumer has a Python fallback).
 test: native test-fast bench-comm-smoke prof-smoke transport-smoke \
       stripe-smoke tracerec-smoke async-smoke ffi-smoke placement-smoke \
-      synth-smoke hier-smoke chaos-smoke
+      synth-smoke hier-smoke chaos-smoke links-smoke metrics-lint
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -177,6 +177,29 @@ chaos-smoke:
 	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --delay-smoke
 	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --join-smoke
 	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --kill0-smoke
+
+# Link-observatory CI gate: a real 4-process `bfrun --chaos` gang on the
+# CPU backend with a `linkdelay:` fault holding one rank's outbound DATA
+# links at +60ms — asserts the online estimator's per-edge delay EWMAs
+# converge on the injected delay on the affected edges while unaffected
+# edges stay flat, measured-vs-modeled divergence crosses the alert
+# threshold, exactly the matching BLUEFOG_TPU_SLO rule fires on the
+# receiver ranks (bf_slo_breaches_total + degraded /healthz links block
+# + one flight-recorder dump) while a co-armed quiet rule stays silent,
+# every rank computes the IDENTICAL merged link matrix
+# (bf.link_report() agreement), and `tools top` renders one complete
+# frame against the live gang's /metrics endpoints.  The second leg
+# pins BLUEFOG_TPU_LINK_OBS=0 through the transport smoke: the
+# off-switch must be bitwise inert (not one bf_link_* series).
+links-smoke:
+	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --links-smoke
+	env BLUEFOG_TPU_LINK_OBS=0 python bench_comm.py --transport-smoke
+
+# Metrics/doc drift gate: AST-scan every bf_* series the package
+# registers against the docs/observability.md inventory, BOTH ways —
+# fails on an undocumented metric or a stale inventory row.
+metrics-lint:
+	python -m bluefog_tpu.tools.metrics_lint
 
 # Full interactive chaos demo (same harness, bigger run; see
 # `python -m bluefog_tpu.tools chaos --help` for kill/delay/partition

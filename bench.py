@@ -234,6 +234,28 @@ def _churn_summary() -> "dict | None":
     }
 
 
+def _links_summary() -> "dict | None":
+    """Link-observatory evidence for BENCH json: the observatory gate,
+    configured SLO rules, and this rank's live link table (per-edge delay
+    EWMA / jitter / divergence, tx goodput) when any traced gossip ran.
+    The single-chip bench's fused step never crosses the DCN window
+    transport, so the table is typically empty here; the block exists so
+    the JSON schema is stable across workloads (multi-proc runs and the
+    chaos links harness are where the edges move), mirroring
+    detail.churn."""
+    from bluefog_tpu.utils import config, linkobs
+    if not config.get().link_obs:
+        return {"enabled": False}
+    rep = linkobs.local_report()
+    return {
+        "enabled": True,
+        "slo_rules": rep["slo"]["rules"],
+        "slo_breached": sorted(rep["slo"]["breached"]),
+        "edges": rep["edges"],
+        "goodput": rep["goodput"],
+    }
+
+
 def _synthesis_summary(devs) -> "dict | None":
     """Modeled schedule-synthesis evidence for BENCH json, matching the
     placement pattern: the flagship STATIC Exp2 gossip schedule priced on
@@ -472,6 +494,7 @@ def main():
             "synthesis": _synthesis_summary(devs),
             "hierarchy": _hierarchy_summary(devs, tree_bytes),
             "churn": _churn_summary(),
+            "links": _links_summary(),
             "telemetry": snap,
         },
     }))

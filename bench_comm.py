@@ -557,6 +557,78 @@ def transport_main(args) -> int:
             failures.append(
                 "tracing leg produced no per-edge delay readout")
 
+    # Link-observatory leg — the same rig, judged on the ONLINE estimator
+    # (utils/linkobs.py).  Two readouts next to detail.tracing:
+    #   (a) the overhead pair: the traced 4 KiB / 8-peer cell with
+    #       BLUEFOG_TPU_LINK_OBS=0 vs 1 — the acceptance bound (<= 2% on
+    #       quiet hardware) is reported, not asserted, on shared CI
+    #       boxes; the OFF cell is asserted bitwise inert (not one
+    #       bf_link_* series), the ON cell must publish tx goodput;
+    #   (b) the flight recorder's per-edge delay samples fed through
+    #       linkobs.note_delay (the loopback rig bypasses the window
+    #       commit path that feeds the estimator in-process), reported
+    #       as the same link table bf.link_report() serves.
+    links_detail = None
+    if native_ok and tracing_detail is not None:
+        from bluefog_tpu.tools import tracegossip
+        from bluefog_tpu.utils import config, flightrec, linkobs, telemetry
+        prev_obs = os.environ.get("BLUEFOG_TPU_LINK_OBS")
+        # The goodput gauge publishes once per >= 0.5 s rate window —
+        # longer than a whole smoke cell.  Shrink the window (read at
+        # call time) so the ON cell publishes deterministically.
+        prev_win = linkobs._GOODPUT_WINDOW_S
+        try:
+            os.environ["BLUEFOG_TPU_LINK_OBS"] = "0"
+            off = _transport_one_mode("native", t_rows, 4096, peers=8,
+                                      trace_every=64)
+            snap = telemetry.snapshot() if telemetry.enabled() else {}
+            inert = not any(k.startswith("bf_link_") for k in snap)
+            if not inert:
+                failures.append(
+                    "BLUEFOG_TPU_LINK_OBS=0 leg still published bf_link_* "
+                    "series (the off-switch is not bitwise inert)")
+            os.environ["BLUEFOG_TPU_LINK_OBS"] = "1"
+            linkobs._GOODPUT_WINDOW_S = 0.02
+            on = _transport_one_mode("native", t_rows, 4096, peers=8,
+                                     trace_every=64)
+            snap = telemetry.snapshot() if telemetry.enabled() else {}
+            if not any(k.startswith("bf_link_goodput_bytes")
+                       for k in snap):
+                failures.append(
+                    "link observatory armed but the tx path published no "
+                    "bf_link_goodput_bytes series")
+            linkobs.reset()
+            delays = tracegossip.edge_delays(
+                [{"rank": 0, "offset_us": 0,
+                  "events": flightrec.snapshot()}])
+            for (s, d), samples in sorted(delays.items()):
+                for us in samples:
+                    linkobs.note_delay(int(s), int(d), float(us))
+            rep = linkobs.local_report()
+            if not rep.get("edges"):
+                failures.append(
+                    "link observatory produced no edge table from the "
+                    "recorder's delay samples")
+            links_detail = {
+                "overhead_cell": {
+                    "row_bytes": 4096, "peers": 8, "sample_every": 64,
+                    "off_msgs_per_s": off["msgs_per_s"],
+                    "on_msgs_per_s": on["msgs_per_s"],
+                    "ratio": round(on["msgs_per_s"]
+                                   / max(off["msgs_per_s"], 1e-9), 3),
+                    "off_inert": inert,
+                },
+                "report": rep,
+            }
+        finally:
+            linkobs._GOODPUT_WINDOW_S = prev_win
+            linkobs.reset()
+            if prev_obs is None:
+                os.environ.pop("BLUEFOG_TPU_LINK_OBS", None)
+            else:
+                os.environ["BLUEFOG_TPU_LINK_OBS"] = prev_obs
+            config.reload()
+
     rc = 0
     for f in failures:
         print(f"bench_comm --transport: {f}", file=sys.stderr)
@@ -583,6 +655,7 @@ def transport_main(args) -> int:
             "ffi_dispatch_speedup": ffi_value,
             "ffi": ffi_detail,
             "tracing": tracing_detail,
+            "links": links_detail,
         },
     }))
     return rc

@@ -147,6 +147,10 @@ from bluefog_tpu.utils.telemetry import telemetry_snapshot  # noqa: F401
 # in-memory event ring to flightrec.<rank>.bin — the gossip black box
 # `python -m bluefog_tpu.tools trace-gossip` merges across ranks.
 from bluefog_tpu.utils.flightrec import dump as flight_recorder_dump  # noqa: F401,E501
+# Link observatory (BLUEFOG_TPU_LINK_OBS): the cluster-wide measured
+# link matrix — per-edge delay/jitter/divergence plus the hot edge —
+# assembled over the aggregate-snapshot collective (call on all ranks).
+from bluefog_tpu.utils.linkobs import link_report  # noqa: F401
 # Elastic scale-up / coordinator-free bootstrap (BLUEFOG_TPU_ELASTIC_JOIN):
 # bf.gang.init_elastic() / bf.gang.join_gang() — see docs/operations.md
 # "Growing the gang".

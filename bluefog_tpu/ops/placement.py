@@ -65,6 +65,7 @@ __all__ = [
     "optimize_placement",
     "set_active",
     "active",
+    "predicted_edge_cost",
     "modeled_schedule_hops",
 ]
 
@@ -709,6 +710,30 @@ def set_active(model: Optional[TorusModel],
 
 def active() -> Optional[Tuple[TorusModel, Optional[np.ndarray]]]:
     return _active
+
+
+def predicted_edge_cost(src: int, dst: int) -> float:
+    """The active model's predicted RELATIVE cost for the directed edge
+    ``src -> dst`` — what the link observatory prices measured one-way
+    delay against (``bf_link_divergence_ratio``).  Uniform 1.0 when no
+    model is active (CPU gangs, pre-init): divergence then degrades to
+    measured-vs-fastest-link, which is exactly the right alert for a
+    modelless run.  Clamped to >= 1.0 — a zero-cost edge (same chip)
+    must not make the divergence ratio blow up on wire overhead."""
+    with _active_lock:
+        act = _active
+    if act is None:
+        return 1.0
+    model, perm = act
+    n = len(model.device_node)
+    s, d = int(src), int(dst)
+    if not (0 <= s < n and 0 <= d < n):
+        return 1.0
+    if perm is not None:
+        s, d = int(perm[s]), int(perm[d])
+    cost = model.distance(int(model.device_node[s]),
+                          int(model.device_node[d]))
+    return max(float(cost), 1.0)
 
 
 def modeled_schedule_hops(sched) -> Optional[float]:

@@ -696,6 +696,12 @@ class WindowTransport:
         # Peers declared unreachable by chaos fault injection: sends fail
         # immediately, nothing rides the wire (set_partition).
         self._partitioned: frozenset = frozenset()
+        # Chaos link-delay fault (set_send_delay): seconds slept before
+        # each DATA enqueue, landing between the window layer's trace-tag
+        # stamp and the wire — so the observatory measures it as one-way
+        # delay, exactly like a slow link.  0.0 (always, outside chaos)
+        # is one float truthiness check on the send path.
+        self._send_delay = 0.0
         self._senders: Dict[Tuple[str, int, int], _PeerSender] = {}
         self._senders_lock = threading.Lock()
         # Cumulative coalescing stats behind one lock: sender workers on
@@ -787,6 +793,13 @@ class WindowTransport:
             # the fence/mutex fan-out (ops/window.py), which must address
             # every stripe of a peer.
             stripe = stripe_for(name, src, op, self.n_stripes)
+        if self._send_delay and (op & ~OP_FLAG_MASK) in _DATA_OPS:
+            # Data ops only: heartbeats, fences, mutex and gang traffic
+            # must never be delayed — a chaos link-delay fault models a
+            # slow DATA link, not a dead control plane (delaying
+            # membership heartbeats would turn every delay experiment
+            # into a churn-suspicion experiment).
+            time.sleep(self._send_delay)
         if self._tx is not None:
             # Native fast path: ONE ctypes call — enqueue onto the C++
             # per-peer queue (blocking backpressure in C, GIL released).
@@ -907,6 +920,13 @@ class WindowTransport:
             csv = ",".join(f"{h}:{p}" for h, p in sorted(self._partitioned))
             self._lib.bf_wintx_set_partition(self._tx, csv.encode())
 
+    def set_send_delay(self, seconds: float) -> None:
+        """Chaos link-delay fault: sleep ``seconds`` before every DATA
+        enqueue (control ops never delayed), so the link observatory
+        measures it as real per-edge one-way delay.  0.0 heals the
+        fault and restores the undelayed send path."""
+        self._send_delay = max(0.0, float(seconds))
+
     def drop_peer(self, host: str, port: int) -> None:
         """Retire EVERY stripe of a peer's sender cleanly (churn
         controller: the peer is dead by consensus).  Queued messages to it
@@ -917,7 +937,10 @@ class WindowTransport:
         retrying into closed sockets or stale gauge series behind.
         Idempotent; a later send to the same address would lazily create
         fresh stripe senders (peer restart)."""
-        from bluefog_tpu.utils import telemetry
+        from bluefog_tpu.utils import linkobs, telemetry
+        # Same orphan-series hygiene for the link observatory: the dead
+        # peer's goodput/retry-rate gauges are claims about a live wire.
+        linkobs.clear_peer(f"{host}:{port}")
         if self._tx is not None:
             # Same retirement on the native queues (churn supervisor
             # follow-up): every stripe's C++ worker exits instead of
@@ -1086,7 +1109,7 @@ class WindowTransport:
         peer per op would cost a meaningful slice of the zero-copy
         dispatch budget for series that only need scrape-rate freshness.
         ``stop()`` forces a final pump so nothing is lost."""
-        from bluefog_tpu.utils import telemetry
+        from bluefog_tpu.utils import linkobs, telemetry
         tx = self._tx if tx is None else tx
         if tx is None or not telemetry.enabled():
             return
@@ -1160,6 +1183,10 @@ class WindowTransport:
                     if d:
                         telemetry.inc("bf_win_tx_stripe_bytes_total",
                                       float(d), peer=peer, stripe=str(k))
+                        # Same diff feeds the link observatory's goodput
+                        # estimator — the pump's flush-boundary cadence
+                        # is exactly its windowing granularity.
+                        linkobs.note_tx(peer, k, float(d))
                     telemetry.set_gauge("bf_win_tx_queue_depth",
                                         float(ss.queue_len), peer=peer,
                                         stripe=str(k))
@@ -1218,11 +1245,13 @@ class WindowTransport:
         """Worker-side: ship a drained queue as ONE native send (an
         OP_BATCH frame), or as the plain single frame when only one message
         coalesced (no container overhead, bit-identical legacy wire)."""
-        from bluefog_tpu.utils import telemetry
+        from bluefog_tpu.utils import linkobs, telemetry
         if telemetry.enabled():
             telemetry.inc("bf_win_tx_stripe_bytes_total",
                           float(sum(len(m[6]) for m in batch)),
                           peer=f"{host}:{port}", stripe=str(stripe))
+        linkobs.note_tx(f"{host}:{port}", stripe,
+                        float(sum(len(m[6]) for m in batch)))
         frame_op = batch[0][0] if len(batch) == 1 else OP_BATCH
         if flightrec.enabled():
             flightrec.note(flightrec.FLUSH, op=frame_op, stripe=stripe,

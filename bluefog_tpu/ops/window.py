@@ -74,7 +74,7 @@ from bluefog_tpu.ops.transport import (  # noqa: E402
     OP_FENCE_ACK, OP_MUTEX_ACQ, OP_MUTEX_GRANT, OP_MUTEX_REL, OP_MEMBER,
     OP_GANG, OP_BF16_FLAG, OP_SPARSE_FLAG, OP_TRACE_FLAG, OP_FLAG_MASK,
     make_trace_tag, trace_strip, sparse_encode, sparse_decode)
-from bluefog_tpu.utils import flightrec  # noqa: E402
+from bluefog_tpu.utils import flightrec, linkobs  # noqa: E402
 # Zero-copy XLA put path (BLUEFOG_TPU_WIN_XLA): plan-compiled dispatch of
 # remote put edges straight from the device buffer into the native
 # per-peer arenas, plus the host-staging-copy accounting helpers.
@@ -315,6 +315,7 @@ def _shutdown_transport() -> None:
         _gang.install(None)
         clear_contribution_age()
         clear_async_staleness()
+        linkobs.clear_all()
 
 
 def _to_numpy(x) -> np.ndarray:
@@ -545,12 +546,14 @@ _age_lock = threading.Lock()
 _age_minmax: Dict[int, list] = {}
 
 
-def _note_trace_commit(name: str, src: int, tag) -> None:
+def _note_trace_commit(name: str, src: int, tag, dst: int = -1) -> None:
     """One tagged contribution reached its staging slot: record its age
     (receiver wall clock minus the tag's origin wall clock — NTP-grade
     across hosts, exact on one host) into the per-src histogram + the
-    freshest/stalest gauges, and give the flight recorder its COMMIT
-    event so the tag's chain ends where the state changed."""
+    freshest/stalest gauges, feed the link observatory's per-edge delay
+    estimator (``dst`` = the receiving rank, when the caller knows it),
+    and give the flight recorder its COMMIT event so the tag's chain
+    ends where the state changed."""
     import time as _time
     from bluefog_tpu.utils import telemetry
     if _async.armed and len(tag) > 4 and tag[4] >= 0:
@@ -564,6 +567,7 @@ def _note_trace_commit(name: str, src: int, tag) -> None:
     if flightrec.enabled():
         flightrec.note(flightrec.COMMIT, src=tag[0], dst=src, seq=tag[1],
                        name=name)
+    linkobs.note_commit(src, dst, tag)
     if not telemetry.enabled():
         return
     age = max(0.0, (_time.time_ns() // 1000 - tag[3]) / 1e6)
@@ -684,6 +688,10 @@ def set_async_step(step: int) -> None:
                 else 0.9 * _async.step_period + 0.1 * dt
     from bluefog_tpu.ops import transport as _transport
     _transport.set_trace_origin_step(step)
+    # Step boundary: the link observatory refreshes divergence/rates and
+    # evaluates SLO rules here (sync loops get the same tick from the
+    # churn supervisor; calling both is harmless — breaches are latched).
+    linkobs.on_step(step)
 
 
 def async_step_lag() -> int:
@@ -1354,7 +1362,7 @@ def _apply_inbound(op: int, name: str, src: int, dst: int, weight: float,
             if stale_action is not None:
                 _note_stale(name, [(src, stale_action)])
             if tag is not None:
-                _note_trace_commit(name, src, tag)
+                _note_trace_commit(name, src, tag, dst)
     elif op == OP_GET_REQ:
         _store.svc_pool.submit(_reply_get, name, src, dst, weight)
     elif op == OP_GET_REPLY:
@@ -1537,10 +1545,10 @@ def _commit_native_run(name: str, entries) -> None:
                         _divert_stale(win, key, row, p_mass, keep)
                         stale_noted.append((src, action))
                 if trace is not None:
-                    noted.append((src, trace))
+                    noted.append((src, dst, trace))
     _note_stale(name, stale_noted)
-    for src, tag in noted:  # outside win.lock: telemetry is not state
-        _note_trace_commit(name, src, tag)
+    for src, dst_r, tag in noted:  # outside win.lock: not state
+        _note_trace_commit(name, src, tag, dst_r)
 
 
 def _apply_data_run(name: str, group) -> None:
@@ -1637,10 +1645,10 @@ def _apply_data_run(name: str, group) -> None:
                         _divert_stale(win, key, scaled, p_mass, keep)
                         stale_noted.append((key[1], action))
                 if tag is not None:
-                    noted.append((key[1], tag))
+                    noted.append((key[1], key[0], tag))
     _note_stale(name, stale_noted)
-    for src, tag in noted:  # outside win.lock: telemetry is not state
-        _note_trace_commit(name, src, tag)
+    for src, dst_r, tag in noted:  # outside win.lock: not state
+        _note_trace_commit(name, src, tag, dst_r)
 
 
 def _neighbors_from_topology():

@@ -132,6 +132,24 @@ def bfstat_text() -> str:
             f"collect every {a['collect_every']}"
             + (f"; stale rejected {rej:g}" if rej else "")
             + (f", downweighted {dwn:g}" if dwn else ""))
+    links = health.get("links")
+    if links:
+        # Link observatory (utils/linkobs.py): the worst measured edge,
+        # how far reality has diverged from the placement model, and the
+        # SLO engine's verdict — the line an operator reads to tell "a
+        # link is slow" from "a rank is slow".
+        slo = links.get("slo", {})
+        lines.append(
+            f"[bfstat] links: {links.get('edges', 0)} edge(s)"
+            + (f", worst {links['worst_edge']} "
+               f"({links['worst_delay_us']:.0f} us)"
+               if links.get("worst_edge") else "")
+            + (f", max divergence x{links['max_divergence_ratio']:.2f}"
+               if links.get("max_divergence_ratio") is not None else "")
+            + (f"; SLO BREACHED: {', '.join(slo['breached'])}"
+               if slo.get("breached") else
+               (f"; SLO ok ({len(slo['rules'])} rule(s))"
+                if slo.get("rules") else "")))
     straggler = health.get("straggler")
     if straggler:
         slow = straggler["slowest_rank"]

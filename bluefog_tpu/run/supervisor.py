@@ -200,6 +200,11 @@ class ChurnSupervisor:
         must not race the training loop's own window ops."""
         self.ctrl.note_step(step)
         self.chaos.apply(step)
+        # Step-boundary tick for the link observatory: divergence/rate
+        # refresh + SLO evaluation (async loops also tick it through
+        # set_async_step — harmless, breaches are latched).
+        from bluefog_tpu.utils import linkobs
+        linkobs.on_step(step)
         view = self.ctrl.poll_change()
         if view is None:
             return None
@@ -280,6 +285,8 @@ class ChurnSupervisor:
         # or its per-src stale-rejection counters survive it.
         self._W.clear_contribution_age(dead_ranks)
         self._W.clear_async_staleness(dead_ranks)
+        from bluefog_tpu.utils import linkobs
+        linkobs.clear_edges(dead_ranks)
         W = self._W
         snaps: Dict[str, dict] = {}
         for name in W.get_current_created_window_names():

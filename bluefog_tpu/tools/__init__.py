@@ -34,7 +34,8 @@ step the reference never had:
       outer cadence and codec.  Pure host math — no accelerator, no
       mesh, no bf.init() required.
 
-  python -m bluefog_tpu.tools trace-gossip <prefix> [-o merged.json]
+  python -m bluefog_tpu.tools trace-gossip <prefix> [-o merged.json] \
+          [--json]
       Merge per-rank flight-recorder dumps (``flightrec.<rank>.bin``,
       written by ``BLUEFOG_TPU_FLIGHT_RECORDER`` on fatal transport
       errors / churn events or by ``bf.flight_recorder_dump()``) into
@@ -42,9 +43,21 @@ step the reference never had:
       each dump's clock anchor, with a cross-rank FLOW ARROW per
       sampled wire trace tag (``BLUEFOG_TPU_TRACE_SAMPLE``) — follow
       one put from the sender's enqueue to the receiver's decode.
-      Also prints the per-edge one-way-delay p50/p99 table.  Pure host
-      math over the dump files (``tools/tracegossip.py``); runs on
-      whatever survived a chaos kill.
+      Also prints the per-edge one-way-delay p50/p99 table; ``--json``
+      emits the stats and the same edge table as one machine-readable
+      JSON document instead.  Pure host math over the dump files
+      (``tools/tracegossip.py``); runs on whatever survived a chaos
+      kill.
+
+  python -m bluefog_tpu.tools top --endpoints host:port,... | \
+          --gang-dir <prefix> [--telemetry-base PORT]
+      Live fleet dashboard (``tools/top.py``): poll every rank's
+      ``/metrics`` + ``/healthz`` each interval and render per-rank
+      status / async lag / queue depth / straggler score / SLO state,
+      the merged cluster link matrix (the link observatory's
+      ``bf_link_*`` gauges, hot edge marked), membership and the
+      stalest contribution — one refresh-loop terminal frame, no
+      curses.  ``--once`` renders a single frame for scripts and CI.
 
   python -m bluefog_tpu.tools chaos [--np 4] [--kill-rank K] [--smoke]
       Chaos harness for the churn controller (``tools/chaos.py``): launch
@@ -434,6 +447,10 @@ def main(argv=None) -> int:
         # bfrun re-enters); delegate before the subparser dispatch.
         from bluefog_tpu.tools.chaos import main as chaos_main
         return chaos_main(argv[1:])
+    if argv and argv[0] == "top":
+        # Same delegation: the dashboard owns its flag surface.
+        from bluefog_tpu.tools.top import main_top
+        return main_top(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m bluefog_tpu.tools", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -459,6 +476,9 @@ def main(argv=None) -> int:
                          "run used (dumps are <prefix>.<rank>.bin)")
     pg.add_argument("-o", "--output", default=None,
                     help="output path (default <prefix>.merged.json)")
+    pg.add_argument("--json", action="store_true",
+                    help="emit stats + the per-edge delay table as one "
+                         "machine-readable JSON document on stdout")
     # Listed for --help only; the real dispatch happens above (the chaos
     # harness owns its own flag surface, including the bfrun-launched
     # --worker mode).
@@ -466,6 +486,11 @@ def main(argv=None) -> int:
         "chaos", add_help=False,
         help="churn-controller chaos harness: kill a gang rank mid-gossip "
              "under bfrun --chaos and assert survivor-only recovery")
+    sub.add_parser(
+        "top", add_help=False,
+        help="live fleet dashboard: poll every rank's /metrics + /healthz "
+             "and render the link matrix, stragglers, SLO state and "
+             "membership in one refreshing terminal frame")
     pd = sub.add_parser(
         "schedule-dump",
         help="compiled-schedule pipeline report (provenance, rounds, "
@@ -513,7 +538,8 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "trace-gossip":
         from bluefog_tpu.tools.tracegossip import main_trace_gossip
-        return main_trace_gossip(args.prefix, args.output)
+        return main_trace_gossip(args.prefix, args.output,
+                                 as_json=args.json)
     if args.cmd == "trace-merge":
         out = trace_merge(args.prefix, args.output)
         events, _ = load_trace_events(out)

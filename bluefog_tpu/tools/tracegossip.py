@@ -39,7 +39,7 @@ import numpy as np
 from bluefog_tpu.utils import flightrec
 
 __all__ = ["dump_files", "load_dumps", "edge_delays", "delay_table",
-           "merge_gossip"]
+           "edge_delay_records", "merge_gossip"]
 
 # Sender-side chain start and receiver-side chain end of one tagged
 # message, for flow arrows and the delay table.
@@ -140,6 +140,21 @@ def delay_table(delays: Dict[Tuple[int, int], np.ndarray]) -> str:
     return "\n".join(lines)
 
 
+def edge_delay_records(delays: Dict[Tuple[int, int], np.ndarray]) \
+        -> List[dict]:
+    """The delay table as machine-readable rows (``--json``): one dict
+    per directed edge, same edges and the same ms percentiles as
+    :func:`delay_table` — what CI and ``bench_comm.py`` diff against the
+    link observatory's ONLINE estimates."""
+    out = []
+    for (src, dst), d in delays.items():
+        p50, p99 = np.percentile(d, [50, 99])
+        out.append({"src": int(src), "dst": int(dst), "tags": int(len(d)),
+                    "p50_ms": float(p50 / 1e3), "p99_ms": float(p99 / 1e3),
+                    "max_ms": float(d.max() / 1e3)})
+    return out
+
+
 def merge_gossip(prefix: str, out_path: Optional[str] = None,
                  dumps: Optional[List[dict]] = None) -> Tuple[str, dict]:
     """Merge the dumps under ``prefix`` into one chrome trace with a
@@ -207,13 +222,23 @@ def merge_gossip(prefix: str, out_path: Optional[str] = None,
     return out_path, stats
 
 
-def main_trace_gossip(prefix: str, out_path: Optional[str] = None) -> int:
+def main_trace_gossip(prefix: str, out_path: Optional[str] = None,
+                      as_json: bool = False) -> int:
     dumps = load_dumps(prefix)
     out, stats = merge_gossip(prefix, out_path, dumps=dumps)
+    delays = edge_delays(dumps)
+    if as_json:
+        # Machine-readable mode: stdout is EXACTLY one JSON document
+        # (json.loads round-trips the whole output), same edges as the
+        # text table.
+        print(json.dumps({"trace": out, "stats": stats,
+                          "edges": edge_delay_records(delays)},
+                         indent=2, sort_keys=True))
+        return 0
     print(f"trace-gossip: wrote {out} ({stats['events']} events, "
           f"{len(stats['ranks'])} rank lane(s), "
           f"{stats['flows_matched']}/{stats['tags_sent']} trace tag(s) "
           "matched into flow arrows)")
     print()
-    print(delay_table(edge_delays(dumps)))
+    print(delay_table(delays))
     return 0

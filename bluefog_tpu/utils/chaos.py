@@ -24,6 +24,15 @@ Spec grammar (comma-separated faults, each ``kind:key=val:...``):
       green while heartbeats go silent, exercising the hard-silence
       detection path.
 
+  ``linkdelay:rank=K:step=N[:steps=M][:ms=D]``
+      Rank K's outbound DATA sends each sleep D ms (default 60) for steps
+      N..N+M-1 (default M=10) — a slow LINK, not a slow rank: the sleep
+      lands between the window layer's trace-tag stamp and the wire, so
+      the link observatory (utils/linkobs.py) measures it as real one-way
+      delay on every edge out of K, while control traffic (heartbeats,
+      fences, membership) is never delayed and churn suspicion stays
+      quiet.
+
 The launcher side (``run/run.py``) uses :func:`killed_ranks` to know which
 rank deaths are EXPECTED — a chaos-killed rank's exit must not trigger the
 normal any-failure-kills-the-gang policy, or there would be no survivors
@@ -40,15 +49,16 @@ from typing import List, Optional
 
 __all__ = ["Fault", "parse_chaos", "killed_ranks", "ChaosInjector"]
 
-_KINDS = ("kill", "delay", "partition")
+_KINDS = ("kill", "delay", "partition", "linkdelay")
 _DEFAULTS = {"delay": {"steps": 10, "ms": 200.0},
              "partition": {"steps": 20},
+             "linkdelay": {"steps": 10, "ms": 60.0},
              "kill": {}}
 
 
 @dataclass(frozen=True)
 class Fault:
-    kind: str           # kill | delay | partition
+    kind: str           # kill | delay | partition | linkdelay
     rank: int           # global rank the fault targets
     step: int           # first step the fault is active
     steps: int = 1      # how many consecutive steps it stays active
@@ -120,9 +130,11 @@ class ChaosInjector:
         # Every peer (host, port) — the partition fault drops the lot.
         self.peer_addrs = list(peer_addrs or [])
         self._partitioned = False
+        self._link_delay_ms = 0.0
 
     def apply(self, step: int) -> None:
         partition_now = False
+        link_delay_ms = 0.0
         for f in self.faults:
             if f.kind == "kill" and f.step == step:
                 from bluefog_tpu.utils.logging import get_logger
@@ -136,6 +148,17 @@ class ChaosInjector:
                 time.sleep(f.ms / 1e3)
             elif f.kind == "partition" and f.active_at(step):
                 partition_now = True
+            elif f.kind == "linkdelay" and f.active_at(step):
+                link_delay_ms = max(link_delay_ms, f.ms)
+        if self.transport is not None and \
+                link_delay_ms != self._link_delay_ms:
+            self.transport.set_send_delay(link_delay_ms / 1e3)
+            self._link_delay_ms = link_delay_ms
+            from bluefog_tpu.utils.logging import get_logger
+            get_logger().warning(
+                "chaos: outbound data-link delay %s at step %d",
+                f"{link_delay_ms:.0f} ms ENGAGED" if link_delay_ms
+                else "healed", step)
         if self.transport is not None and partition_now != self._partitioned:
             self.transport.set_partition(
                 self.peer_addrs if partition_now else None)

@@ -34,6 +34,8 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_FLIGHT_RECORDER   | 0     | 1: record transport events (enqueue/flush/sendmsg/drain/decode/fold/commit) into the native in-memory ring, dumped to flightrec.<rank>.bin on fatal transport error / eviction / bf.flight_recorder_dump() |
 | BLUEFOG_TPU_FLIGHT_RECORDER_EVENTS | 65536 | flight-recorder ring capacity (events; oldest overwritten) |
 | BLUEFOG_TPU_FLIGHT_RECORDER_PATH | flightrec | dump path prefix (files are <prefix>.<rank>.bin) |
+| BLUEFOG_TPU_LINK_OBS          | 1     | 0: disable the link observatory (utils/linkobs.py) — no per-edge delay/jitter/goodput/divergence estimation, no SLO evaluation, bitwise inert |
+| BLUEFOG_TPU_SLO               | unset | declarative SLO rules, `<metric><op><value>` joined by `;` (e.g. `link_delay_us>50000;step_lag>128`); evaluated at step boundaries, breaches degrade /healthz + bump bf_slo_breaches_total + dump the flight recorder |
 | BLUEFOG_TPU_CHURN             | 0     | 1: enable the elastic-gossip churn controller |
 | BLUEFOG_TPU_CHURN_HEARTBEAT_MS | 250  | membership heartbeat period |
 | BLUEFOG_TPU_CHURN_SUSPECT_MS  | 1500  | heartbeat silence before a peer is suspected |
@@ -185,6 +187,16 @@ def _validated_sketch(value: str) -> str:
     return value
 
 
+def _validated_slo(value: Optional[str]) -> Optional[str]:
+    if value is None or not value.strip():
+        return None
+    # Lazy import: linkobs owns the SLO grammar (module-level would
+    # cycle: linkobs imports config for its own gate).
+    from bluefog_tpu.utils.linkobs import parse_slo_rules
+    parse_slo_rules(value)  # raises on malformed input — fail at init,
+    return value            # not silently-never-alert during an incident
+
+
 def _parse_trace_sample(raw: Optional[str]) -> int:
     """``BLUEFOG_TPU_TRACE_SAMPLE`` parser: ``"1/N"`` (the documented
     spelling) or a plain integer period ``N`` both mean "tag every Nth
@@ -332,6 +344,15 @@ class Config:
     flight_recorder: bool
     flight_recorder_events: int
     flight_recorder_path: str
+    # Link observatory (utils/linkobs.py): online per-edge delay/jitter/
+    # goodput/divergence estimation off the trace-tag commit path and the
+    # tx stats pump, plus the declarative SLO engine.  ON by default —
+    # when the trace sampler is off it merely never receives a sample;
+    # =0 is bitwise inert (no flag, no registry mutation anywhere).
+    link_obs: bool
+    # SLO rule spec ("<metric><op><value>;..."), validated at init by
+    # linkobs.parse_slo_rules; None = no rules, the engine never runs.
+    slo: Optional[str]
     # Elastic-gossip churn controller (ops/membership.py +
     # run/supervisor.py); OFF by default — with churn=0 no membership
     # state exists, no heartbeat is ever sent and every code path is
@@ -476,6 +497,8 @@ class Config:
                 "BLUEFOG_TPU_FLIGHT_RECORDER_EVENTS", "65536")),
             flight_recorder_path=os.environ.get(
                 "BLUEFOG_TPU_FLIGHT_RECORDER_PATH", "flightrec"),
+            link_obs=_flag("BLUEFOG_TPU_LINK_OBS", default=True),
+            slo=_validated_slo(os.environ.get("BLUEFOG_TPU_SLO")),
             churn=_flag("BLUEFOG_TPU_CHURN"),
             churn_heartbeat_ms=float(os.environ.get(
                 "BLUEFOG_TPU_CHURN_HEARTBEAT_MS", "250")),

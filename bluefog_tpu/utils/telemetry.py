@@ -577,6 +577,19 @@ def health() -> dict:
         gd = None
     if gd is not None:
         body["gang_directory"] = gd
+    # Link observatory (utils/linkobs.py): worst measured edge, max
+    # measured-vs-modeled divergence, and the SLO engine's state.  A
+    # latched SLO breach degrades /healthz — that IS the alert contract.
+    # Absent entirely when BLUEFOG_TPU_LINK_OBS=0 or nothing observed.
+    try:
+        from bluefog_tpu.utils import linkobs
+        links = linkobs.health_summary()
+    except Exception:  # noqa: BLE001 — health must render regardless
+        links = None
+    if links is not None:
+        body["links"] = links
+        if links.get("slo", {}).get("breached"):
+            body["status"] = "degraded"
     probe = stall._peer_probe
     if probe is not None:
         try:

@@ -1,0 +1,516 @@
+"""Link observatory (utils/linkobs.py), SLO engine, linkdelay chaos
+fault, trace-gossip --json and the tools-top dashboard.
+
+Covers the tentpole's contract surface:
+  * BLUEFOG_TPU_LINK_OBS=0 => bitwise inert: no note_* site mutates the
+    registry or the module state, on_step never evaluates;
+  * delay/jitter EWMA math, min-normalized measured-vs-modeled
+    divergence, and the bf_link_* gauge surface;
+  * the SLO grammar (good/bad specs, the metric vocabulary), breach
+    latch + bf_slo_breaches_total + degraded /healthz links block +
+    recovery;
+  * report_from_snapshot / merge_link_snapshots purity and cross-rank
+    agreement (the bf.link_report() claim, collective-free);
+  * churn/shutdown hygiene: clear_edges / clear_peer / clear_all retire
+    every published series;
+  * the linkdelay fault: spec parse defaults + ChaosInjector engage/heal
+    + the transport sleeping on DATA ops only;
+  * tools trace-gossip --json round-trip (json.loads, same edges as the
+    text table) and tools top parse/render (pure frame).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.ops import transport as T
+from bluefog_tpu.tools import tracegossip
+from bluefog_tpu.tools import top as topmod
+from bluefog_tpu.utils import chaos as uchaos
+from bluefog_tpu.utils import config, flightrec, linkobs, telemetry
+
+
+@pytest.fixture
+def link_env(monkeypatch):
+    """Set knobs + reload config; linkobs and the registry start and end
+    clean."""
+    def set_env(**kv):
+        for k, v in kv.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, str(v))
+        config.reload()
+    telemetry.reset()
+    linkobs.reset()
+    yield set_env
+    linkobs.reset()
+    telemetry.reset()
+    config.reload()
+
+
+def _link_series():
+    return {k: v for k, v in telemetry.snapshot().items()
+            if k.startswith(("bf_link_", "bf_slo_"))}
+
+
+# ---------------------------------------------------------------------------
+# Off-switch: bitwise inert
+# ---------------------------------------------------------------------------
+
+def test_link_obs_off_is_inert(link_env):
+    link_env(BLUEFOG_TPU_LINK_OBS="0",
+             BLUEFOG_TPU_SLO="link_delay_us>=1")
+    assert not linkobs.enabled()
+    now_us = 1_000_000
+    linkobs.note_commit(1, 0, (1, 7, 0, now_us - 5_000, 3))
+    linkobs.note_delay(2, 0, 60000.0)
+    linkobs.note_tx("h:1", 0, 1e6)
+    linkobs.on_step(5)
+    assert telemetry.snapshot() == {}
+    assert not linkobs._edges and not linkobs._tx
+    # The armed rule never evaluated: nothing latched, no counter.
+    assert linkobs.slo_state() == {"rules": [], "breached": {}}
+    assert linkobs.health_summary() is None
+
+
+def test_link_obs_on_by_default(link_env):
+    link_env(BLUEFOG_TPU_LINK_OBS=None)
+    assert linkobs.enabled()
+
+
+# ---------------------------------------------------------------------------
+# Estimator math: EWMA, jitter, divergence
+# ---------------------------------------------------------------------------
+
+def test_delay_ewma_and_gauges(link_env):
+    link_env()
+    for _ in range(40):
+        linkobs.note_delay(3, 0, 60000.0)
+    snap = telemetry.snapshot()
+    # 0.8^39 ~ 1.7e-4: fully converged on the injected delay.
+    assert snap['bf_link_delay_us{dst="0",src="3"}'] == \
+        pytest.approx(60000.0, rel=0.01)
+    # Constant samples -> jitter decays toward 0.
+    assert snap['bf_link_jitter_us{dst="0",src="3"}'] < 1000.0
+    assert any(k.startswith("bf_link_delay_seconds_bucket") for k in snap)
+    pct = telemetry.histogram_percentiles(
+        "bf_link_delay_seconds", qs=(50.0,), src="3", dst="0")
+    assert pct is not None and 0.01 < pct[50.0] < 0.1
+
+
+def test_divergence_min_normalized(link_env):
+    """One slow edge against uniform predictions reads ~k x the fastest
+    edge; healthy edges sit at ~1.0 (no placement model here => uniform
+    predicted cost)."""
+    link_env()
+    for _ in range(40):
+        linkobs.note_delay(1, 0, 500.0)
+        linkobs.note_delay(2, 0, 520.0)
+        linkobs.note_delay(3, 0, 60000.0)
+    snap = telemetry.snapshot()
+    hot = snap['bf_link_divergence_ratio{dst="0",src="3"}']
+    assert hot > linkobs.DIVERGENCE_ALERT
+    assert hot == pytest.approx(120.0, rel=0.1)
+    assert snap['bf_link_divergence_ratio{dst="0",src="1"}'] == \
+        pytest.approx(1.0, rel=0.1)
+
+
+def test_goodput_window(link_env, monkeypatch):
+    import time as _time
+    link_env()
+    monkeypatch.setattr(linkobs, "_GOODPUT_WINDOW_S", 0.001)
+    linkobs.note_tx("h:9", 1, 1000.0)
+    _time.sleep(0.005)
+    linkobs.note_tx("h:9", 1, 1000.0)  # second call closes the window
+    snap = telemetry.snapshot()
+    keys = [k for k in snap
+            if k.startswith("bf_link_goodput_bytes") and 'peer="h:9"' in k]
+    assert keys and snap[keys[0]] > 0
+
+
+# ---------------------------------------------------------------------------
+# SLO grammar + engine
+# ---------------------------------------------------------------------------
+
+def test_slo_parse_good():
+    rules = linkobs.parse_slo_rules(
+        "link_delay_us>=50000; step_lag>128 ;bf_win_tx_queue_depth<=900")
+    assert [(r.metric, r.op, r.threshold) for r in rules] == [
+        ("link_delay_us", ">=", 50000.0),
+        ("step_lag", ">", 128.0),
+        ("bf_win_tx_queue_depth", "<=", 900.0)]
+    assert linkobs.parse_slo_rules(None) == []
+    assert linkobs.parse_slo_rules("  ;  ") == []
+    r = linkobs.parse_slo_rules("goodput_bytes<1e6")[0]
+    assert r.threshold == 1e6 and r.check(5e5) and not r.check(2e6)
+
+
+@pytest.mark.parametrize("bad", [
+    "link_delay_us=5",          # not a comparison op
+    "nonsense>5",               # unknown metric, not bf_*
+    "link_delay_us>",           # missing value
+    ">=5",                      # missing metric
+    "link_delay_us>five",
+])
+def test_slo_parse_bad_fails_loudly(bad):
+    with pytest.raises(ValueError, match="BLUEFOG_TPU_SLO"):
+        linkobs.parse_slo_rules(bad)
+
+
+def test_slo_malformed_spec_fails_at_config_load(link_env, monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_SLO", "what even is this")
+    with pytest.raises(ValueError, match="BLUEFOG_TPU_SLO"):
+        config.reload()
+    # Un-break the env BEFORE fixture teardown reloads config again.
+    monkeypatch.delenv("BLUEFOG_TPU_SLO")
+    config.reload()
+
+
+def test_slo_breach_latch_healthz_and_recovery(link_env):
+    link_env(BLUEFOG_TPU_SLO="link_delay_us>=20000;link_jitter_us>=1e9",
+             BLUEFOG_TPU_FLIGHT_RECORDER="0")
+    for _ in range(10):
+        linkobs.note_delay(2, 0, 500.0)
+    linkobs.on_step(1)
+    st = linkobs.slo_state()
+    assert st["rules"] == ["link_delay_us>=20000", "link_jitter_us>=1e9"]
+    assert st["breached"] == {}
+    hz = linkobs.health_summary()
+    assert hz["slo"]["breached"] == []
+    # Drive the delay over the threshold: exactly the matching rule
+    # latches; the quiet rule stays quiet.
+    for _ in range(40):
+        linkobs.note_delay(2, 0, 60000.0)
+    linkobs.on_step(2)
+    st = linkobs.slo_state()
+    assert list(st["breached"]) == ["link_delay_us>=20000"]
+    assert st["breached"]["link_delay_us>=20000"] >= 20000.0
+    snap = telemetry.snapshot()
+    assert snap[
+        'bf_slo_breaches_total{rule="link_delay_us>=20000"}'] == 1.0
+    hz = linkobs.health_summary()
+    assert hz["slo"]["breached"] == ["link_delay_us>=20000"]
+    assert hz["worst_edge"] == "2->0"
+    # The telemetry /healthz body degrades on the latched breach.
+    body = telemetry.health()
+    assert body["links"]["slo"]["breached"] and \
+        body["status"] == "degraded"
+    # Re-evaluating while still breached must NOT re-count (latched).
+    linkobs.on_step(3)
+    assert telemetry.snapshot()[
+        'bf_slo_breaches_total{rule="link_delay_us>=20000"}'] == 1.0
+    # Recovery: EWMA back under threshold -> latch clears, health green.
+    for _ in range(60):
+        linkobs.note_delay(2, 0, 100.0)
+    linkobs.on_step(4)
+    assert linkobs.slo_state()["breached"] == {}
+    assert telemetry.health()["status"] in ("ok", "stalled")
+
+
+def test_slo_no_signal_never_breaches(link_env):
+    link_env(BLUEFOG_TPU_SLO="link_delay_us>=1;goodput_bytes<=1e12")
+    linkobs.on_step(1)  # no edges, no tx: value None on both rules
+    assert linkobs.slo_state()["breached"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Snapshot purity: merge + report, cross-rank agreement
+# ---------------------------------------------------------------------------
+
+def _rank_snapshot(edges):
+    """Build one rank's rendered bf_link_* snapshot via the real
+    ingestion path, then reset for the next 'rank'."""
+    for (src, dst), us in edges.items():
+        for _ in range(40):
+            linkobs.note_delay(src, dst, us)
+    snap = _link_series()
+    linkobs.reset()
+    telemetry.reset()
+    return snap
+
+
+def test_merge_and_report_cross_rank_agreement(link_env):
+    """Each edge lives on its receiver; the gauge-MAX merge of per-rank
+    snapshots is the SAME matrix no matter who computes it — the chaos
+    rig's collective-free stand-in for bf.link_report()."""
+    link_env()
+    s0 = _rank_snapshot({(3, 0): 60000.0, (1, 0): 400.0})
+    s1 = _rank_snapshot({(3, 1): 58000.0, (2, 1): 380.0})
+    s2 = _rank_snapshot({(0, 2): 410.0, (1, 2): 395.0})
+    reports = [linkobs.report_from_snapshot(
+        linkobs.merge_link_snapshots(order))
+        for order in ([s0, s1, s2], [s2, s0, s1], [s1, s2, s0])]
+    assert reports[0] == reports[1] == reports[2]
+    rep = reports[0]
+    assert rep["hot_edge"]["src"] == 3 and rep["hot_edge"]["dst"] == 0
+    assert rep["hot_edge"]["delay_us"] == pytest.approx(60000, rel=0.01)
+    assert len(rep["edges"]) == 6
+    assert rep["max_divergence_ratio"] > linkobs.DIVERGENCE_ALERT
+    # Purity: assembling a report never touches the live registry.
+    assert telemetry.snapshot() == {}
+
+
+def test_merge_ignores_non_link_series(link_env):
+    link_env()
+    merged = linkobs.merge_link_snapshots([
+        {'bf_link_delay_us{dst="0",src="1"}': 5.0,
+         "bf_async_step_lag": 99.0},
+        {'bf_link_delay_us{dst="0",src="1"}': 7.0}])
+    assert merged == {'bf_link_delay_us{dst="0",src="1"}': 7.0}
+
+
+# ---------------------------------------------------------------------------
+# Hygiene: churn eviction, peer drop, shutdown
+# ---------------------------------------------------------------------------
+
+def test_clear_edges_churn_hygiene(link_env):
+    link_env()
+    for src in (1, 3, 5):
+        linkobs.note_delay(src, 0, 500.0)
+    linkobs.clear_edges([3])
+    snap = telemetry.snapshot()
+    assert 'bf_link_delay_us{dst="0",src="3"}' not in snap
+    assert 'bf_link_divergence_ratio{dst="0",src="3"}' not in snap
+    assert 'bf_link_delay_us{dst="0",src="1"}' in snap
+    assert (3, 0) not in linkobs._edges and (1, 0) in linkobs._edges
+
+
+def test_clear_peer_and_clear_all(link_env, monkeypatch):
+    link_env()
+    import time as _time
+    monkeypatch.setattr(linkobs, "_GOODPUT_WINDOW_S", 0.001)
+    linkobs.note_tx("h:1", 0, 1000.0)
+    linkobs.note_tx("h:2", 1, 1000.0)
+    _time.sleep(0.005)
+    linkobs.note_tx("h:1", 0, 1000.0)
+    linkobs.note_tx("h:2", 1, 1000.0)
+    linkobs.note_delay(1, 0, 500.0)
+    linkobs.clear_peer("h:1")
+    snap = telemetry.snapshot()
+    assert not any('peer="h:1"' in k for k in snap)
+    assert any('peer="h:2"' in k for k in snap)
+    linkobs.clear_all()
+    # Every GAUGE is retired; the cumulative delay histogram persists
+    # (histograms are monotone scrape series, not live claims).
+    left = [k for k in _link_series()
+            if not k.startswith("bf_link_delay_seconds")]
+    assert left == []
+    # Hygiene runs even when the observatory is OFF (teardown contract).
+    link_env(BLUEFOG_TPU_LINK_OBS="0")
+    linkobs.clear_edges([1])
+    linkobs.clear_peer("h:2")
+    linkobs.clear_all()
+
+
+# ---------------------------------------------------------------------------
+# linkdelay fault: spec, injector, transport
+# ---------------------------------------------------------------------------
+
+def test_linkdelay_spec_parse_defaults():
+    f = uchaos.parse_chaos("linkdelay:rank=3:step=40")[0]
+    assert (f.kind, f.rank, f.step, f.steps, f.ms) == \
+        ("linkdelay", 3, 40, 10, 60.0)
+    f = uchaos.parse_chaos("linkdelay:rank=1:step=5:steps=7:ms=25")[0]
+    assert (f.steps, f.ms) == (7, 25.0)
+    assert f.active_at(5) and f.active_at(11) and not f.active_at(12)
+    with pytest.raises(ValueError):
+        uchaos.parse_chaos("linkdelay:rank=1")     # step missing
+    with pytest.raises(ValueError):
+        uchaos.parse_chaos("linkdelay:rank=1:step=2:bogus=3")
+
+
+class _FakeTransport:
+    def __init__(self):
+        self.delays = []
+
+    def set_send_delay(self, seconds):
+        self.delays.append(seconds)
+
+
+def test_chaos_injector_linkdelay_engage_heal():
+    faults = uchaos.parse_chaos(
+        "linkdelay:rank=3:step=10:steps=3:ms=50,"
+        "linkdelay:rank=2:step=11:steps=1:ms=80")
+    tr = _FakeTransport()
+    inj = uchaos.ChaosInjector([2, 3], faults=faults, transport=tr)
+    inj.apply(9)
+    assert tr.delays == []             # not engaged yet
+    inj.apply(10)
+    assert tr.delays == [0.05]         # rank-3 fault engages
+    inj.apply(11)
+    assert tr.delays == [0.05, 0.08]   # overlapping faults: the MAX
+    inj.apply(12)
+    assert tr.delays == [0.05, 0.08, 0.05]
+    inj.apply(13)
+    assert tr.delays[-1] == 0.0        # healed exactly once
+    inj.apply(14)
+    assert len(tr.delays) == 4         # no repeat calls while steady
+
+
+def test_chaos_injector_ignores_other_ranks():
+    faults = uchaos.parse_chaos("linkdelay:rank=3:step=1:steps=5:ms=50")
+    tr = _FakeTransport()
+    inj = uchaos.ChaosInjector([0, 1], faults=faults, transport=tr)
+    for s in range(8):
+        inj.apply(s)
+    assert tr.delays == []
+
+
+def test_transport_send_delay_data_ops_only(link_env):
+    """set_send_delay sleeps DATA sends only — heartbeats/fences ride
+    undelayed, so churn suspicion stays quiet during a linkdelay
+    fault."""
+    import time as _time
+    link_env(BLUEFOG_TPU_WIN_COALESCE_LINGER_MS="2")
+    got = []
+    import threading
+    cv = threading.Condition()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        with cv:
+            got.append(op & ~T.OP_FLAG_MASK)
+            cv.notify_all()
+
+    def apply_batch(msgs):
+        for m in msgs:
+            apply(*m)
+
+    server = T.WindowTransport(apply, apply_batch=apply_batch)
+    client = T.WindowTransport(lambda *a: None)
+    try:
+        client.set_send_delay(0.15)
+        row = np.zeros(4, np.float32)
+        t0 = _time.perf_counter()
+        client.send("127.0.0.1", server.port, T.OP_PUT, "w", 0, 1, 1.0,
+                    row)
+        client.flush()
+        with cv:
+            assert cv.wait_for(lambda: T.OP_PUT in got, timeout=30)
+        assert _time.perf_counter() - t0 >= 0.15   # the data op slept
+        client.set_send_delay(0.0)
+        t0 = _time.perf_counter()
+        client.send("127.0.0.1", server.port, T.OP_PUT, "w", 1, 1, 1.0,
+                    row)
+        client.flush()
+        with cv:
+            assert cv.wait_for(lambda: got.count(T.OP_PUT) >= 2,
+                               timeout=30)
+        assert _time.perf_counter() - t0 < 0.15    # healed: no sleep
+    finally:
+        client.stop()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# trace-gossip --json round-trip
+# ---------------------------------------------------------------------------
+
+def _write_fake_dump(path, rank, unix_us, mono_us, events):
+    arr = np.zeros(len(events), flightrec.EVENT_DTYPE)
+    for i, e in enumerate(events):
+        for k, v in e.items():
+            arr[i][k] = v
+    with open(path, "wb") as f:
+        f.write(flightrec.HEADER.pack(flightrec.MAGIC, flightrec.VERSION,
+                                      rank, 0, unix_us, mono_us,
+                                      len(arr)))
+        f.write(arr.tobytes())
+
+
+def _fake_two_rank_prefix(tmp_path):
+    prefix = str(tmp_path / "flightrec")
+    _write_fake_dump(
+        f"{prefix}.0.bin", 0, unix_us=10_000_000, mono_us=0,
+        events=[dict(t_us=1_000, src=0, dst=1, seq=5, len=64,
+                     etype=flightrec.ENQUEUE, op=T.OP_PUT, name=b"w")])
+    _write_fake_dump(
+        f"{prefix}.1.bin", 1, unix_us=10_000_000, mono_us=500_000,
+        events=[dict(t_us=501_250, src=0, dst=1, seq=5, len=64,
+                     etype=flightrec.DECODE,
+                     op=T.OP_PUT | T.OP_TRACE_FLAG, name=b"w")])
+    return prefix
+
+
+def test_trace_gossip_json_roundtrip(tmp_path, capsys):
+    prefix = _fake_two_rank_prefix(tmp_path)
+    rc = tracegossip.main_trace_gossip(prefix, as_json=True)
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)   # ONE json object
+    assert set(payload) >= {"trace", "stats", "edges"}
+    assert payload["stats"]["flows_matched"] == 1
+    assert payload["edges"] == [{"src": 0, "dst": 1, "tags": 1,
+                                 "p50_ms": 0.25, "p99_ms": 0.25,
+                                 "max_ms": 0.25}]
+    # Same edges as the text table renders.
+    dumps = tracegossip.load_dumps(prefix)
+    table = tracegossip.delay_table(tracegossip.edge_delays(dumps))
+    for row in payload["edges"]:
+        assert f"{row['src']} -> {row['dst']}" in table
+
+
+def test_trace_gossip_text_mode_unchanged(tmp_path, capsys):
+    prefix = _fake_two_rank_prefix(tmp_path)
+    rc = tracegossip.main_trace_gossip(prefix)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "0 -> 1" in out
+    with pytest.raises(json.JSONDecodeError):
+        json.loads(out)   # text mode is NOT the json contract
+
+
+# ---------------------------------------------------------------------------
+# tools top: parse + pure frame render
+# ---------------------------------------------------------------------------
+
+def test_top_parse_prometheus():
+    text = ("# HELP bf_x whatever\n"
+            "bf_async_step_lag 3\n"
+            'bf_link_delay_us{dst="0",src="3"} 60000.0\n'
+            "garbage-line-no-value\n"
+            "bf_bad notanumber\n")
+    m = topmod.parse_prometheus(text)
+    assert m == {"bf_async_step_lag": 3.0,
+                 'bf_link_delay_us{dst="0",src="3"}': 60000.0}
+
+
+def test_top_render_frame_matrix_and_down(link_env):
+    link_env()
+    metrics = {
+        'bf_link_delay_us{dst="0",src="3"}': 60000.0,
+        'bf_link_delay_us{dst="0",src="1"}': 400.0,
+        'bf_link_jitter_us{dst="0",src="3"}': 900.0,
+        'bf_link_divergence_ratio{dst="0",src="3"}': 150.0,
+        "bf_async_step_lag": 2.0,
+    }
+    health = {"status": "degraded",
+              "async": {"step": 41, "step_lag": 2},
+              "links": {"slo": {"rules": ["link_delay_us>=20000"],
+                                "breached": ["link_delay_us>=20000"]}}}
+    frame = topmod.render_frame({"h:9100": (metrics, health),
+                                 "h:9101": (None, None)})
+    assert "1/2 endpoint(s) up" in frame
+    assert "DOWN" in frame                       # dead endpoint row
+    assert "3 -> 0" in frame and "<- HOT" in frame
+    # The per-rank slo column truncates at 20 chars.
+    assert "BREACH link_delay_us" in frame
+    assert "degraded" in frame
+
+
+def test_top_render_frame_empty_matrix(link_env):
+    link_env()
+    frame = topmod.render_frame({"h:9100": ({}, {"status": "ok"})})
+    assert "no bf_link_* series yet" in frame
+
+
+def test_top_endpoint_discovery_explicit():
+    class A:
+        endpoints = "h1:9100, h2:9101"
+        gang_dir = None
+    assert topmod._discover_endpoints(A()) == ["h1:9100", "h2:9101"]
+    with pytest.raises(SystemExit):
+        class B:
+            endpoints = None
+            gang_dir = None
+        topmod._discover_endpoints(B())
