@@ -256,6 +256,43 @@ def _links_summary() -> "dict | None":
     }
 
 
+def _fused_step_summary() -> "dict | None":
+    """Whole-step compilation evidence for BENCH json: with
+    BLUEFOG_TPU_FUSED_STEP armed, the eager-vs-fused end-to-end step
+    time (p50/p99 ms), speedup and one-time compile cost measured on
+    bench_comm's loopback transport rig — the put-family twin of the
+    allreduce step this bench times (which already runs as one XLA
+    program).  Off by default, so the block is ``{"enabled": False}``
+    unless the flag is set; capability misses (no native
+    bf_xla_win_put_pass handler, non-CPU jax backend) degrade to a
+    labeled skip, mirroring detail.links."""
+    from bluefog_tpu.utils import config
+    if not config.get().fused_step:
+        return {"enabled": False}
+    try:
+        import bench_comm
+        from bluefog_tpu import native
+        from bluefog_tpu.ops import xlaffi
+        if not (native.available() and native.has_win_xla()
+                and native.has_xla_handler()
+                and xlaffi.has_passthrough()):
+            return {"enabled": True,
+                    "skipped": "native bf_xla_win_put_pass unavailable"}
+        prev = bench_comm._fused_env_setup()
+        try:
+            config.reload()
+            xlaffi._reset_for_tests()
+            if not xlaffi.armed():
+                return {"enabled": True,
+                        "skipped": xlaffi.disarm_reason() or "disarmed"}
+            cell = bench_comm._fused_timing_cell(steps=20, warm=4)
+        finally:
+            bench_comm._fused_env_restore(prev)
+        return {"enabled": True, **cell}
+    except Exception as e:  # noqa: BLE001 — evidence block, never fatal
+        return {"enabled": True, "skipped": f"rig unavailable: {e}"}
+
+
 def _synthesis_summary(devs) -> "dict | None":
     """Modeled schedule-synthesis evidence for BENCH json, matching the
     placement pattern: the flagship STATIC Exp2 gossip schedule priced on
@@ -495,6 +532,7 @@ def main():
             "hierarchy": _hierarchy_summary(devs, tree_bytes),
             "churn": _churn_summary(),
             "links": _links_summary(),
+            "fused_step": _fused_step_summary(),
             "telemetry": snap,
         },
     }))

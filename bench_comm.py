@@ -104,6 +104,21 @@ def _parse_args():
                         "asserts the FFI path engaged + zero staging-copy "
                         "bytes, no timing assertion; graceful skip when "
                         "jax.ffi or the native bf_xla symbols are absent")
+    p.add_argument("--fused", action="store_true",
+                   help="run the whole-step compilation bench "
+                        "(BLUEFOG_TPU_FUSED_STEP): eager vs fused "
+                        "end-to-end step time on the loopback transport "
+                        "rig plus the structural gates; asserts the "
+                        ">= 1.5x step-time win and <= 1e-6 trajectory "
+                        "equivalence over 50 steps")
+    p.add_argument("--fused-smoke", action="store_true",
+                   help="CI variant of --fused (`make fused-smoke`): "
+                        "asserts fused engagement (bf_fused_step_active, "
+                        "in-program puts counted), trajectory equivalence "
+                        "vs eager, FUSED_STEP=0 bitwise inertness and the "
+                        "graceful one-warning fallback; no timing "
+                        "assertion, graceful skip without the native "
+                        "bf_xla_win_put_pass handler")
     p.add_argument("--async-smoke", action="store_true",
                    help="structural CI gate of the barrier-free async "
                         "gossip mode (`make async-smoke`): a loopback "
@@ -629,6 +644,32 @@ def transport_main(args) -> int:
                 os.environ["BLUEFOG_TPU_LINK_OBS"] = prev_obs
             config.reload()
 
+    # Whole-step compilation leg (full runs only — it needs jax + the
+    # native XLA put handler): the eager-vs-fused end-to-end step-time
+    # cell, folded into this report's detail next to the links leg.
+    # Capability-gated with a graceful skip, like the ffi leg above.
+    fused_detail = None
+    if not smoke and native_ok:
+        from bluefog_tpu import native as _native
+        if (_native.has_win_xla() and _native.has_xla_handler()
+                and os.environ.get("BLUEFOG_TPU_FUSED_STEP") != "0"):
+            prev_fused = _fused_env_setup()
+            try:
+                from bluefog_tpu.ops import xlaffi as _xlaffi
+                from bluefog_tpu.utils import config as _fconfig
+                _fconfig.reload()
+                _xlaffi._reset_for_tests()
+                if _xlaffi.armed() and _xlaffi.has_passthrough():
+                    fused_detail = _fused_timing_cell()
+                else:
+                    fused_detail = {"skipped": _xlaffi.disarm_reason()
+                                    or "no passthrough put handler"}
+            finally:
+                _fused_env_restore(prev_fused)
+        else:
+            fused_detail = {"skipped": "bf_xla symbols absent or "
+                                       "FUSED_STEP pinned off"}
+
     rc = 0
     for f in failures:
         print(f"bench_comm --transport: {f}", file=sys.stderr)
@@ -656,6 +697,7 @@ def transport_main(args) -> int:
             "ffi": ffi_detail,
             "tracing": tracing_detail,
             "links": links_detail,
+            "fused_step": fused_detail,
         },
     }))
     return rc
@@ -997,6 +1039,341 @@ def async_main(args) -> int:
             "fold_restored_exactly": rc == 0,
             "zero_mutation_ok": not leaked,
         },
+    }))
+    return rc
+
+
+def _fused_env_setup():
+    """Arm the whole-step rig's environment (idempotent; call BEFORE the
+    first jax import): CPU backend, 8 virtual devices, native window
+    transport + XLA put path.  Returns the saved env for restore."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    prev = {v: os.environ.get(v) for v in (
+        "BLUEFOG_TPU_WIN_NATIVE", "BLUEFOG_TPU_WIN_XLA",
+        "BLUEFOG_TPU_WIN_COALESCE", "BLUEFOG_TPU_WIN_COALESCE_LINGER_MS",
+        "BLUEFOG_TPU_WIN_COMPRESSION", "BLUEFOG_TPU_FUSED_STEP",
+        "BLUEFOG_TPU_TELEMETRY")}
+    os.environ.update({
+        "BLUEFOG_TPU_WIN_NATIVE": "1",
+        "BLUEFOG_TPU_WIN_XLA": "1",
+        "BLUEFOG_TPU_WIN_COALESCE": "1",
+        "BLUEFOG_TPU_WIN_COALESCE_LINGER_MS": "500",
+        "BLUEFOG_TPU_WIN_COMPRESSION": "none",
+        "BLUEFOG_TPU_TELEMETRY": "1",
+    })
+    os.environ.pop("BLUEFOG_TPU_FUSED_STEP", None)
+    return prev
+
+
+def _fused_env_restore(prev):
+    from bluefog_tpu.ops import xlaffi
+    from bluefog_tpu.utils import config as _config
+    for var, val in prev.items():
+        if val is None:
+            os.environ.pop(var, None)
+        else:
+            os.environ[var] = val
+    _config.reload()
+    xlaffi._reset_for_tests()
+
+
+def _fused_rig(fused, leaves, cols, buckets, steps, warm=0, synced=False,
+               lr=0.5, armed=True):
+    """One loopback leg of the whole-step rig: a ``leaves x (8, cols)``
+    f32 tree stepped through a put-family optimizer against the
+    two-transport loopback store pair (the test_win_xla rig — the
+    windows predate the directory install, so one store serves both wire
+    ends and every remote put really crosses TCP).
+
+    ``synced=True`` gates each drain on every remote frame of the step
+    having been applied (the loopback twin of a quiescent wire) — the
+    determinism mode the trajectory-equivalence legs need; timing legs
+    run ungated.  Returns (times_ms, final_params, fused_steps)."""
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import transport as T
+    from bluefog_tpu.ops import window as W
+    from bluefog_tpu.ops import xlaffi
+
+    from bluefog_tpu.optim import window_optimizers as WO
+
+    W.win_free()
+    bf.init(lambda: topo.RingGraph(8))
+    rs = np.random.RandomState(7)
+    params = {f"l{i:02d}": jnp.asarray(rs.randn(8, cols)
+                                       .astype(np.float32))
+              for i in range(leaves)}
+    opt = WO.DistributedWinPutOptimizer(optax.sgd(lr), fused=fused,
+                                        fusion_buckets=buckets)
+    st = opt.init(params)
+
+    applied = [0]
+    cv = threading.Condition()
+
+    def bump(k):
+        with cv:
+            applied[0] += k
+            cv.notify_all()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        W._apply_inbound(op, name, src, dst, weight, p_weight, payload)
+        bump(1)
+
+    def apply_batch(msgs):
+        W._apply_inbound_batch(msgs)
+        bump(len(msgs))
+
+    def apply_items(items):
+        W._apply_inbound_items(items)
+        bump(sum((p[5] + p[6]) if k else 1 for k, p in items))
+
+    server = T.WindowTransport(apply, apply_batch=apply_batch,
+                               apply_items=apply_items)
+    client = T.WindowTransport(lambda *a: None)
+    saved = W._store.distrib
+    orig_update = W.win_update
+    expect = [0]
+
+    def synced_update(name, **kw):
+        with cv:
+            assert cv.wait_for(lambda: applied[0] >= expect[0],
+                               timeout=60), (applied[0], expect[0])
+        return orig_update(name, **kw)
+
+    try:
+        assert client.native_path, "native transport sender required"
+        for name, spl in zip(opt._names, opt._bucket_splits):
+            server.register_window(name, int(spl[-1]))
+        W._store.distrib = W._Distrib(
+            client, rank_owner={r: r % 2 for r in range(8)},
+            proc_addr={0: ("127.0.0.1", 1),
+                       1: ("127.0.0.1", server.port)},
+            my_proc=0)
+        if armed:
+            assert xlaffi.armed(), xlaffi.disarm_reason()
+        if synced:
+            W.win_update = synced_update
+        p = params
+        rng = np.random.RandomState(42)
+        times = []
+        n_windows = len(opt._names)
+        for i in range(steps + warm):
+            g = jax.tree.map(lambda x: x * 0.01 + jnp.asarray(
+                rng.randn(*x.shape).astype(np.float32)) * 1e-3, p)
+            # The bidirectional ring's out-edges from owned (even) srcs
+            # all target odd dsts: 8 remote edges per op per window.
+            expect[0] += 8 * n_windows
+            t0 = time.perf_counter()
+            p, st = opt.step(p, g, st, require_mutex=False)
+            jax.block_until_ready(p)
+            if i >= warm:
+                times.append((time.perf_counter() - t0) * 1e3)
+        fused_steps = (opt._fused_impl.fused_steps
+                       if opt._fused_impl is not None else 0)
+        return times, {k: np.asarray(v) for k, v in p.items()}, fused_steps
+    finally:
+        W.win_update = orig_update
+        W._store.distrib = saved
+        opt.free()
+        client.stop()
+        server.stop()
+
+
+def _fused_timing_cell(steps=40, warm=6):
+    """The acceptance cell: eager vs fused end-to-end step time on the
+    ungated loopback rig at the window-heavy configuration (32 leaves x
+    (8, 128) over 8 fusion buckets = 8 in-program puts per step)."""
+    import numpy as np
+
+    from bluefog_tpu.utils import telemetry
+    leaves, cols, buckets = 32, 128, 8
+    te, _, _ = _fused_rig(False, leaves, cols, buckets, steps, warm)
+    telemetry.reset()
+    tf, _, fsteps = _fused_rig(True, leaves, cols, buckets, steps, warm)
+    snap = telemetry.snapshot()
+    compile_s = snap.get("bf_fused_step_compile_seconds_sum", 0.0)
+    e50, e99 = np.percentile(te, 50), np.percentile(te, 99)
+    f50, f99 = np.percentile(tf, 50), np.percentile(tf, 99)
+    return {
+        "leaves": leaves, "cols": cols, "fusion_buckets": buckets,
+        "steps": steps,
+        "eager_ms_p50": round(float(e50), 3),
+        "eager_ms_p99": round(float(e99), 3),
+        "fused_ms_p50": round(float(f50), 3),
+        "fused_ms_p99": round(float(f99), 3),
+        "speedup": round(float(e50 / max(f50, 1e-9)), 3),
+        "compile_seconds": round(float(compile_s), 3),
+        "fused_steps": fsteps,
+    }
+
+
+def _fused_report(smoke: bool):
+    """The whole-step compilation gate: returns (speedup|None, detail,
+    failures).  Callers set the env (``_fused_env_setup``) first.
+
+    Structural legs (both modes):
+      1. engagement — a gated loopback run where every step takes the
+         fused path (``bf_fused_step_active`` 1, in-program puts
+         counted), with the trajectory-equivalence assert (<= 1e-6 vs
+         eager over the same gradient stream; bitwise expected — the
+         2^-1 learning rate keeps the update multiply exact, so XLA's
+         FMA contraction and eager's separate mul+add round the same);
+      2. ``BLUEFOG_TPU_FUSED_STEP=0`` — bitwise identical to the eager
+         leg AND inert (no program built, no bf_fused_step_* series);
+      3. graceful fallback — with the XLA put path disarmed a
+         ``fused=True`` optimizer warns ONCE, keeps stepping on the
+         eager path, and reports inactive.
+    Full runs add the timing cell and assert the >= 1.5x end-to-end
+    step-time win."""
+    import numpy as np
+
+    from bluefog_tpu import native
+    from bluefog_tpu.ops import xlaffi
+    from bluefog_tpu.utils import config as _config
+    from bluefog_tpu.utils import logging as bflog
+    from bluefog_tpu.utils import telemetry
+    _config.reload()
+    xlaffi._reset_for_tests()
+
+    if not (native.available() and native.has_win_xla()
+            and native.has_xla_handler() and xlaffi.has_passthrough()):
+        reason = ("native core lacks bf_xla_win_put_pass"
+                  if native.available() else "native core unavailable")
+        return None, {"skipped": reason}, []
+
+    failures = []
+    detail = {"smoke": smoke}
+    steps = 10 if smoke else 50
+
+    # -- leg 1: engagement + trajectory equivalence (gated loopback) --------
+    telemetry.reset()
+    _, pe, _ = _fused_rig(False, 2, 48, 2, steps, synced=True)
+    _, pf, fsteps = _fused_rig(True, 2, 48, 2, steps, synced=True)
+    snap = telemetry.snapshot()
+    max_diff = max(float(np.abs(pe[k] - pf[k]).max()) for k in pe)
+    bitwise = all(np.array_equal(pe[k], pf[k]) for k in pe)
+    if fsteps != steps:
+        failures.append(f"only {fsteps}/{steps} steps took the fused "
+                        "path on the engagement leg")
+    if snap.get("bf_fused_step_active") != 1.0:
+        failures.append("bf_fused_step_active != 1 after a fused run")
+    if not snap.get("bf_fused_step_puts_total"):
+        failures.append("no in-program puts counted "
+                        "(bf_fused_step_puts_total)")
+    if max_diff > 1e-6:
+        failures.append(f"fused-vs-eager trajectory diverged: max |d| = "
+                        f"{max_diff} > 1e-6 over {steps} steps")
+    detail["trajectory"] = {
+        "steps": steps, "max_abs_diff": max_diff, "bitwise": bitwise,
+        "puts_total": snap.get("bf_fused_step_puts_total", 0.0),
+    }
+
+    # -- leg 2: BLUEFOG_TPU_FUSED_STEP=0 is inert and bitwise eager ---------
+    os.environ["BLUEFOG_TPU_FUSED_STEP"] = "0"
+    _config.reload()
+    telemetry.reset()
+    try:
+        _, p0, _ = _fused_rig(None, 2, 48, 2, steps, synced=True)
+    finally:
+        os.environ.pop("BLUEFOG_TPU_FUSED_STEP", None)
+        _config.reload()
+    snap0 = telemetry.snapshot()
+    off_bitwise = all(np.array_equal(pe[k], p0[k]) for k in pe)
+    if not off_bitwise:
+        failures.append("FUSED_STEP=0 leg is not bitwise identical to "
+                        "the eager oracle")
+    leaked = [k for k in snap0 if k.startswith("bf_fused_step")]
+    if leaked:
+        failures.append(f"FUSED_STEP=0 leg registered {leaked[:3]}")
+    detail["env_off"] = {"bitwise": off_bitwise, "inert": not leaked}
+
+    # -- leg 3: graceful fallback when the XLA put path is disarmed ---------
+    # Same loopback rig (distrib installed — the eligibility check only
+    # applies to a live wire), XLA put path pinned off: the fused=True
+    # optimizer must warn ONCE, keep stepping eager, report inactive,
+    # and land the SAME trajectory.
+    os.environ["BLUEFOG_TPU_WIN_XLA"] = "0"
+    _config.reload()
+    xlaffi._reset_for_tests()
+    telemetry.reset()
+    warns = []
+    logger = bflog.get_logger()
+    orig_warning = logger.warning
+    logger.warning = lambda msg, *a, **kw: (
+        warns.append(msg % a if a else msg), orig_warning(msg, *a, **kw))
+    try:
+        _, pfb, fb_steps = _fused_rig(True, 2, 48, 2, steps, synced=True,
+                                      armed=False)
+    finally:
+        logger.warning = orig_warning
+        os.environ["BLUEFOG_TPU_WIN_XLA"] = "1"
+        _config.reload()
+        xlaffi._reset_for_tests()
+    fb_warns = [m for m in warns if "falling back to the eager path" in m]
+    if len(fb_warns) != 1:
+        failures.append(f"fallback leg warned {len(fb_warns)} times "
+                        "(want exactly 1)")
+    if fb_steps != 0:
+        failures.append(f"fallback leg still took {fb_steps} fused steps "
+                        "with the XLA put path disarmed")
+    if telemetry.snapshot().get("bf_fused_step_active") != 0.0:
+        failures.append("fallback leg did not report "
+                        "bf_fused_step_active = 0")
+    fb_bitwise = all(np.array_equal(pe[k], pfb[k]) for k in pe)
+    if not fb_bitwise:
+        failures.append("fallback leg's eager trajectory is not bitwise "
+                        "identical to the eager oracle")
+    detail["fallback"] = {"warnings": len(fb_warns),
+                          "bitwise_eager": fb_bitwise}
+
+    # -- timing cell (full runs only: shared CI boxes jitter) ---------------
+    speedup = None
+    if not smoke:
+        cell = _fused_timing_cell()
+        detail["timing"] = cell
+        speedup = cell["speedup"]
+        if speedup < 1.5:
+            failures.append(f"fused end-to-end step speedup {speedup}x "
+                            "< 1.5x on the transport rig")
+    return speedup, detail, failures
+
+
+def fused_main(args) -> int:
+    """`make fused-smoke` / `--fused`: the whole-step compilation gate.
+
+    Smoke: structural only — fused engagement (every step through the
+    single XLA program, in-program puts counted), trajectory equivalence
+    vs eager, FUSED_STEP=0 bitwise inertness, graceful one-warning
+    fallback without the native XLA handler.  Full adds the eager-vs-
+    fused timing cell and asserts the >= 1.5x end-to-end win."""
+    import sys
+
+    smoke = bool(args.fused_smoke and not args.fused)
+    prev = _fused_env_setup()
+    try:
+        value, detail, failures = _fused_report(smoke)
+    finally:
+        _fused_env_restore(prev)
+    rc = 0
+    for f in failures:
+        print(f"bench_comm --fused: {f}", file=sys.stderr)
+        rc = 1
+    print(json.dumps({
+        "metric": "fused_step_speedup",
+        "value": value,
+        "unit": "x",
+        "detail": detail,
     }))
     return rc
 
@@ -2026,6 +2403,8 @@ def main():
     args = _parse_args()
     if args.ffi or args.ffi_smoke:
         return ffi_main(args)
+    if args.fused or args.fused_smoke:
+        return fused_main(args)
     if args.async_smoke:
         return async_main(args)
     if args.tracerec_smoke:

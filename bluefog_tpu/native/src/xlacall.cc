@@ -375,6 +375,44 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(bf_xla_win_put, BfXlaWinPutImpl,
                                   .Attr<int64_t>("plan_id")
                                   .Attr<int64_t>("tx"));
 
+// Donated-buffer passthrough variant: same plan executor, but the input
+// buffer flows THROUGH the call as the first output (the Python side
+// declares input_output_aliases={0: 0}, so XLA donates the buffer and
+// x_out IS x — no copy).  Downstream program stages consume x_out, which
+// makes the put a real data dependence inside the fused step program:
+// XLA cannot sink it past the consumers, and each bucket's put issues
+// exactly when that bucket's bytes are materialized.
+static bffi::Error BfXlaWinPutPassImpl(bffi::AnyBuffer x,
+                                       bffi::Result<bffi::AnyBuffer> x_out,
+                                       bffi::Result<bffi::AnyBuffer> status,
+                                       int64_t plan_id, int64_t tx) {
+  if (status->element_count() < 1)
+    return bffi::Error(bffi::ErrorCode::kInvalidArgument,
+                       "bf_xla_win_put_pass needs an i32[1] status output");
+  auto* out = reinterpret_cast<int32_t*>(status->untyped_data());
+  if (x.element_type() != bffi::DataType::F32) {
+    out[0] = -12;  // non-f32 buffer: the Python side falls back
+    return bffi::Error::Success();
+  }
+  out[0] = PlanRun(plan_id, (const void*)(uintptr_t)tx,
+                   reinterpret_cast<const float*>(x.untyped_data()),
+                   (uint64_t)x.element_count());
+  // Defensive: honor the passthrough contract even if the runtime did
+  // not alias (donation can be declined when the buffer is still live).
+  if (x_out->untyped_data() != x.untyped_data())
+    std::memcpy(x_out->untyped_data(), x.untyped_data(),
+                x.element_count() * sizeof(float));
+  return bffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(bf_xla_win_put_pass, BfXlaWinPutPassImpl,
+                              bffi::Ffi::Bind()
+                                  .Arg<bffi::AnyBuffer>()
+                                  .Ret<bffi::AnyBuffer>()
+                                  .Ret<bffi::AnyBuffer>()
+                                  .Attr<int64_t>("plan_id")
+                                  .Attr<int64_t>("tx"));
+
 extern "C" int32_t bf_xla_has_handler(void) { return 1; }
 
 #else  // !BF_HAVE_XLA_FFI

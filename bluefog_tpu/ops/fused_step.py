@@ -1,0 +1,580 @@
+"""Whole-step compilation: the gossip training step as one XLA program.
+
+The eager window-optimizer step crosses the Python boundary four times
+per iteration — grad/update math, per-bucket host put dispatch, drain,
+parameter rebuild — so the neighbor averaging BlueFog promises to hide
+inside compute never actually hides end-to-end.  This module is the
+compiler pass that closes the boundary: it lowers (optimizer update ×
+the schedule layer's ``window_plan()`` × codec × per-bucket window put)
+into a single jitted program, behind ``BLUEFOG_TPU_FUSED_STEP`` (default
+OFF — ``=0`` pins the eager path as the bitwise oracle).
+
+Program shape (built once per cache key, replayed every step):
+
+  * **step program** — the vmapped base-optimizer update, the per-bucket
+    flat concatenation, and one donated-buffer FFI put
+    (``xlaffi.xla_put_program_pass``, native ``bf_xla_win_put_pass``)
+    per fusion bucket.  The put is a *passthrough*: its first output IS
+    the bucket flat (``input_output_aliases`` donation), so downstream
+    consumers data-depend on the put — XLA issues each bucket's put
+    exactly when that bucket's bytes materialize, pipelining the sends
+    against the remaining update math by data dependence instead of the
+    hand-rolled ``_pending`` handle list the eager overlap mode keeps.
+  * **finish program** — the drain: ``win_update`` (or the push-sum
+    ``win_update_then_collect``) runs host-side once the put statuses
+    have landed, handing its fresh combine buffers (``commit=False``)
+    straight to one jitted program doing the per-leaf rebuild
+    (split/reshape/cast) and the owned-row merge; the jit argument path
+    is where the host arrays re-enter jax — one batched conversion,
+    measured ~5x cheaper than per-array ``commit_to_jax`` re-entry.
+    (Embedding the drain as an ordered ``io_callback`` inside the
+    program was measured ~1.5x slower end to end: the callback
+    machinery's device round-trip dwarfs the fold it wraps, and the
+    put-status block already gives the same ordering for free.)
+
+Between the two programs the host performs exactly what ``_do_put`` does
+around the native plan dispatch and an in-program custom call cannot:
+local-edge staging writes, the scoped transport flush, the post-send
+self-publish (push-sum mass conservation) and the periodic push-sum
+fence — see ``window._fused_host_finish``.
+
+Cache + invalidation: programs are keyed on (family, tree structure,
+leaf avals, window names, ``basics`` topology generation, committed
+membership epoch, codec, associated-P arming, resolved edge weights,
+mutex mode, transport handle).  ``set_topology`` bumps the topology
+generation and a committed membership change bumps the epoch, so a stale
+program can never dispatch against a new topology generation — the next
+step misses the cache and rebuilds.
+
+The schedule layer is a first-class input: the resolved edge weights
+compile through ``ops.schedule.compile_static`` into a
+``CompiledSchedule`` re-tagged ``lowering="fused"`` and the program's
+per-source push lists are consumed from its ``window_plan()`` — the same
+artifact ``tools schedule-dump --lowering fused`` previews without
+running anything (:func:`modeled_overlap`).
+
+Telemetry: ``bf_fused_step_active`` (gauge), ``bf_fused_step_compile_seconds``
+(histogram, observed at build), ``bf_fused_step_puts_total`` (counter,
+one per in-program plan dispatch) and ``bf_fused_step_overlap_seconds``
+(histogram labeled by bucket: wall time between a bucket's put issuing
+inside the program and the program completing — the window the put
+actually overlapped).  With the flag off none of these mutate.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bluefog_tpu import basics
+from bluefog_tpu.ops import window as W
+from bluefog_tpu.ops import xlaffi
+
+__all__ = ["FusedStep", "FusedFallback", "modeled_overlap"]
+
+# Bounded program cache per optimizer: topology flips A->B->A should hit,
+# a topology sweep should not grow without bound.
+_MAX_PROGRAMS = 4
+
+
+class FusedFallback(Exception):
+    """This step cannot take the fused path — run the eager oracle.
+
+    Raised for *configuration* reasons (disarmed XLA path, unsupported
+    layout, async mode), never mid-dispatch: by the time the fused
+    program runs, every disqualifier has already been checked."""
+
+
+class _Program:
+    """One compiled fused step: the two jitted programs plus the host
+    metadata needed to dispatch them."""
+
+    __slots__ = (
+        "key", "step_fn", "finish_fn", "finish_host_drain", "names",
+        "plans", "tx", "edges", "remote_procs", "sched", "stamps",
+        "n_put_calls", "accumulate",
+    )
+
+
+def _edge_token(dst_weights):
+    """Hashable identity of a ``dst_weights`` argument for cache keying."""
+    if dst_weights is None:
+        return None
+    if isinstance(dst_weights, dict):
+        return tuple(sorted((k, float(v)) for k, v in dst_weights.items()))
+    arr = np.asarray(dst_weights, dtype=float)
+    return ("matrix", arr.shape, arr.tobytes())
+
+
+def _self_weight_token(self_weight):
+    if self_weight is None:
+        return None
+    arr = np.asarray(self_weight, dtype=float)
+    return (arr.shape, arr.tobytes())
+
+
+def compile_fused_schedule(edges: Dict[tuple, float], n: int):
+    """Compile a resolved ``{(src, dst): w}`` edge set into a
+    ``CompiledSchedule`` artifact tagged ``lowering="fused"`` — the
+    schedule-layer representation the fused program consumes (via
+    ``window_plan()``) and ``tools schedule-dump`` previews."""
+    from bluefog_tpu.ops import schedule as S
+    m = np.zeros((n, n), dtype=float)
+    for (src, dst), w in edges.items():
+        if src != dst:
+            m[src, dst] = float(w)
+    sched = S.compile_static(basics.load_topology(), src_weights=m)
+    return S.as_compiled(sched, lowering="fused")
+
+
+def modeled_overlap(bucket_bytes: List[int]) -> List[dict]:
+    """Static overlap preview for ``k`` fusion buckets (no execution).
+
+    Model: the update math costs one unit spread evenly over the buckets
+    in order; bucket ``i``'s put issues the moment its flat materializes
+    (fraction ``(i+1)/k`` of the compute) and its wire time then runs
+    concurrently with the remaining ``(k-i-1)/k`` of compute — the data-
+    dependence pipelining the fused program gets from XLA.  Returns one
+    row per bucket: ``bytes``, ``ready_at`` (fraction of compute done
+    when the put issues) and ``overlap`` (fraction of the compute the
+    put's wire time can hide behind)."""
+    k = len(bucket_bytes)
+    rows = []
+    for i, nb in enumerate(bucket_bytes):
+        rows.append({
+            "bucket": i,
+            "bytes": int(nb),
+            "ready_at": (i + 1) / k if k else 1.0,
+            "overlap": (k - i - 1) / k if k else 0.0,
+        })
+    return rows
+
+
+class FusedStep:
+    """Per-optimizer fused-step compiler + dispatcher.
+
+    Owned by a window optimizer (``optim/window_optimizers.py``); one
+    instance caches up to ``_MAX_PROGRAMS`` compiled programs keyed by
+    (tree structure, topology generation, membership epoch, edges,
+    codec, ...) and replays them across steps."""
+
+    def __init__(self, opt):
+        self.opt = opt
+        self._programs: "Dict[tuple, _Program]" = {}
+        self.builds = 0          # program (re)builds — tests assert on this
+        self.fused_steps = 0     # steps served by a fused program
+        self._warned: set = set()
+
+    # -- engagement --------------------------------------------------------
+
+    def _fallback(self, reason: str):
+        from bluefog_tpu.utils import telemetry
+        telemetry.set_gauge("bf_fused_step_active", 0.0)
+        if reason not in self._warned:
+            self._warned.add(reason)
+            from bluefog_tpu.utils.logging import get_logger
+            get_logger().warning(
+                "fused step: falling back to the eager path (%s); "
+                "set BLUEFOG_TPU_FUSED_STEP=0 to silence", reason)
+        raise FusedFallback(reason)
+
+    def _check_eligible(self, params):
+        import jax
+        import jax.numpy as jnp
+        opt = self.opt
+        if not opt.fuse:
+            self._fallback("fuse=False (per-leaf windows) is not lowered")
+        if opt._async_on:
+            self._fallback("async mode (BLUEFOG_TPU_ASYNC) keeps the "
+                           "eager barrier-free step")
+        leaves = jax.tree_util.tree_leaves(params)
+        if not all(np.asarray(x).dtype == jnp.float32 for x in leaves):
+            self._fallback("non-f32 parameter leaves")
+        d = W._store.distrib
+        if d is not None:
+            if not xlaffi.armed():
+                self._fallback("XLA put path disarmed: %s"
+                               % (xlaffi.disarm_reason() or "unknown"))
+            if not xlaffi.has_passthrough():
+                self._fallback("native core lacks bf_xla_win_put_pass "
+                               "(rebuild bluefog_tpu/native)")
+            if getattr(d.transport, "_tx", None) is None:
+                self._fallback("window transport is not native "
+                               "(BLUEFOG_TPU_WIN_NATIVE=0?)")
+        return d
+
+    # -- program build -----------------------------------------------------
+
+    def _key(self, family, treedef, avals, dst_weights, self_weight,
+             require_mutex, d):
+        from bluefog_tpu.utils import config, telemetry
+        view = getattr(self.opt, "membership_change", None)
+        cfg = config.get()
+        return (
+            family, treedef, avals, tuple(self.opt._names),
+            basics._ctx.topology_version,
+            (view.epoch if view is not None else -1),
+            _edge_token(dst_weights), _self_weight_token(self_weight),
+            bool(require_mutex), cfg.win_compression,
+            W._store.associated_p_enabled,
+            (getattr(d.transport, "_tx", None) if d is not None else None),
+            telemetry.enabled(),
+        )
+
+    def _resolve_edges(self, dst_weights):
+        """The schedule-layer pass: resolve the caller's weights exactly
+        as the eager put does, compile them into the ``lowering="fused"``
+        artifact, and read the program's per-source push lists back off
+        ``window_plan()``."""
+        win = W._store.get(self.opt._names[0])
+        resolved = W._resolve_edge_weights(dst_weights, win.out_nbrs, 1.0)
+        sched = compile_fused_schedule(resolved, self.opt._n)
+        plan = sched.window_plan()
+        edges = {(src, dst): w
+                 for src in range(self.opt._n)
+                 for dst, w in plan[src]}
+        return edges, sched
+
+    def _build(self, family, params, grads, base_state, *, dst_weights,
+               self_weight, require_mutex, d, key):
+        import jax
+        import jax.numpy as jnp
+        from bluefog_tpu.utils import telemetry
+
+        opt = self.opt
+        accumulate = family == "pushsum"
+        rows = opt._rows
+        edges, sched = self._resolve_edges(dst_weights)
+        owned_edges = {(s, t): w for (s, t), w in edges.items()
+                       if W._owns(s)}
+        remote_procs = ({d.rank_owner[t] for (s, t) in owned_edges
+                         if not W._owns(t)} if d is not None else set())
+
+        prog = _Program()
+        prog.key = key
+        prog.names = list(opt._names)
+        prog.edges = owned_edges
+        prog.remote_procs = remote_procs
+        prog.sched = sched
+        prog.tx = getattr(d.transport, "_tx", None) if d is not None else None
+        prog.accumulate = accumulate
+        prog.stamps = [None] * len(opt._names)
+        prog.plans = []
+        op = W.OP_ACCUMULATE if accumulate else W.OP_PUT
+        remote_edges = tuple(
+            ((s, t), w) for (s, t), w in owned_edges.items()
+            if not W._owns(t))
+        for name in opt._names:
+            if d is None or not remote_edges:
+                prog.plans.append(None)
+                continue
+            win = W._store.get(name)
+            plan = xlaffi.prepare_put(d, win, name, op, remote_edges,
+                                      per_edge=False)
+            if plan is None:
+                self._fallback("native plan build failed for %r" % name)
+            prog.plans.append(plan)
+        prog.n_put_calls = sum(
+            len(p.groups) for p in prog.plans if p is not None)
+
+        # Passthrough put closures + per-bucket issue-time stamps.
+        put_fns: List[List] = []
+        for plan in prog.plans:
+            fns = []
+            if plan is not None:
+                for pid, _grp in plan.groups:
+                    f = xlaffi.xla_put_program_pass(pid, prog.tx)
+                    if f is None:
+                        self._fallback("jax FFI module unavailable for "
+                                       "the in-program put")
+                    fns.append(f)
+            put_fns.append(fns)
+
+        stamp_fns: List[Optional[object]] = [None] * len(opt._names)
+        if telemetry.enabled() and any(put_fns):
+            try:
+                from jax.experimental import io_callback as _iocb
+            except Exception:  # noqa: BLE001 — no stamps on older jax
+                _iocb = None
+            if _iocb is not None:
+                def _mk_stamp(bi):
+                    def _cb(_st):
+                        prog.stamps[bi] = time.monotonic()
+                        return np.int32(0)
+
+                    def _emit(status):
+                        return _iocb(_cb,
+                                     jax.ShapeDtypeStruct((), jnp.int32),
+                                     status, ordered=False)
+                    return _emit
+                stamp_fns = [_mk_stamp(i) for i in range(len(opt._names))]
+
+        base = opt.base
+        buckets = opt._buckets
+
+        def _step(params_t, grads_t, state_t):
+            updates, new_state = jax.vmap(
+                lambda g, s, p: base.update(g, s, p))(
+                    grads_t, state_t, params_t)
+            new_params = jax.tree.map(lambda p, u: p + u, params_t, updates)
+            leaves = jax.tree_util.tree_leaves(new_params)
+            flats, statuses = [], []
+            for bi, idxs in enumerate(buckets):
+                flat = jnp.concatenate(
+                    [jnp.reshape(leaves[i], (rows, -1)) for i in idxs],
+                    axis=1)
+                sts = []
+                for f in put_fns[bi]:
+                    flat, st = f(flat)
+                    sts.append(st)
+                st_all = (jnp.concatenate(sts) if sts
+                          else jnp.zeros((1,), jnp.int32))
+                if sts and stamp_fns[bi] is not None:
+                    stamp_fns[bi](st_all)
+                flats.append(flat)
+                statuses.append(st_all)
+            return flats, statuses, new_state
+
+        # Finish: the host drain — win_update (or the push-sum collect)
+        # per bucket window with ``commit=False`` — then ONE jitted
+        # rebuild+merge program whose argument path is where the fresh
+        # host arrays re-enter jax: the jit call boundary converts a
+        # batch of donor-less numpy operands in one pass, measured ~5x
+        # cheaper than per-array ``commit_to_jax`` re-entry and ~8x
+        # cheaper than embedding the drain as an ordered ``io_callback``
+        # (the callback machinery's device round-trip dwarfs the fold it
+        # wraps).  Ordering needs no program token — the step blocks on
+        # the put statuses before the drain runs.
+        def _drain_host():
+            return tuple(
+                W.win_update_then_collect(
+                    name, require_mutex=require_mutex, commit=False)
+                if accumulate else
+                W.win_update(name, require_mutex=require_mutex,
+                             commit=False)
+                for name in prog.names)
+
+        prog.finish_host_drain = _drain_host
+
+        if d is not None and opt._layout == "rank":
+            mask = np.zeros(opt._n, bool)
+            mask[opt._owned] = True
+        else:
+            mask = None
+
+        shapes, dtypes = opt._shapes, opt._dtypes
+        bucket_splits = opt._bucket_splits
+        treedef = jax.tree_util.tree_structure(params)
+
+        def _rebuild_merge(params_t, combined):
+            leaves_out = []
+            for bi, idxs in enumerate(buckets):
+                splits = bucket_splits[bi]
+                parts = (jnp.split(combined[bi], list(splits[:-1]), axis=1)
+                         if len(idxs) > 1 else [combined[bi]])
+                leaves_out.extend(
+                    jnp.reshape(p, shapes[i]).astype(dtypes[i])
+                    for p, i in zip(parts, idxs))
+            new_t = jax.tree_util.tree_unflatten(treedef, leaves_out)
+            if mask is None:
+                return new_t
+
+            def one(p, q):
+                m = jnp.asarray(
+                    mask.reshape((-1,) + (1,) * (jnp.ndim(q) - 1)))
+                return jnp.where(m, q, p)
+            return jax.tree.map(one, params_t, new_t)
+
+        # ``combined`` is consumed as inputs only (the caller keeps the
+        # drain views for the consensus sampler) — returning it would
+        # force XLA to materialize an output copy of every bucket flat.
+        def _finish(params_t, *combined):
+            return _rebuild_merge(params_t, combined)
+
+        t0 = time.monotonic()
+        step_fn = jax.jit(_step)
+        try:  # AOT so compile time is observable separately from step time
+            step_fn = step_fn.lower(params, grads, base_state).compile()
+        except Exception:  # noqa: BLE001 — plain jit compiles on first call
+            pass
+        prog.step_fn = step_fn
+        prog.finish_fn = jax.jit(_finish)
+        telemetry.observe("bf_fused_step_compile_seconds",
+                          time.monotonic() - t0)
+        self.builds += 1
+        return prog
+
+    # -- dispatch ----------------------------------------------------------
+
+    def step(self, params, grads, state, *, family: str,
+             dst_weights=None, self_weight=None,
+             require_mutex: bool = False, pre_drain=None):
+        """One fused training step; raises :class:`FusedFallback` when
+        this configuration cannot take the fused path (the caller then
+        runs the eager step — the bitwise oracle)."""
+        import jax
+        from bluefog_tpu.optim.functional import DistOptState
+        from bluefog_tpu.utils import telemetry
+
+        opt = self.opt
+        d = self._check_eligible(params)
+        avals = tuple(
+            (tuple(np.shape(x)), str(getattr(x, "dtype", np.float32)))
+            for x in jax.tree_util.tree_leaves(params))
+        treedef = jax.tree_util.tree_structure(params)
+        key = self._key(family, treedef, avals, dst_weights, self_weight,
+                        require_mutex, d)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._build(family, params, grads, state.base,
+                               dst_weights=dst_weights,
+                               self_weight=self_weight,
+                               require_mutex=require_mutex, d=d, key=key)
+            # A topology/membership/config change made every older
+            # program stale — a stale program must never dispatch
+            # against a new generation, so evict rather than cap-rotate.
+            if len(self._programs) >= _MAX_PROGRAMS:
+                self._programs.clear()
+            self._programs[key] = prog
+
+        # Overlapped puts from a previous EAGER step must land before a
+        # program targets the same windows.
+        if hasattr(opt, "_drain_pending"):
+            opt._drain_pending()
+
+        # Host pre-dispatch: error token, sparse residual migration and
+        # the associated-P refresh — the same work _ffi_put does before
+        # its plan run, done once here because the run happens inside the
+        # compiled program.
+        tok = None
+        if prog.remote_procs:
+            tok = d.transport.error_token(
+                {d.proc_addr[p] for p in prog.remote_procs})
+        with contextlib.ExitStack() as stack:
+            for name, plan in zip(prog.names, prog.plans):
+                if plan is None:
+                    continue
+                stack.enter_context(plan.dispatch_lock)
+                win = W._store.get(name)
+                if plan.codec == 2:
+                    with W._ef_lock:
+                        taken = []
+                        for _pid, grp in plan.groups:
+                            for (src, dst), _w in grp:
+                                r = W._ef_residuals.pop(
+                                    (name, src, dst), None)
+                                if r is not None:
+                                    taken.append((src, dst, r))
+                    for src, dst, r in taken:
+                        xlaffi.push_native_residual(name, src, dst, r)
+                if W._store.associated_p_enabled:
+                    with win.lock:
+                        for pid, grp in plan.groups:
+                            xlaffi.set_group_p(
+                                pid, [w * float(win.p_main[src])
+                                      for (src, _dst), w in grp])
+                    plan.p_set = True
+                elif plan.p_set:
+                    for pid, grp in plan.groups:
+                        xlaffi.set_group_p(pid, [0.0] * len(grp))
+                    plan.p_set = False
+            if require_mutex:
+                # An in-program custom call cannot hold the per-edge
+                # distributed mutex around its own send; hold every
+                # remote edge's mutex across the program instead — a
+                # superset of the eager per-edge hold (still exclusive,
+                # deterministic dst order so writers cannot deadlock).
+                for (src, dst) in sorted(prog.edges):
+                    if W._owns(src) and not W._owns(dst):
+                        stack.enter_context(
+                            W._remote_mutex(prog.names[0], dst, src))
+
+            flats, statuses, new_base = prog.step_fn(
+                params, grads, state.base)
+            sts = [np.asarray(s) for s in statuses]  # waits for the puts
+        t_done = time.monotonic()
+
+        self._check_statuses(prog, sts, flats)
+
+        nbytes = sum(int(np.prod(f.shape)) * f.dtype.itemsize
+                     for f in flats)
+        W._count_win_op("accumulate" if prog.accumulate else "put",
+                        nbytes, prog.edges)
+        for plan in prog.plans:
+            if plan is not None:
+                xlaffi.record_dispatch(plan)
+        if prog.n_put_calls:
+            telemetry.inc("bf_fused_step_puts_total",
+                          float(prog.n_put_calls))
+        for bi, t_put in enumerate(prog.stamps):
+            if t_put is not None:
+                telemetry.observe("bf_fused_step_overlap_seconds",
+                                  max(0.0, t_done - t_put), bucket=str(bi))
+                prog.stamps[bi] = None
+
+        # Host half of the put: local-edge staging writes and the
+        # post-send self-publish per bucket, then ONE scoped transport
+        # flush covering every bucket's sends (the eager path flushes
+        # per window; one flush since the same token is the same wire
+        # boundary at a fraction of the host cost).
+        for name, flat in zip(prog.names, flats):
+            W._fused_host_finish(
+                name, flat, prog.edges, accumulate=prog.accumulate,
+                self_weight=self_weight, require_mutex=require_mutex,
+                remote_procs=prog.remote_procs, since=tok, flush=False)
+        if prog.remote_procs:
+            W._flush_transport(prog.remote_procs, since=tok)
+        if pre_drain is not None:  # push-sum fence / stale-residual fold
+            pre_drain()
+
+        combined = prog.finish_host_drain()
+        merged = prog.finish_fn(params, *combined)
+
+        t = int(state.step)
+        # Device arrays go in as-is (the eager step does the same): the
+        # sampler gates on its cadence before touching a single element.
+        opt._maybe_sample_consensus(t, list(flats), list(combined))
+        telemetry.set_gauge("bf_fused_step_active", 1.0)
+        self.fused_steps += 1
+        return merged, DistOptState(new_base, state.step + 1)
+
+    def _check_statuses(self, prog, sts, flats) -> None:
+        """Mirror the eager dispatch's error semantics: a vanished plan
+        (cache eviction race — nothing was sent) redispatches the remote
+        edges host-side; any other nonzero status raises exactly like
+        ``xlaffi.run_group`` would have."""
+        rcs = np.concatenate(sts) if sts else np.zeros(0, np.int32)
+        if not rcs.size or not (rcs != 0).any():
+            return
+        if (rcs[rcs != 0] == -9).all():
+            self._programs.pop(prog.key, None)  # plans are stale too
+            d = W._store.distrib
+            op = W.OP_ACCUMULATE if prog.accumulate else W.OP_PUT
+            remote_edges = tuple(
+                ((s, t), w) for (s, t), w in prog.edges.items()
+                if not W._owns(t))
+            for name, flat in zip(prog.names, flats):
+                win = W._store.get(name)
+                fresh = xlaffi.prepare_put(d, win, name, op, remote_edges,
+                                           per_edge=False)
+                if fresh is None:
+                    raise xlaffi.PlanVanished(
+                        "fused step: native plan vanished and could not "
+                        "be rebuilt")
+                if W._store.associated_p_enabled:
+                    with win.lock:
+                        for pid, grp in fresh.groups:
+                            xlaffi.set_group_p(
+                                pid, [w * float(win.p_main[src])
+                                      for (src, _dst), w in grp])
+                for pid, _grp in fresh.groups:
+                    xlaffi.run_group(pid, prog.tx, flat)
+            return
+        self._programs.pop(prog.key, None)
+        bad = int(rcs[rcs != 0][0])
+        raise ConnectionError(
+            f"fused step: in-program window put failed (rc={bad}); "
+            "the transport rejected or dropped the dispatch")

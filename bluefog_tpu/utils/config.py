@@ -19,6 +19,7 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_COALESCE      | 1     | 0: legacy per-message transport sends |
 | BLUEFOG_TPU_WIN_NATIVE        | 1     | 0: keep the transport hot loop (batch/drain/fold) in Python; 1 auto-falls back when the native core is missing/stale |
 | BLUEFOG_TPU_WIN_XLA           | 1     | 0: pin the host-staged put path (the bitwise oracle); 1 auto-disarms (one warning) without jax.ffi, the bf_xla native symbols, or host-addressable device buffers |
+| BLUEFOG_TPU_FUSED_STEP        | 0     | whole-step compilation (ops/fused_step.py): optimizer math + per-bucket window puts lower into one jitted XLA program; 0 pins the eager step (the bitwise oracle); 1 auto-falls back to eager (one warning) when the XLA put path is disarmed |
 | BLUEFOG_TPU_WIN_COALESCE_LINGER_MS | 1.0 | sender-worker linger before flushing a partial batch |
 | BLUEFOG_TPU_WIN_COALESCE_BYTES | 1 MiB | queued bytes that force an immediate batch flush |
 | BLUEFOG_TPU_WIN_TX_QUEUE      | 1024  | per-peer outbound queue bound (messages); full blocks the producer |
@@ -296,6 +297,16 @@ class Config:
     # (non-CPU backends, pending the TPU lowering); 0 pins the host-staged
     # PR-9 path unconditionally — the bitwise equivalence oracle.
     win_xla: bool
+    # Whole-step compilation (ops/fused_step.py): the distributed window
+    # optimizers lower (optimizer update x bucket concat x per-bucket
+    # window put) into one jitted XLA program; bucket puts issue as XLA
+    # materializes each bucket, pipelining against the remaining update
+    # math by data dependence instead of the hand-rolled _pending list.
+    # OFF by default — with fused_step=0 no program is built anywhere and
+    # every step is bit-identical to the eager path.  1 auto-falls back
+    # to eager (one logged warning) whenever the XLA put path is
+    # disarmed (no jax.ffi / native symbols / non-CPU backend).
+    fused_step: bool
     # Transient-send retry policy of the DCN transport (ops/transport.py):
     # how many times a failed native send is retried with jittered
     # exponential backoff (base win_retry_backoff_ms, doubling per
@@ -478,6 +489,7 @@ class Config:
                 "BLUEFOG_TPU_WIN_DECODE_THREADS", floor=0),
             win_native=_flag("BLUEFOG_TPU_WIN_NATIVE", default=True),
             win_xla=_flag("BLUEFOG_TPU_WIN_XLA", default=True),
+            fused_step=_flag("BLUEFOG_TPU_FUSED_STEP"),
             win_retries=int(os.environ.get(
                 "BLUEFOG_TPU_WIN_RETRIES", "1")),
             win_retry_backoff_ms=float(os.environ.get(

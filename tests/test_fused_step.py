@@ -1,0 +1,301 @@
+"""Whole-step compilation (BLUEFOG_TPU_FUSED_STEP, ops/fused_step.py).
+
+Covers the tentpole's contract surface:
+
+  * the fused-vs-eager BITWISE state oracle on the loopback store pair:
+    the same gradient stream stepped through the single jitted program
+    (optimizer math + in-program per-bucket FFI puts + embedded drain)
+    lands bit-identical parameters AND window state (staging rows,
+    version counters, associated-P) as the eager handle-pipelined step,
+    across the {none, bf16, sparse:0.5} wire codecs x {+-associated-P};
+  * program-cache invalidation: a ``set_topology`` and a committed
+    membership change each force a rebuild (a stale program must never
+    dispatch against a new topology generation);
+  * ``BLUEFOG_TPU_FUSED_STEP=0`` inertness — the default pins the eager
+    path as the bitwise oracle: no program is built, no ``bf_fused_step_*``
+    metric is registered;
+  * graceful fallback (one warning, eager result) for a configuration
+    the compiler cannot lower (per-leaf ``fuse=False`` windows).
+"""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import native
+from bluefog_tpu import topology as topo
+from bluefog_tpu.ops import fused_step as F
+from bluefog_tpu.ops import transport as T
+from bluefog_tpu.ops import window as W
+from bluefog_tpu.ops import xlaffi
+from bluefog_tpu.optim import window_optimizers as WO
+from bluefog_tpu.utils import config, telemetry
+
+needs_fused = pytest.mark.skipif(
+    not (native.available() and native.has_win_xla()
+         and native.has_xla_handler() and xlaffi.has_passthrough()),
+    reason="native core lacks the bf_xla_win_put_pass XLA handler")
+
+
+@pytest.fixture
+def fused_env(monkeypatch):
+    """Set knobs, reload config, and reset every xlaffi cache after."""
+    def set_env(**kv):
+        for k, v in kv.items():
+            monkeypatch.setenv(k, str(v))
+        config.reload()
+        xlaffi._reset_for_tests()
+    yield set_env
+    config.reload()
+    xlaffi._reset_for_tests()
+
+
+def _params():
+    """Two leaves byte-unbalanced enough that ``fusion_buckets=2`` yields
+    two real buckets (two windows, two in-program puts per step)."""
+    return {
+        "b": jnp.asarray(np.random.RandomState(1).randn(8, 20)
+                         .astype(np.float32)),
+        "w": jnp.asarray(np.random.RandomState(0).randn(8, 4, 3)
+                         .astype(np.float32)),
+    }
+
+
+def _grad_stream(params, steps, seed=42):
+    rng = np.random.RandomState(seed)
+    return [jax.tree.map(
+        lambda x: x * 0.01 + jnp.asarray(
+            rng.randn(*x.shape).astype(np.float32)) * 1e-3, params)
+        for _ in range(steps)]
+
+
+def _fake_distrib(transport, server_port):
+    """Even ranks owned here (proc 0), odd ranks 'owned' by proc 1 whose
+    endpoint is the local server transport feeding the SAME store (the
+    windows were created before the directory install, so they carry
+    every rank's slots) — tests/test_win_xla.py's loopback rig."""
+    return W._Distrib(transport,
+                      rank_owner={r: r % 2 for r in range(8)},
+                      proc_addr={0: ("127.0.0.1", 1),
+                                 1: ("127.0.0.1", server_port)},
+                      my_proc=0)
+
+
+def _run_loopback(fused_env, fused, codec, with_p, steps=4):
+    """Step a 2-bucket put-family optimizer against the loopback store
+    pair; returns (final params, per-window state snapshots)."""
+    bf.init(lambda: topo.RingGraph(8))
+    fused_env(BLUEFOG_TPU_WIN_COALESCE=1,
+              BLUEFOG_TPU_WIN_COALESCE_LINGER_MS=500,
+              BLUEFOG_TPU_WIN_NATIVE=1,
+              BLUEFOG_TPU_WIN_XLA=1,
+              BLUEFOG_TPU_WIN_COMPRESSION=codec)
+    if with_p:
+        bf.turn_on_win_ops_with_associated_p()
+    params = _params()
+    opt = WO.DistributedWinPutOptimizer(optax.sgd(0.5), fused=fused,
+                                        fusion_buckets=2)
+    st = opt.init(params)
+    assert len(opt._names) == 2, opt._names
+
+    applied = [0]
+    cv = threading.Condition()
+
+    def bump(k):
+        with cv:
+            applied[0] += k
+            cv.notify_all()
+
+    def apply(op, name, src, dst, weight, p_weight, payload):
+        W._apply_inbound(op, name, src, dst, weight, p_weight, payload)
+        bump(1)
+
+    def apply_batch(msgs):
+        W._apply_inbound_batch(msgs)
+        bump(len(msgs))
+
+    def apply_items(items):
+        W._apply_inbound_items(items)
+        bump(sum((p[5] + p[6]) if k else 1 for k, p in items))
+
+    server = T.WindowTransport(apply, apply_batch=apply_batch,
+                               apply_items=apply_items)
+    client = T.WindowTransport(lambda *a: None)
+    saved = W._store.distrib
+    orig_update = W.win_update
+    expect = [0]
+
+    def synced_update(name, **kw):
+        # Determinism gate: both legs fold the SAME arrived set — the
+        # drain waits until every remote frame this step sent has been
+        # applied (the loopback twin of a quiescent wire).
+        with cv:
+            assert cv.wait_for(lambda: applied[0] >= expect[0],
+                               timeout=30), (applied[0], expect[0])
+        return orig_update(name, **kw)
+
+    try:
+        assert client.native_path, "native sender required for both legs"
+        for name, spl in zip(opt._names, opt._bucket_splits):
+            server.register_window(name, int(spl[-1]))
+        W._store.distrib = _fake_distrib(client, server.port)
+        assert xlaffi.armed(), xlaffi.disarm_reason()
+        W.win_update = synced_update
+        p = params
+        for g in _grad_stream(params, steps):
+            # The (bidirectional) ring's out-edges from owned (even)
+            # srcs all target odd dsts: 8 remote edges per op, per
+            # bucket window.
+            expect[0] += 8 * len(opt._names)
+            p, st = opt.step(p, g, st, require_mutex=False)
+        if fused:
+            assert opt._fused_impl is not None
+            assert opt._fused_impl.fused_steps == steps
+            assert opt._fused_impl.builds == 1
+        states = {n: bf.win_state_dict(n) for n in opt._names}
+        return p, states
+    finally:
+        W.win_update = orig_update
+        W._store.distrib = saved
+        opt.free()
+        client.stop()
+        server.stop()
+        if with_p:
+            bf.turn_off_win_ops_with_associated_p()
+
+
+@needs_fused
+@pytest.mark.parametrize("with_p", [False, True])
+@pytest.mark.parametrize("codec", ["none", "bf16", "sparse:0.5"])
+def test_fused_vs_eager_loopback_state_bitwise(fused_env, codec, with_p):
+    """The fused=1/0 oracle on a live wire: identical parameters and
+    BIT-IDENTICAL window state whether the step ran as one XLA program
+    (puts issued by data dependence inside it) or as the eager
+    put/wait/update sequence, for every codec, with and without the
+    associated push-sum weight."""
+    pe, se = _run_loopback(fused_env, False, codec, with_p)
+    pf, sf = _run_loopback(fused_env, True, codec, with_p)
+    for k in pe:
+        np.testing.assert_array_equal(np.asarray(pe[k]), np.asarray(pf[k]),
+                                      err_msg=f"params[{k}] (bitwise)")
+    for n in se:
+        for part in ("staging", "versions", "p_staging", "main", "p_main"):
+            assert set(se[n][part]) == set(sf[n][part]), (n, part)
+            for k, v in se[n][part].items():
+                np.testing.assert_array_equal(
+                    np.asarray(sf[n][part][k]), np.asarray(v),
+                    err_msg=f"{n}:{part}[{k}] (bitwise)")
+
+
+@needs_fused
+def test_program_cache_invalidation_counts(fused_env):
+    """set_topology AND a committed membership change each force exactly
+    one rebuild; an unchanged configuration replays the cached program."""
+    bf.init(lambda: topo.RingGraph(8))
+    params = _params()
+    opt = WO.DistributedWinPutOptimizer(optax.sgd(0.5), fused=True,
+                                        fusion_buckets=2)
+    st = opt.init(params)
+    try:
+        p = params
+        grads = _grad_stream(params, 6)
+        p, st = opt.step(p, grads[0], st, require_mutex=False)
+        p, st = opt.step(p, grads[1], st, require_mutex=False)
+        assert opt._fused_impl.builds == 1
+
+        # What set_topology / the elastic window rebuild do to the
+        # generation counter (set_topology itself refuses while windows
+        # exist; the restart-free rebuild paths bump the version with
+        # the windows live — basics.py:448).
+        from bluefog_tpu import basics
+        basics._ctx.topology_version += 1
+        p, st = opt.step(p, grads[2], st, require_mutex=False)
+        assert opt._fused_impl.builds == 2, \
+            "a topology generation bump must invalidate the program"
+
+        # A committed membership change (what _maybe_churn_step lands on
+        # opt.membership_change) re-keys on its epoch.
+        opt.membership_change = types.SimpleNamespace(epoch=7,
+                                                      evicted=False)
+        p, st = opt.step(p, grads[3], st, require_mutex=False)
+        assert opt._fused_impl.builds == 3, \
+            "a committed membership change must invalidate the program"
+
+        p, st = opt.step(p, grads[4], st, require_mutex=False)
+        assert opt._fused_impl.builds == 3, \
+            "an unchanged configuration must replay the cached program"
+        assert opt._fused_impl.fused_steps == 5
+    finally:
+        opt.free()
+
+
+def test_fused_step_env_off_is_inert(fused_env):
+    """The =0 oracle's other half: with the flag off (the default) and no
+    explicit fused=, the optimizer never constructs the compiler and no
+    bf_fused_step_* metric appears — the eager path is untouched."""
+    fused_env(BLUEFOG_TPU_FUSED_STEP=0)
+    assert config.get().fused_step is False
+    telemetry.reset()
+    bf.init(lambda: topo.RingGraph(8))
+    params = _params()
+    opt = WO.DistributedWinPutOptimizer(optax.sgd(0.5), fusion_buckets=2)
+    st = opt.init(params)
+    try:
+        p = params
+        for g in _grad_stream(params, 2):
+            p, st = opt.step(p, g, st, require_mutex=False)
+        assert opt._fused_impl is None, "no program may be built at =0"
+        snap = telemetry.snapshot()
+        assert not any(k.startswith("bf_fused_step") for k in snap), \
+            [k for k in snap if k.startswith("bf_fused_step")]
+    finally:
+        opt.free()
+
+
+def test_fused_fallback_unlowerable_config_warns_once(fused_env,
+                                                      monkeypatch):
+    """fuse=False (per-leaf windows) cannot lower: the step falls back to
+    eager with ONE warning, keeps working, and reports inactive."""
+    from bluefog_tpu.utils import logging as bflog
+    bf.init(lambda: topo.RingGraph(8))
+    telemetry.reset()
+    warns = []
+    logger = bflog.get_logger()
+    orig_warning = logger.warning
+    monkeypatch.setattr(
+        logger, "warning",
+        lambda msg, *a, **kw: (warns.append(msg % a if a else msg),
+                               orig_warning(msg, *a, **kw)))
+    params = _params()
+    opt = WO.DistributedWinPutOptimizer(optax.sgd(0.5), fused=True,
+                                        fuse=False)
+    st = opt.init(params)
+    try:
+        p = params
+        for g in _grad_stream(params, 3):
+            p, st = opt.step(p, g, st, require_mutex=False)
+        warns = [m for m in warns
+                 if "falling back to the eager path" in m]
+        assert len(warns) == 1, warns
+        assert opt._fused_impl is not None
+        assert opt._fused_impl.fused_steps == 0
+        assert telemetry.snapshot().get("bf_fused_step_active") == 0.0
+    finally:
+        opt.free()
+
+
+def test_modeled_overlap_shape():
+    """The schedule-dump preview model: bucket i's put issues at compute
+    fraction (i+1)/k and overlaps the remaining (k-i-1)/k."""
+    rows = F.modeled_overlap([100, 200, 300])
+    assert [r["bucket"] for r in rows] == [0, 1, 2]
+    assert rows[0]["overlap"] == pytest.approx(2 / 3)
+    assert rows[-1]["overlap"] == 0.0
+    assert rows[-1]["ready_at"] == 1.0
