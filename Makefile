@@ -8,8 +8,8 @@ PYTEST = python -m pytest -q
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
         stripe-smoke tracerec-smoke async-smoke ffi-smoke fused-smoke \
-        placement-smoke synth-smoke hier-smoke chaos-smoke chaos \
-        links-smoke metrics-lint
+        probe-smoke placement-smoke synth-smoke hier-smoke chaos-smoke \
+        chaos links-smoke metrics-lint
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -20,8 +20,8 @@ PYTEST = python -m pytest -q
 # every native consumer has a Python fallback).
 test: native test-fast bench-comm-smoke prof-smoke transport-smoke \
       stripe-smoke tracerec-smoke async-smoke ffi-smoke fused-smoke \
-      placement-smoke synth-smoke hier-smoke chaos-smoke links-smoke \
-      metrics-lint
+      probe-smoke placement-smoke synth-smoke hier-smoke chaos-smoke \
+      links-smoke metrics-lint
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -168,6 +168,18 @@ ffi-smoke:
 # step-time win is gated by `python bench_comm.py --fused` full runs.
 fused-smoke:
 	env JAX_PLATFORMS=cpu python bench_comm.py --fused-smoke
+
+# In-program probe CI gate (BLUEFOG_TPU_PROBE, utils/probes.py): run the
+# fused loopback rig with probes on and assert the whole reconcile loop —
+# every step served fused, a measured bf_fused_overlap_ratio in (0, 1],
+# probe events drained (bf_probe_events_total > 0), one
+# bf_fused_bucket_issue_seconds series per fusion bucket, a finite
+# measured-vs-modeled divergence, and trace-merge'd timeline output
+# carrying the fused-probe lanes.  Graceful skip when the native core
+# lacks the bf_probe_* / bf_xla_probe symbols (the feature then degrades
+# to the labeled-but-unattributed fused-step phase, tested in tier 1).
+probe-smoke:
+	env JAX_PLATFORMS=cpu python bench_comm.py --probe-smoke
 
 # Churn-controller CI gate: a real 4-process `bfrun --chaos` gang on the
 # CPU backend, one rank SIGKILLed mid-gossip — asserts the survivors reach

@@ -64,6 +64,7 @@ import argparse
 import json
 import os
 import time
+from typing import Optional
 
 
 def _parse_args():
@@ -119,6 +120,17 @@ def _parse_args():
                         "graceful one-warning fallback; no timing "
                         "assertion, graceful skip without the native "
                         "bf_xla_win_put_pass handler")
+    p.add_argument("--probe-smoke", action="store_true",
+                   help="CI gate of the in-program probes "
+                        "(`make probe-smoke`): a fused loopback run with "
+                        "BLUEFOG_TPU_PROBE on (the default) asserts the "
+                        "probe surfaces land — bf_fused_overlap_ratio in "
+                        "(0, 1], per-bucket issue histograms, "
+                        "bf_probe_events_total, a finite measured-vs-"
+                        "modeled divergence — and that trace-merge emits "
+                        "valid JSON carrying the fused-probe lanes; "
+                        "graceful skip when the native core lacks "
+                        "bf_xla_probe")
     p.add_argument("--async-smoke", action="store_true",
                    help="structural CI gate of the barrier-free async "
                         "gossip mode (`make async-smoke`): a loopback "
@@ -1190,22 +1202,62 @@ def _fused_rig(fused, leaves, cols, buckets, steps, warm=0, synced=False,
         server.stop()
 
 
+def _probe_overlap_cell(buckets: int, steps: int) -> Optional[dict]:
+    """Measured-overlap summary over the fused leg's last ``steps``
+    probe reconciles: the MEASURED ``bf_fused_overlap_ratio`` median
+    (replacing the static model as the headline number), per-bucket
+    p50/p99 put-issue latencies, and the modeled mean kept solely for
+    the divergence ratio (the link-observatory x3 pattern)."""
+    import numpy as np
+
+    from bluefog_tpu.utils import probes
+    rows = probes.recent_summaries(steps)
+    if not rows:
+        return None
+    meas = [r["measured_overlap"] for r in rows]
+    modeled = rows[-1].get("modeled_overlap")
+    measured = float(np.median(meas))
+    cell = {
+        "measured_overlap": round(measured, 4),
+        "modeled_overlap": modeled,
+        "overlap_divergence": (round(measured / modeled, 3)
+                               if modeled else None),
+        "reconciled_steps": len(rows),
+        "bucket_issue_us": {},
+    }
+    for bi in range(buckets):
+        vals = [r["bucket_issue_seconds"][bi] * 1e6 for r in rows
+                if bi in r["bucket_issue_seconds"]]
+        if vals:
+            cell["bucket_issue_us"][str(bi)] = {
+                "p50": round(float(np.percentile(vals, 50)), 1),
+                "p99": round(float(np.percentile(vals, 99)), 1),
+            }
+    return cell
+
+
 def _fused_timing_cell(steps=40, warm=6):
     """The acceptance cell: eager vs fused end-to-end step time on the
     ungated loopback rig at the window-heavy configuration (32 leaves x
-    (8, 128) over 8 fusion buckets = 8 in-program puts per step)."""
+    (8, 128) over 8 fusion buckets = 8 in-program puts per step).
+
+    When the native core carries the in-program probes the cell reports
+    MEASURED overlap (median over the timed steps) with per-bucket
+    p50/p99 issue latencies; the static model stays only as the
+    denominator of the divergence ratio."""
     import numpy as np
 
-    from bluefog_tpu.utils import telemetry
+    from bluefog_tpu.utils import probes, telemetry
     leaves, cols, buckets = 32, 128, 8
     te, _, _ = _fused_rig(False, leaves, cols, buckets, steps, warm)
     telemetry.reset()
+    probes._reset_for_tests()
     tf, _, fsteps = _fused_rig(True, leaves, cols, buckets, steps, warm)
     snap = telemetry.snapshot()
     compile_s = snap.get("bf_fused_step_compile_seconds_sum", 0.0)
     e50, e99 = np.percentile(te, 50), np.percentile(te, 99)
     f50, f99 = np.percentile(tf, 50), np.percentile(tf, 99)
-    return {
+    cell = {
         "leaves": leaves, "cols": cols, "fusion_buckets": buckets,
         "steps": steps,
         "eager_ms_p50": round(float(e50), 3),
@@ -1216,6 +1268,10 @@ def _fused_timing_cell(steps=40, warm=6):
         "compile_seconds": round(float(compile_s), 3),
         "fused_steps": fsteps,
     }
+    overlap = _probe_overlap_cell(buckets, steps)
+    if overlap is not None:
+        cell["overlap"] = overlap
+    return cell
 
 
 def _fused_report(smoke: bool):
@@ -1376,6 +1432,116 @@ def fused_main(args) -> int:
         "detail": detail,
     }))
     return rc
+
+
+def probe_main(args) -> int:
+    """`make probe-smoke`: the in-program probe CI gate.
+
+    One fused loopback run (probes on by default) must land every probe
+    surface: the measured ``bf_fused_overlap_ratio`` gauge in (0, 1],
+    per-bucket ``bf_fused_bucket_issue_seconds`` histograms,
+    ``bf_probe_events_total``, a finite measured-vs-modeled divergence
+    ratio, and — with a timeline armed — trace-merge output that is
+    valid JSON carrying the ``fused-probe`` lanes.  Structural only (no
+    timing assertion); graceful skip when the native core predates
+    ``bf_xla_probe``."""
+    import sys
+    import tempfile
+
+    prev = _fused_env_setup()
+    prev["BLUEFOG_TPU_PYTHON_TIMELINE"] = os.environ.get(
+        "BLUEFOG_TPU_PYTHON_TIMELINE")
+    # Probe lanes need the args-capable Python writer for lane naming,
+    # and the in-band clock anchor keeps trace-merge alignment exact.
+    os.environ["BLUEFOG_TPU_PYTHON_TIMELINE"] = "1"
+    try:
+        from bluefog_tpu import native, tools
+        from bluefog_tpu.ops import xlaffi
+        from bluefog_tpu.utils import config as _config
+        from bluefog_tpu.utils import probes, telemetry, timeline
+        _config.reload()
+        xlaffi._reset_for_tests()
+        if not (native.available() and native.has_win_xla()
+                and native.has_xla_handler() and xlaffi.has_passthrough()
+                and native.has_probe()):
+            reason = ("native core lacks bf_xla_probe"
+                      if native.available() else "native core unavailable")
+            print(json.dumps({
+                "metric": "probe_overlap_measured",
+                "value": None, "unit": "ratio", "status": "no_probe",
+                "detail": {"reason": reason}}))
+            return 0
+
+        failures = []
+        buckets, steps = 2, 8
+        tmpdir = tempfile.mkdtemp(prefix="bf-probe-smoke-")
+        prefix = os.path.join(tmpdir, "tl_")
+        telemetry.reset()
+        probes._reset_for_tests()
+        timeline.start_timeline(f"{prefix}0.json")
+        try:
+            _, _, fsteps = _fused_rig(True, 4, 64, buckets, steps)
+        finally:
+            timeline.stop_timeline()
+
+        if fsteps != steps:
+            failures.append(f"only {fsteps}/{steps} steps took the "
+                            "fused path")
+        snap = telemetry.snapshot()
+        ratio = snap.get("bf_fused_overlap_ratio")
+        if ratio is None or not (0.0 < ratio <= 1.0):
+            failures.append(f"bf_fused_overlap_ratio {ratio!r} not in "
+                            "(0, 1]")
+        if not snap.get("bf_probe_events_total"):
+            failures.append("bf_probe_events_total missing or zero")
+        issue_counts = [k for k in snap
+                        if k.startswith("bf_fused_bucket_issue_seconds"
+                                        "_count")]
+        if len(issue_counts) < buckets:
+            failures.append("per-bucket issue histograms missing: "
+                            f"{issue_counts}")
+        div = snap.get("bf_fused_overlap_divergence_ratio")
+        if div is None or not (div > 0):
+            failures.append(f"divergence ratio {div!r} not finite/positive")
+
+        summary = probes.last_summary()
+        if summary is None:
+            failures.append("probes.last_summary() is None after a "
+                            "fused run")
+
+        merged = tools.trace_merge(prefix)
+        try:
+            with open(merged) as f:
+                events = json.load(f)  # must be VALID json
+        except ValueError as e:
+            events, failures = [], failures + [f"trace-merge output is "
+                                               f"not valid JSON: {e}"]
+        lanes = {e.get("tid") for e in events
+                 if e.get("cat") == "fused-probe"}
+        if not lanes:
+            failures.append("no fused-probe lanes in the merged trace")
+
+        rc = 0
+        for f in failures:
+            print(f"bench_comm --probe-smoke: {f}", file=sys.stderr)
+            rc = 1
+        print(json.dumps({
+            "metric": "probe_overlap_measured",
+            "value": ratio,
+            "unit": "ratio",
+            "detail": {
+                "fused_steps": fsteps,
+                "overlap": _probe_overlap_cell(buckets, steps),
+                "probe_events": snap.get("bf_probe_events_total"),
+                "divergence": div,
+                "probe_lanes": sorted(int(t) for t in lanes
+                                      if t is not None),
+                "merged_events": len(events),
+            },
+        }))
+        return rc
+    finally:
+        _fused_env_restore(prev)
 
 
 def tracerec_main(args) -> int:
@@ -2405,6 +2571,8 @@ def main():
         return ffi_main(args)
     if args.fused or args.fused_smoke:
         return fused_main(args)
+    if args.probe_smoke:
+        return probe_main(args)
     if args.async_smoke:
         return async_main(args)
     if args.tracerec_smoke:

@@ -59,6 +59,18 @@ one per in-program plan dispatch) and ``bf_fused_step_overlap_seconds``
 (histogram labeled by bucket: wall time between a bucket's put issuing
 inside the program and the program completing — the window the put
 actually overlapped).  With the flag off none of these mutate.
+
+In-program probes (``BLUEFOG_TPU_PROBE``, default on): when the native
+core exports ``bf_xla_probe``, the program threads passthrough timestamp
+custom calls at its semantic seams — grad-ready at entry, pre/post each
+bucket's put chain, step end — and the host notes its drain seams into
+the same ring; ``utils/probes.reconcile`` then turns one post-step drain
+into measured overlap (``bf_fused_overlap_ratio``), per-bucket issue
+latencies, real ``bf_step_phase_seconds`` attribution for an active
+``bf.step_profile()`` and chrome-timeline probe lanes.  The probes
+supersede the Python ``io_callback`` stamps (kept as the fallback when
+the ``.so`` predates the probe symbols).  ``BLUEFOG_TPU_PROBE=0``
+compiles none of this — the program is bitwise the pre-probe lowering.
 """
 
 from __future__ import annotations
@@ -72,6 +84,7 @@ import numpy as np
 from bluefog_tpu import basics
 from bluefog_tpu.ops import window as W
 from bluefog_tpu.ops import xlaffi
+from bluefog_tpu.utils import probes as _probes
 
 __all__ = ["FusedStep", "FusedFallback", "modeled_overlap"]
 
@@ -95,7 +108,7 @@ class _Program:
     __slots__ = (
         "key", "step_fn", "finish_fn", "finish_host_drain", "names",
         "plans", "tx", "edges", "remote_procs", "sched", "stamps",
-        "n_put_calls", "accumulate",
+        "n_put_calls", "accumulate", "probes",
     )
 
 
@@ -222,6 +235,10 @@ class FusedStep:
             W._store.associated_p_enabled,
             (getattr(d.transport, "_tx", None) if d is not None else None),
             telemetry.enabled(),
+            # Flipping BLUEFOG_TPU_PROBE (or a core rebuild gaining the
+            # probe symbols) must miss the cache: the probe ops are
+            # compiled INTO the program.
+            (cfg.probe and _probes.available()),
         )
 
     def _resolve_edges(self, dst_weights):
@@ -293,8 +310,32 @@ class FusedStep:
                     fns.append(f)
             put_fns.append(fns)
 
+        # In-program probes: passthrough timestamp custom calls threaded
+        # at the program's seams via data dependence (operand aliased to
+        # result — XLA cannot reorder them past their consumers).  When
+        # they compile in, the Python io_callback stamps below are
+        # superseded: the probe reconciler feeds the same histogram from
+        # in-program clocks at a fraction of the cost.
+        from bluefog_tpu.utils import config as _cfgmod
+        k_buckets = len(opt._buckets)
+        probe_on = _cfgmod.get().probe and xlaffi.has_probe() \
+            and _probes.arm()
+        p_grad = p_end = None
+        p_pre: List[Optional[object]] = []
+        p_post: List[Optional[object]] = []
+        if probe_on:
+            p_grad = xlaffi.xla_probe_program(_probes.GRAD_READY)
+            p_end = xlaffi.xla_probe_program(_probes.STEP_END)
+            p_pre = [xlaffi.xla_probe_program(_probes.BUCKET_PRE + i)
+                     for i in range(k_buckets)]
+            p_post = [xlaffi.xla_probe_program(_probes.BUCKET_POST + i)
+                      for i in range(k_buckets)]
+            probe_on = (p_grad is not None and p_end is not None
+                        and all(p_pre) and all(p_post))
+        prog.probes = probe_on
+
         stamp_fns: List[Optional[object]] = [None] * len(opt._names)
-        if telemetry.enabled() and any(put_fns):
+        if telemetry.enabled() and any(put_fns) and not probe_on:
             try:
                 from jax.experimental import io_callback as _iocb
             except Exception:  # noqa: BLE001 — no stamps on older jax
@@ -316,6 +357,12 @@ class FusedStep:
         buckets = opt._buckets
 
         def _step(params_t, grads_t, state_t):
+            if probe_on:
+                # Grad-ready: threaded through one gradient leaf, so the
+                # stamp data-precedes the update math consuming it.
+                g_leaves, g_td = jax.tree_util.tree_flatten(grads_t)
+                g_leaves[0] = p_grad(g_leaves[0])
+                grads_t = jax.tree_util.tree_unflatten(g_td, g_leaves)
             updates, new_state = jax.vmap(
                 lambda g, s, p: base.update(g, s, p))(
                     grads_t, state_t, params_t)
@@ -326,16 +373,22 @@ class FusedStep:
                 flat = jnp.concatenate(
                     [jnp.reshape(leaves[i], (rows, -1)) for i in idxs],
                     axis=1)
+                if probe_on:
+                    flat = p_pre[bi](flat)  # bucket flat materialized
                 sts = []
                 for f in put_fns[bi]:
                     flat, st = f(flat)
                     sts.append(st)
+                if probe_on:
+                    flat = p_post[bi](flat)  # put chain issued
                 st_all = (jnp.concatenate(sts) if sts
                           else jnp.zeros((1,), jnp.int32))
                 if sts and stamp_fns[bi] is not None:
                     stamp_fns[bi](st_all)
                 flats.append(flat)
                 statuses.append(st_all)
+            if probe_on and flats:
+                flats[-1] = p_end(flats[-1])  # program tail
             return flats, statuses, new_state
 
         # Finish: the host drain — win_update (or the push-sum collect)
@@ -496,6 +549,9 @@ class FusedStep:
                 params, grads, state.base)
             sts = [np.asarray(s) for s in statuses]  # waits for the puts
         t_done = time.monotonic()
+        # Host-sync seam: how long the host sat on the statuses AFTER the
+        # program's own tail (reconcile bills it as host-sync).
+        t_statuses_ns = time.monotonic_ns() if prog.probes else None
 
         self._check_statuses(prog, sts, flats)
 
@@ -530,13 +586,39 @@ class FusedStep:
         if pre_drain is not None:  # push-sum fence / stale-residual fold
             pre_drain()
 
+        if prog.probes:  # host seams go into the same ring/clock
+            _probes.note(_probes.DRAIN_START)
         combined = prog.finish_host_drain()
+        if prog.probes:
+            _probes.note(_probes.DRAIN_COMMIT)
         merged = prog.finish_fn(params, *combined)
+        if prog.probes:
+            _probes.note(_probes.FINISH_DONE)
 
         t = int(state.step)
         # Device arrays go in as-is (the eager step does the same): the
         # sampler gates on its cadence before touching a single element.
         opt._maybe_sample_consensus(t, list(flats), list(combined))
+
+        # Reconcile the step's probe events into measured overlap, the
+        # per-bucket issue histograms, timeline lanes and — when a
+        # StepProfiler wraps this step — real phase attribution.  The
+        # modeled mean is the average of modeled_overlap()'s rows,
+        # (k-1)/(2k): the divergence gauge compares like with like.
+        attributed = False
+        if prog.probes:
+            k = len(opt._buckets)
+            modeled = (k - 1) / (2 * k) if k else 0.0
+            summary = _probes.reconcile(k, modeled_mean=modeled,
+                                        t_statuses_ns=t_statuses_ns)
+            attributed = bool(summary and summary.get("attributed"))
+        from bluefog_tpu.utils import profiler as _profiler
+        prof = _profiler.active()
+        if prof is not None:
+            # Without probe attribution the profiler labels the fused
+            # program's opaque remainder "fused-step", not grad-compute.
+            prof.note_fused(attributed)
+
         telemetry.set_gauge("bf_fused_step_active", 1.0)
         self.fused_steps += 1
         return merged, DistOptState(new_base, state.step + 1)

@@ -545,6 +545,13 @@ def _ensure_registered() -> bool:
                 "bf_xla_win_put_pass",
                 mod.pycapsule(lib.bf_xla_win_put_pass),
                 platform="cpu")
+        # In-program probe (BLUEFOG_TPU_PROBE): the timestamp custom call
+        # the fused step threads through its semantic seams.  Same
+        # degradation contract as the pass variant.
+        if hasattr(lib, "bf_xla_probe"):
+            mod.register_ffi_target("bf_xla_probe",
+                                    mod.pycapsule(lib.bf_xla_probe),
+                                    platform="cpu")
         _registered[0] = True
     return True
 
@@ -559,6 +566,42 @@ def has_passthrough() -> bool:
         return hasattr(native.lib(), "bf_xla_win_put_pass")
     except Exception:  # noqa: BLE001 — treat load failure as absent
         return False
+
+
+def has_probe() -> bool:
+    """True when the in-program probe FFI target is registered (native
+    core carries ``bf_xla_probe`` + the ring symbols and jax has an FFI
+    module)."""
+    if not _ensure_registered():
+        return False
+    return native.has_probe()
+
+
+def xla_probe_program(probe_id: int):
+    """A timestamp probe lowered INTO a compiled program: returns
+    ``f(x) -> x`` where the output IS the input buffer
+    (``input_output_aliases={0: 0}`` — XLA donates it, no copy) and the
+    custom call records ``(probe_id, steady-clock ns, counter)`` into the
+    native probe ring as a side effect.  Because the caller rethreads its
+    value through the probe, the recorded instant is pinned into the
+    program's dataflow: XLA cannot hoist the probe above the work that
+    produced ``x`` or sink it below the stages that consume the output.
+    None when the probe handler is unavailable (the Python stamp fallback
+    still works)."""
+    if not has_probe():
+        return None
+    from bluefog_tpu import _compat
+    import jax
+    mod = _compat.jax_ffi()
+
+    def run(x):
+        call = mod.ffi_call(
+            "bf_xla_probe",
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            has_side_effect=True,
+            input_output_aliases={0: 0})
+        return call(x, probe_id=np.int64(probe_id))
+    return run
 
 
 def xla_put_program(plan_id: int, tx: int):

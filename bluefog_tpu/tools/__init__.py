@@ -59,6 +59,16 @@ step the reference never had:
       stalest contribution — one refresh-loop terminal frame, no
       curses.  ``--once`` renders a single frame for scripts and CI.
 
+  python -m bluefog_tpu.tools bench-trend [dir] [--pattern GLOB]
+      Perf-trajectory table from the repo's per-round bench records
+      (``BENCH_r<N>.json``): one row per round with its rc, the
+      headline metric/value/unit, the signed delta against the previous
+      round that reported the SAME metric, and the recorded
+      vs-baseline factor.  Rounds whose bench had no backend
+      (``parsed: null``) render as ``(no parsed result)`` instead of
+      vanishing — a gap in the trajectory is itself signal.  Pure
+      stdlib over local files.
+
   python -m bluefog_tpu.tools chaos [--np 4] [--kill-rank K] [--smoke]
       Chaos harness for the churn controller (``tools/chaos.py``): launch
       a CPU multi-process gang under ``bfrun --chaos``, SIGKILL one rank
@@ -77,7 +87,8 @@ import re
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["load_trace_events", "rank_files", "trace_merge",
-           "phase_durations", "trace_summary", "schedule_dump", "main"]
+           "phase_durations", "trace_summary", "schedule_dump",
+           "bench_trend", "main"]
 
 _ANCHOR = "bf_clock_anchor"  # timeline.CLOCK_ANCHOR_NAME (no jax import here)
 
@@ -462,6 +473,68 @@ def _hier_dump_lines(model, n: int, slices: int, outer_every: int,
     return out
 
 
+def bench_trend(directory: str = ".",
+                pattern: str = "BENCH_r*.json") -> str:
+    """Perf-trajectory table from the repo's per-round bench records.
+
+    Every growth round leaves a ``BENCH_r<N>.json`` (``{"n", "cmd",
+    "rc", "tail", "parsed"}``; ``parsed`` is the bench's one-line JSON
+    result, or null when the round had no backend).  This tabulates them
+    into the trajectory the individual files cannot show: one row per
+    round with the headline metric, and the delta against the previous
+    round that reported the SAME metric — so a perf regression shows up
+    as a signed percentage, not a diff between two JSON blobs.  Pure
+    stdlib over local files; no jax, no network."""
+    import os
+    rows = []
+    for path in sorted(glob.glob(os.path.join(directory, pattern))):
+        name = os.path.basename(path)
+        m = re.search(r"r(\d+)", name)
+        rnd = int(m.group(1)) if m else -1
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = None
+        rows.append((rnd, name, doc))
+    if not rows:
+        return (f"bench-trend: no files match "
+                f"{os.path.join(directory, pattern)}")
+    lines = [f"{'round':>5}  {'rc':>3}  {'metric':<44} {'value':>12}  "
+             f"{'unit':<8} {'vs_prev':>8}  {'vs_base':>8}"]
+    lines.append("-" * len(lines[0]))
+    last_value: Dict[str, float] = {}
+    for rnd, name, doc in sorted(rows):
+        if doc is None:
+            lines.append(f"{rnd:>5}  {'?':>3}  "
+                         f"{'<unreadable: ' + name + '>':<44}")
+            continue
+        rc = doc.get("rc")
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            lines.append(f"{rnd:>5}  {rc if rc is not None else '?':>3}  "
+                         f"{'(no parsed result)':<44}")
+            continue
+        metric = str(parsed.get("metric", "?"))
+        value = parsed.get("value")
+        unit = str(parsed.get("unit", ""))
+        base = parsed.get("vs_baseline")
+        prev_txt = "-"
+        if isinstance(value, (int, float)):
+            prev = last_value.get(metric)
+            if prev:
+                prev_txt = f"{(value / prev - 1.0) * 100:+.1f}%"
+            last_value[metric] = float(value)
+        val_txt = (f"{value:g}" if isinstance(value, (int, float))
+                   else "-")
+        base_txt = (f"{base:g}x" if isinstance(base, (int, float))
+                    else "-")
+        lines.append(f"{rnd:>5}  {rc if rc is not None else '?':>3}  "
+                     f"{metric:<44} {val_txt:>12}  {unit:<8} "
+                     f"{prev_txt:>8}  {base_txt:>8}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     import sys
     argv = sys.argv[1:] if argv is None else list(argv)
@@ -502,6 +575,17 @@ def main(argv=None) -> int:
     pg.add_argument("--json", action="store_true",
                     help="emit stats + the per-edge delay table as one "
                          "machine-readable JSON document on stdout")
+    pb = sub.add_parser(
+        "bench-trend",
+        help="perf-trajectory table from the per-round BENCH_r*.json "
+             "records: one row per round with the headline metric and "
+             "the delta vs the previous round reporting it")
+    pb.add_argument("directory", nargs="?", default=".",
+                    help="directory holding the BENCH_r*.json files "
+                         "(default: current directory)")
+    pb.add_argument("--pattern", default="BENCH_r*.json",
+                    help="glob for the bench records "
+                         "(default BENCH_r*.json)")
     # Listed for --help only; the real dispatch happens above (the chaos
     # harness owns its own flag surface, including the bfrun-launched
     # --worker mode).
@@ -572,6 +656,9 @@ def main(argv=None) -> int:
             hier_compression=args.hier_compression,
             lowering=args.lowering, fusion_buckets=args.fusion_buckets,
             payload_mb=args.payload_mb))
+        return 0
+    if args.cmd == "bench-trend":
+        print(bench_trend(args.directory, args.pattern))
         return 0
     if args.cmd == "trace-gossip":
         from bluefog_tpu.tools.tracegossip import main_trace_gossip

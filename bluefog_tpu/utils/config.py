@@ -50,6 +50,7 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_TELEMETRY_CONSENSUS_EVERY | 10 | consensus-distance sample period (0=off) |
 | BLUEFOG_TPU_PROFILE           | 0     | 1: enable the step profiler's periodic sampling |
 | BLUEFOG_TPU_PROFILE_EVERY     | 50    | straggler-gather / synced-sample period (steps) |
+| BLUEFOG_TPU_PROBE             | 1     | in-program probes (utils/probes.py): native timestamp custom calls threaded through the fused step program — measured overlap, fused-path phase attribution, per-bucket timeline lanes; 0 compiles no probe ops and is bitwise inert |
 | BLUEFOG_TPU_SCHEDULE_OPT      | 1     | 0: skip the min-round schedule repack |
 | BLUEFOG_TPU_SCHEDULE_SYNTH    | 1     | 0: skip sketch-guided schedule synthesis (PR 5 congestion-repack path exactly) |
 | BLUEFOG_TPU_SCHEDULE_SYNTH_SKETCH | auto | synthesis sketch: auto / ring-within-slice / hierarchical / chunked-pipelined |
@@ -461,6 +462,16 @@ class Config:
     # overrides both.  bf.step_profile() works regardless of this flag.
     profile: bool
     profile_every: int
+    # In-program probes (utils/probes.py + native xlacall.cc): the fused
+    # step program threads bf_xla_probe timestamp custom calls through its
+    # semantic seams (per-bucket put issue, step end) and a post-step
+    # reconciler maps the ring events into measured overlap, fused-path
+    # phase attribution and per-bucket timeline lanes.  ON by default —
+    # one probe is a relaxed atomic claim + a 16-byte store (~ns).  0
+    # compiles NO probe ops into the program and never arms the ring:
+    # bitwise inert.  Structurally inert anyway while fused_step is off
+    # (the eager path carries no probes).
+    probe: bool
 
     @staticmethod
     def from_env() -> "Config":
@@ -560,6 +571,7 @@ class Config:
             profile=_flag("BLUEFOG_TPU_PROFILE"),
             profile_every=int(
                 os.environ.get("BLUEFOG_TPU_PROFILE_EVERY", "50")),
+            probe=_flag("BLUEFOG_TPU_PROBE", default=True),
         )
 
 

@@ -1,0 +1,331 @@
+"""In-program probes: native timestamps inside the compiled fused step.
+
+The fused step (``ops/fused_step.py``) compiles the whole training step
+into one XLA program, which makes the step FAST and the step OPAQUE:
+host-side wall clocks see one black interval, so ``bf.step_profile()``
+books the entire program as grad-compute and the overlap story rests on
+the static :func:`~bluefog_tpu.ops.fused_step.modeled_overlap` preview.
+This module puts the clock back inside the program.
+
+``bf_xla_probe`` (``native/src/xlacall.cc``) is a passthrough XLA FFI
+custom call: its operand is aliased to its result, so threading a value
+through it creates a data dependency XLA cannot reorder, and its body is
+one relaxed atomic claim plus a 16-byte store of ``(probe_id,
+steady_clock ns, seq)`` into a lock-free ring — no GIL, no allocation,
+cheap enough to leave on by default.  The fused program threads probes
+at its semantic seams (grad-ready, per-bucket put-issue pre/post, step
+end); the host notes its own seams (drain start/commit, finish) into
+the SAME ring through the C ABI, so one post-step :func:`reconcile`
+drain yields the full step chronology on one clock
+(``std::chrono::steady_clock`` == ``time.monotonic_ns()`` ==
+the timeline's microsecond event clock — all CLOCK_MONOTONIC).
+
+Reconcile maps the events into the existing surfaces:
+
+  * real fused-path phase attribution for the active ``StepProfiler``
+    (``bf_step_phase_seconds``: optimizer-update = in-program tail after
+    the update math minus the put-issue windows; gossip-communicate =
+    put-issue windows + the host drain; host-sync = status wait past
+    program end; remainder stays grad-compute);
+  * a MEASURED ``bf_fused_overlap_ratio`` gauge — the model treats each
+    bucket's put issue as an instant; in the program it is a WINDOW
+    (``k`` sequential FFI dispatches), so the instant maps to the
+    window's temporal center and
+    ``overlap_i = clamp((t_end - mid_i) / (t_end - t_grad), 0, 1)``
+    with ``mid_i = (t_pre_i + t_post_i) / 2`` — the fraction of the
+    program still ahead when bucket ``i``'s put was in flight.  When
+    dispatch is cheap (the TPU case) ``mid == post`` and this IS the
+    model's definition; when dispatch windows span the program (CPU
+    loopback, where XLA's thread pool runs bucket chains concurrently)
+    the midpoint keeps the estimate centered instead of collapsing to an
+    endpoint.  The ratio of means against the model is the
+    ``bf_fused_overlap_divergence_ratio`` gauge (alerting at the link-
+    observatory's x3 threshold when measurement and model disagree);
+  * ``bf_fused_bucket_issue_seconds{bucket}`` — the in-program dwell of
+    each bucket's put dispatch chain;
+  * per-bucket lanes in the chrome timeline (cat ``fused-probe``), on
+    the monotonic microsecond clock every other event already uses, so
+    trace-merge aligns them cross-rank via the existing clock anchors.
+
+Gating: ``BLUEFOG_TPU_PROBE`` (default ON).  ``=0`` compiles no probe
+ops at all — the fused program is bitwise identical to the pre-probe
+lowering.  When the native core lacks the probe symbols the fused step
+keeps its Python ``io_callback`` stamps and the profiler labels the
+un-attributable remainder ``fused-step`` (degraded, surfaced in
+``/healthz``).  Registry mutation is additionally telemetry-gated, like
+every other metric source.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "GRAD_READY", "STEP_END", "DRAIN_START", "DRAIN_COMMIT", "FINISH_DONE",
+    "BUCKET_PRE", "BUCKET_POST",
+    "available", "enabled", "arm", "note", "drain", "reconcile",
+    "last_summary", "recent_summaries", "probe_name",
+]
+
+# ---------------------------------------------------------------------------
+# Probe IDs (the ring stores ids, not names — these are the vocabulary)
+# ---------------------------------------------------------------------------
+
+GRAD_READY = 1     # program entry: gradients materialized, update math begins
+STEP_END = 2       # program tail: last bucket's put chain issued
+
+# Host-side seams, noted through the C ABI into the same ring:
+DRAIN_START = 10   # win_update drain begins (host, after statuses land)
+DRAIN_COMMIT = 11  # drain handed combine buffers to the finish program
+FINISH_DONE = 12   # rebuilt/merged params returned to the caller
+
+BUCKET_PRE = 100   # + bucket index: bucket flat ready, put chain about to run
+BUCKET_POST = 200  # + bucket index: bucket's put chain issued
+
+_RING_CAPACITY = 8192
+
+
+def probe_name(pid: int) -> str:
+    """Human name for a probe id (timeline lanes, trace tooling)."""
+    fixed = {GRAD_READY: "grad-ready", STEP_END: "step-end",
+             DRAIN_START: "drain-start", DRAIN_COMMIT: "drain-commit",
+             FINISH_DONE: "finish-done"}
+    if pid in fixed:
+        return fixed[pid]
+    if BUCKET_PRE <= pid < BUCKET_POST:
+        return f"bucket{pid - BUCKET_PRE}-pre"
+    if BUCKET_POST <= pid < BUCKET_POST + 100:
+        return f"bucket{pid - BUCKET_POST}-post"
+    return f"probe{pid}"
+
+
+# ---------------------------------------------------------------------------
+# Ring access (arming, host notes, drain)
+# ---------------------------------------------------------------------------
+
+_arm_lock = threading.Lock()
+_armed = False
+_last: Optional[dict] = None
+_history: "collections.deque" = collections.deque(maxlen=256)
+_lane_names_emitted: set = set()
+
+
+def available() -> bool:
+    """The native core exports the probe ring + FFI handler."""
+    from bluefog_tpu import native
+    return native.has_probe()
+
+
+def enabled() -> bool:
+    """Probes are configured on AND the native core carries them."""
+    from bluefog_tpu.utils import config
+    return config.get().probe and available()
+
+
+def arm(capacity: int = _RING_CAPACITY) -> bool:
+    """Enable the native event ring (idempotent; first capacity wins
+    in-process — the ring is shared by every fused optimizer)."""
+    global _armed
+    if not available():
+        return False
+    with _arm_lock:
+        from bluefog_tpu import native
+        native.lib().bf_probe_enable(int(capacity))
+        _armed = True
+    return True
+
+
+def note(probe_id: int) -> None:
+    """Host-side probe: same ring, same clock as the in-program calls."""
+    if not _armed and not arm():
+        return
+    from bluefog_tpu import native
+    lib = native.lib()
+    if lib is not None:
+        lib.bf_probe_note(int(probe_id))
+
+
+def total() -> int:
+    """Events ever claimed (including any overwritten by ring wrap)."""
+    from bluefog_tpu import native
+    lib = native.lib()
+    if lib is None or not native.has_probe():
+        return 0
+    return int(lib.bf_probe_total())
+
+
+def drain(cap: int = _RING_CAPACITY) -> List[tuple]:
+    """Drain events noted since the previous drain, oldest first, as
+    ``(t_ns, probe_id, seq)`` tuples.  Empty when the ring is off."""
+    from bluefog_tpu import native
+    lib = native.lib()
+    if lib is None or not native.has_probe():
+        return []
+    buf = (native.ProbeEvent * cap)()
+    n = int(lib.bf_probe_drain(buf, cap))
+    if n <= 0:
+        return []
+    return [(int(buf[i].t_ns), int(buf[i].probe_id), int(buf[i].seq))
+            for i in range(n)]
+
+
+def _reset_for_tests() -> None:
+    global _armed, _last
+    from bluefog_tpu import native
+    lib = native.lib()
+    if lib is not None and native.has_probe():
+        lib.bf_probe_reset()
+    with _arm_lock:
+        _armed = False
+    _last = None
+    _history.clear()
+    _lane_names_emitted.clear()
+
+
+# ---------------------------------------------------------------------------
+# Reconcile: events -> metrics, profiler phases, timeline lanes
+# ---------------------------------------------------------------------------
+
+def last_summary() -> Optional[dict]:
+    """The most recent :func:`reconcile` result (bench + tools read this)."""
+    s = _last
+    return None if s is None else dict(s)
+
+
+def recent_summaries(n: Optional[int] = None) -> List[dict]:
+    """The last ``n`` (default: all retained, newest last) reconcile
+    summaries — the bench derives per-bucket p50/p99 issue latencies and
+    the measured-overlap median from these instead of one step's noise."""
+    rows = list(_history)
+    if n is not None:
+        rows = rows[-int(n):]
+    return [dict(r) for r in rows]
+
+
+def _emit_lanes(events: List[tuple], issues: Dict[int, tuple]) -> None:
+    """Per-bucket timeline lanes: X spans on the shared monotonic clock.
+
+    Lane tids sit at 1000+bucket so they group visually under the rank's
+    process lane without colliding with real thread ids; the fused-step
+    umbrella span rides tid 999 and the host drain tid 998."""
+    from bluefog_tpu.utils import timeline
+    if not timeline.timeline_enabled():
+        return
+    ts = {pid: t for (t, pid, _s) in events}
+
+    def span(name, tid, t0_ns, t1_ns):
+        if t0_ns is None or t1_ns is None or t1_ns < t0_ns:
+            return
+        timeline.probe_span(name, t0_ns // 1000, (t1_ns - t0_ns) // 1000,
+                            tid)
+        if tid not in _lane_names_emitted and \
+                timeline.counter_events_supported():
+            # Name the synthetic lane (Python writer only — the native
+            # wire format carries no args payload for M events).
+            timeline.thread_name(tid, f"fused {name.split(' ')[0]}")
+            _lane_names_emitted.add(tid)
+
+    span("fused-step", 999, ts.get(GRAD_READY), ts.get(STEP_END))
+    span("drain", 998, ts.get(DRAIN_START), ts.get(DRAIN_COMMIT))
+    for bi, (t_pre, t_post) in sorted(issues.items()):
+        span(f"bucket{bi} put-issue", 1000 + bi, t_pre, t_post)
+
+
+def reconcile(num_buckets: int, *, modeled_mean: Optional[float] = None,
+              t_statuses_ns: Optional[int] = None) -> Optional[dict]:
+    """Drain the ring and fold one fused step's events into the existing
+    observability surfaces.  Called by ``FusedStep.step()`` after the
+    finish program returns; a no-op (returns None) when the step's
+    in-program probes did not fire (probe path disarmed mid-flight).
+
+    Returns the summary dict it also stores for :func:`last_summary`:
+    ``measured_overlap``, ``modeled_overlap``, ``divergence``,
+    ``bucket_issue_seconds`` and the raw seam timestamps."""
+    global _last
+    events = drain()
+    if not events:
+        return None
+    ts: Dict[int, int] = {}
+    for t_ns, pid, _seq in events:
+        ts[pid] = t_ns  # newest wins: one step's worth per drain
+    t_grad = ts.get(GRAD_READY)
+    t_end = ts.get(STEP_END)
+    if t_grad is None or t_end is None or t_end <= t_grad:
+        return None
+
+    from bluefog_tpu.utils import profiler, telemetry
+    telemetry.inc("bf_probe_events_total", float(len(events)))
+
+    issues: Dict[int, tuple] = {}
+    for bi in range(num_buckets):
+        t_pre = ts.get(BUCKET_PRE + bi)
+        t_post = ts.get(BUCKET_POST + bi)
+        if t_pre is not None and t_post is not None and t_post >= t_pre:
+            issues[bi] = (t_pre, t_post)
+
+    # Measured overlap, same normalization as modeled_overlap(): the
+    # fraction of the program still ahead when each bucket's put was in
+    # flight, taking the issue WINDOW's center as the model's issue
+    # instant (see module docstring).
+    program_ns = t_end - t_grad
+    overlaps = []
+    issue_sum = 0.0
+    for bi, (t_pre, t_post) in sorted(issues.items()):
+        issue_s = (t_post - t_pre) / 1e9
+        issue_sum += issue_s
+        telemetry.observe("bf_fused_bucket_issue_seconds", issue_s,
+                          bucket=str(bi))
+        telemetry.observe("bf_fused_step_overlap_seconds",
+                          max(0.0, (t_end - t_post) / 1e9), bucket=str(bi))
+        mid = (t_pre + t_post) / 2
+        overlaps.append(min(1.0, max(0.0, (t_end - mid) / program_ns)))
+    measured = sum(overlaps) / len(overlaps) if overlaps else 0.0
+    telemetry.set_gauge("bf_fused_overlap_ratio", measured)
+
+    divergence = None
+    if modeled_mean is not None and modeled_mean > 0:
+        divergence = measured / modeled_mean
+        telemetry.set_gauge("bf_fused_overlap_divergence_ratio", divergence)
+
+    # Real phase attribution for the active StepProfiler: the program's
+    # wall time splits into update math (the non-put remainder of the
+    # in-program interval), the put-issue windows + the host drain
+    # (communication), and the status wait past program end (host-sync);
+    # whatever the profiler's remainder logic keeps is true grad-compute.
+    prof = profiler.active()
+    attributed = prof is not None
+    if prof is not None:
+        opt_s = max(0.0, program_ns / 1e9 - issue_sum)
+        comm_s = issue_sum
+        t_ds, t_dc = ts.get(DRAIN_START), ts.get(DRAIN_COMMIT)
+        if t_ds is not None and t_dc is not None and t_dc > t_ds:
+            comm_s += (t_dc - t_ds) / 1e9
+        prof.attribute("optimizer-update", opt_s)
+        if comm_s > 0:
+            prof.attribute("gossip-communicate", comm_s)
+        if t_statuses_ns is not None and t_statuses_ns > t_end:
+            prof.attribute("host-sync", (t_statuses_ns - t_end) / 1e9)
+
+    _emit_lanes(events, issues)
+
+    _last = {
+        "measured_overlap": round(measured, 6),
+        "modeled_overlap": (round(modeled_mean, 6)
+                            if modeled_mean is not None else None),
+        "divergence": (round(divergence, 3)
+                       if divergence is not None else None),
+        "bucket_issue_seconds": {
+            bi: round((tp - t0) / 1e9, 9)
+            for bi, (t0, tp) in sorted(issues.items())},
+        "program_seconds": round(program_ns / 1e9, 9),
+        "attributed": attributed,
+        "events": len(events),
+        "t_grad_ready_ns": t_grad,
+        "t_step_end_ns": t_end,
+        "wall_ns": time.monotonic_ns(),
+    }
+    _history.append(_last)
+    return dict(_last)

@@ -36,6 +36,8 @@ __all__ = [
     "flush",
     "counter_event",
     "counter_events_supported",
+    "probe_span",
+    "thread_name",
     "set_op_span_hook",
     "CLOCK_ANCHOR_NAME",
 ]
@@ -261,6 +263,31 @@ def timeline_context(tensor_name: str, activity_name: str = "USER"):
         yield
     finally:
         timeline_end_activity(tensor_name, activity_name)
+
+
+def probe_span(name: str, ts_us: int, dur_us: int, tid: int,
+               cat: str = "fused-probe") -> None:
+    """Emit one complete ("X") span on a synthetic lane — the in-program
+    probe reconciler (``utils/probes.py``) renders fused-step seams with
+    these.  ``ts_us`` is on the same monotonic microsecond clock as every
+    other event here, so trace-merge's clock anchors align probe lanes
+    cross-rank for free.  Works on both writers (the native wire format
+    carries ``dur``)."""
+    w = _writer
+    if w is None:
+        return
+    w.emit({"name": name, "cat": cat, "ph": "X", "ts": int(ts_us),
+            "dur": max(0, int(dur_us)), "pid": os.getpid(), "tid": int(tid)})
+
+
+def thread_name(tid: int, name: str) -> None:
+    """Label a synthetic lane with a chrome-tracing thread_name metadata
+    event (Python writer only — the native format has no args payload)."""
+    w = _writer
+    if w is None or not hasattr(w, "q"):
+        return
+    w.emit({"name": "thread_name", "ph": "M", "ts": 0, "pid": os.getpid(),
+            "tid": int(tid), "args": {"name": name}})
 
 
 def counter_events_supported() -> bool:

@@ -1,0 +1,304 @@
+"""In-program probes (BLUEFOG_TPU_PROBE, ``utils/probes.py``).
+
+Covers the probe tentpole's contract surface:
+
+  * the native ring ABI: drain order, the shared
+    ``steady_clock == time.monotonic_ns()`` clock domain, and wraparound
+    (an over-full ring keeps exactly the newest ``capacity`` events
+    while ``total()`` still counts everything ever claimed);
+  * ``BLUEFOG_TPU_PROBE=0`` inertness: no probe op is compiled into the
+    fused program, the ring never records, no probe metric registers —
+    and ``BLUEFOG_TPU_TELEMETRY=0`` keeps the registry untouched even
+    with probes firing;
+  * real fused-path phase attribution: a fused step inside
+    ``bf.step_profile()`` reports non-zero gossip-communicate AND
+    optimizer-update (the acceptance criterion — pre-probe, the whole
+    program booked as grad-compute), in loose agreement with the eager
+    leg's span-hook attribution, and never the degraded ``fused-step``
+    label while probes reconcile;
+  * the trace surface: two synthesized ranks' probe lanes
+    (cat ``fused-probe``, tids 998/999/1000+bucket) survive
+    ``tools trace-merge`` into per-rank process lanes.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import native
+from bluefog_tpu import topology as topo
+from bluefog_tpu.ops import xlaffi
+from bluefog_tpu.optim import window_optimizers as WO
+from bluefog_tpu.utils import (config, probes, profiler, telemetry,
+                               timeline)
+
+needs_probe = pytest.mark.skipif(
+    not (native.available() and native.has_probe()),
+    reason="native core lacks the bf_probe_* ring")
+
+needs_fused = pytest.mark.skipif(
+    not (native.available() and native.has_win_xla()
+         and native.has_xla_handler() and xlaffi.has_passthrough()),
+    reason="native core lacks the bf_xla_win_put_pass XLA handler")
+
+
+@pytest.fixture
+def probe_env(monkeypatch):
+    """Env knobs + a pristine probe ring / registry / profiler before AND
+    after (probe state is process-wide — a test must not leak armed rings
+    or degraded flags into the next one)."""
+    def set_env(**kv):
+        for k, v in kv.items():
+            monkeypatch.setenv(k, str(v))
+        config.reload()
+        xlaffi._reset_for_tests()
+    probes._reset_for_tests()
+    telemetry.reset()
+    profiler._reset_for_tests()
+    yield set_env
+    config.reload()
+    xlaffi._reset_for_tests()
+    probes._reset_for_tests()
+    telemetry.reset()
+    profiler._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Ring ABI
+# ---------------------------------------------------------------------------
+
+@needs_probe
+def test_ring_drain_order_and_shared_clock(probe_env):
+    """Events drain oldest-first with contiguous sequence numbers, on the
+    same CLOCK_MONOTONIC domain as ``time.monotonic_ns()`` — the property
+    the reconciler and the timeline lanes both lean on."""
+    assert probes.arm()
+    ids = [probes.GRAD_READY, probes.BUCKET_PRE, probes.BUCKET_POST,
+           probes.STEP_END, probes.DRAIN_START]
+    t0 = time.monotonic_ns()
+    for pid in ids:
+        probes.note(pid)
+    t1 = time.monotonic_ns()
+    ev = probes.drain()
+    assert [pid for _t, pid, _s in ev] == ids
+    assert [s for _t, _p, s in ev] == list(range(len(ids)))
+    ts = [t for t, _p, _s in ev]
+    assert ts == sorted(ts)
+    # Same clock domain: every stamp falls inside the host-side bracket.
+    assert t0 <= ts[0] and ts[-1] <= t1, (t0, ts, t1)
+    assert probes.drain() == [], "a second drain must be empty"
+
+
+@needs_probe
+def test_ring_wraparound_keeps_newest(probe_env):
+    """An over-full ring loses the OLDEST events: noting capacity+50
+    events drains exactly ``capacity`` with the newest sequence numbers,
+    while ``total()`` still counts every claim (the lost-count signal)."""
+    assert probes.arm()
+    cap = int(native.lib().bf_probe_enable(0))  # existing ring's capacity
+    extra = 50
+    for _ in range(cap + extra):
+        probes.note(probes.GRAD_READY)
+    assert probes.total() == cap + extra
+    ev = probes.drain(cap=cap + extra)
+    assert len(ev) == cap, "exactly the newest capacity events survive"
+    seqs = [s for _t, _p, s in ev]
+    assert seqs == list(range(extra, cap + extra)), \
+        (seqs[0], seqs[-1], cap, extra)
+
+
+# ---------------------------------------------------------------------------
+# Inertness gates
+# ---------------------------------------------------------------------------
+
+def _params():
+    return {
+        "b": jnp.asarray(np.random.RandomState(1).randn(8, 20)
+                         .astype(np.float32)),
+        "w": jnp.asarray(np.random.RandomState(0).randn(8, 4, 3)
+                         .astype(np.float32)),
+    }
+
+
+def _grad_stream(params, steps, seed=42):
+    rng = np.random.RandomState(seed)
+    return [jax.tree.map(
+        lambda x: x * 0.01 + jnp.asarray(
+            rng.randn(*x.shape).astype(np.float32)) * 1e-3, params)
+        for _ in range(steps)]
+
+
+def _run_fused(steps=2, profile=False):
+    """The plain fused rig (no loopback wire — puts run against the local
+    store); returns (opt, per-step profiler phase dicts)."""
+    bf.init(lambda: topo.RingGraph(8))
+    params = _params()
+    opt = WO.DistributedWinPutOptimizer(optax.sgd(0.5), fused=True,
+                                        fusion_buckets=2)
+    st = opt.init(params)
+    phases = []
+    try:
+        p = params
+        for g in _grad_stream(params, steps):
+            if profile:
+                with bf.step_profile(straggler=False) as prof:
+                    p, st = opt.step(p, g, st, require_mutex=False)
+                phases.append(prof.phases())
+            else:
+                p, st = opt.step(p, g, st, require_mutex=False)
+        assert opt._fused_impl is not None
+        assert opt._fused_impl.fused_steps == steps
+        return opt, phases
+    finally:
+        opt.free()
+
+
+@needs_fused
+@needs_probe
+def test_probe_env_off_is_bitwise_inert(probe_env):
+    """``BLUEFOG_TPU_PROBE=0`` compiles NO probe ops (the cached program
+    says so), never arms the ring, and registers no probe metric — the
+    fused program is the pre-probe lowering."""
+    probe_env(BLUEFOG_TPU_PROBE=0)
+    assert config.get().probe is False
+    opt, _ = _run_fused(steps=2)
+    assert all(not prog.probes
+               for prog in opt._fused_impl._programs.values()), \
+        "=0 must compile probe-free programs"
+    assert probes.total() == 0, "the ring must never record at =0"
+    snap = telemetry.snapshot()
+    bad = [k for k in snap
+           if k.startswith(("bf_probe_", "bf_fused_overlap",
+                            "bf_fused_bucket"))]
+    assert not bad, bad
+
+
+@needs_fused
+@needs_probe
+def test_telemetry_off_keeps_registry_untouched(probe_env):
+    """Probes ON + ``BLUEFOG_TPU_TELEMETRY=0``: the ring records and the
+    program carries probe ops, but reconcile mutates NO metric — the
+    registry stays byte-empty like every other telemetry source."""
+    probe_env(BLUEFOG_TPU_TELEMETRY=0)
+    opt, _ = _run_fused(steps=2)
+    assert any(prog.probes
+               for prog in opt._fused_impl._programs.values())
+    assert telemetry.snapshot() == {}, \
+        "TELEMETRY=0 must keep the registry empty"
+
+
+# ---------------------------------------------------------------------------
+# Phase attribution (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+@needs_fused
+@needs_probe
+def test_fused_profile_reports_real_phases(probe_env):
+    """With probes on (the default), a fused step inside
+    ``bf.step_profile()`` reports non-zero gossip-communicate AND
+    optimizer-update — the program is no longer booked wholesale to
+    grad-compute, and the degraded ``fused-step`` label never appears."""
+    probe_env(BLUEFOG_TPU_PROBE=1)
+    _opt, phases = _run_fused(steps=3, profile=True)
+    for ph in phases[1:]:  # step 0 is compile-dominated
+        assert ph.get("gossip-communicate", 0.0) > 0.0, ph
+        assert ph.get("optimizer-update", 0.0) > 0.0, ph
+        assert profiler.FUSED_PHASE not in ph, ph
+    assert not profiler.attribution_degraded()
+    s = probes.last_summary()
+    assert s is not None and s["attributed"]
+    assert 0.0 < s["measured_overlap"] <= 1.0
+    assert len(s["bucket_issue_seconds"]) == 2
+    snap = telemetry.snapshot()
+    assert snap.get("bf_probe_events_total", 0) > 0
+    assert 0.0 < snap.get("bf_fused_overlap_ratio", 0) <= 1.0
+
+
+@needs_fused
+@needs_probe
+def test_fused_vs_eager_attribution_agreement(probe_env):
+    """The fused leg's probe-derived communication share loosely agrees
+    with the eager leg's span-hook share: same non-zero phase set, and
+    the gossip-communicate fractions within a wide factor of each other
+    (CPU loopback noise — this guards against gross misattribution like
+    booking the drain into optimizer-update, not against jitter)."""
+    probe_env(BLUEFOG_TPU_PROBE=1)
+    _, fused_ph = _run_fused(steps=4, profile=True)
+    from bluefog_tpu import basics
+    basics._reset_for_tests()
+    bf.init(lambda: topo.RingGraph(8))
+    params = _params()
+    opt = WO.DistributedWinPutOptimizer(optax.sgd(0.5), fusion_buckets=2)
+    st = opt.init(params)
+    eager_ph = []
+    try:
+        p = params
+        for g in _grad_stream(params, 4):
+            with bf.step_profile(straggler=False) as prof:
+                p, st = opt.step(p, g, st, require_mutex=False)
+            eager_ph.append(prof.phases())
+    finally:
+        opt.free()
+
+    def comm_frac(rows):
+        rows = rows[1:]  # drop the compile-dominated first step
+        f = [r.get("gossip-communicate", 0.0) / max(sum(r.values()), 1e-12)
+             for r in rows]
+        return sum(f) / len(f)
+
+    cf, ce = comm_frac(fused_ph), comm_frac(eager_ph)
+    assert cf > 0.0 and ce > 0.0, (cf, ce)
+    ratio = max(cf, ce) / min(cf, ce)
+    assert ratio < 10.0, \
+        f"fused comm share {cf:.3f} vs eager {ce:.3f} (x{ratio:.1f})"
+
+
+# ---------------------------------------------------------------------------
+# Trace surface
+# ---------------------------------------------------------------------------
+
+def test_two_rank_trace_merge_probe_lanes(probe_env, tmp_path,
+                                          monkeypatch):
+    """Probe lanes from two ranks merge into per-rank process lanes:
+    synthesize each rank's timeline with ``probe_span``/``thread_name``
+    (exactly what ``probes._emit_lanes`` emits) and assert trace-merge
+    keeps the ``fused-probe`` category, the synthetic tids and the lane
+    names under pid 0 and pid 1."""
+    monkeypatch.setenv("BLUEFOG_TPU_PYTHON_TIMELINE", "1")
+    config.reload()
+    prefix = str(tmp_path / "tl_")
+    for rank in (0, 1):
+        assert timeline.start_timeline(f"{prefix}{rank}.json")
+        base_us = time.monotonic_ns() // 1000
+        timeline.probe_span("fused-step", base_us, 900, 999)
+        timeline.thread_name(999, "fused fused-step")
+        timeline.probe_span("drain", base_us + 900, 120, 998)
+        for bi in range(2):
+            timeline.probe_span(f"bucket{bi} put-issue",
+                                base_us + 100 * (bi + 1), 80, 1000 + bi)
+        timeline.stop_timeline()
+    from bluefog_tpu import tools
+    out = tools.trace_merge(prefix)
+    events, _repaired = tools.load_trace_events(out)
+    for rank in (0, 1):
+        lanes = [e for e in events
+                 if e.get("pid") == rank and e.get("cat") == "fused-probe"]
+        assert {e["tid"] for e in lanes} == {998, 999, 1000, 1001}, \
+            (rank, lanes)
+        assert all(e.get("ph") == "X" and e.get("dur", 0) >= 0
+                   for e in lanes)
+        names = [e for e in events
+                 if e.get("pid") == rank and e.get("ph") == "M"
+                 and e.get("name") == "thread_name"
+                 and e.get("args", {}).get("name") == "fused fused-step"]
+        assert names, "the synthetic lane name must survive the merge"
+    # The merged doc is valid chrome-tracing JSON end to end.
+    with open(out) as f:
+        json.load(f)
