@@ -9,7 +9,7 @@ PYTEST = python -m pytest -q
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
         stripe-smoke tracerec-smoke async-smoke ffi-smoke fused-smoke \
         probe-smoke placement-smoke synth-smoke hier-smoke chaos-smoke \
-        chaos links-smoke metrics-lint
+        chaos links-smoke tune-smoke metrics-lint
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -21,7 +21,7 @@ PYTEST = python -m pytest -q
 test: native test-fast bench-comm-smoke prof-smoke transport-smoke \
       stripe-smoke tracerec-smoke async-smoke ffi-smoke fused-smoke \
       probe-smoke placement-smoke synth-smoke hier-smoke chaos-smoke \
-      links-smoke metrics-lint
+      links-smoke tune-smoke metrics-lint
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -222,6 +222,19 @@ chaos-smoke:
 links-smoke:
 	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --links-smoke
 	env BLUEFOG_TPU_LINK_OBS=0 python bench_comm.py --transport-smoke
+
+# Self-tuning control-plane smoke: the same 4-proc gang started on a
+# full mesh (the wrong topology for the coming fault), run twice.  With
+# BLUEFOG_TPU_TUNE=1 the tuner must measure the hot edges, commit
+# EXACTLY ONE numbered adaptation epoch agreed by every rank (re-route
+# + knob moves), recover >= 2x of the delayed rank's lost gossip
+# throughput without a restart, and surface the epoch in the /healthz
+# "tuner" block and the `tools top` tune column.  With
+# BLUEFOG_TPU_TUNE=0 pinned, the identical fault must leave the send
+# schedule bitwise unchanged and register zero bf_tune_* series — the
+# default-off contract (both legs run inside the one driver).
+tune-smoke:
+	env JAX_PLATFORMS=cpu python -m bluefog_tpu.tools chaos --tune-smoke
 
 # Metrics/doc drift gate: AST-scan every bf_* series the package
 # registers against the docs/observability.md inventory, BOTH ways —

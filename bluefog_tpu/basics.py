@@ -494,7 +494,15 @@ def _placement_model(devices):
         if len(_placement_model_cache) > 8:
             _placement_model_cache.clear()
         _placement_model_cache[key] = PL.build_model(devices)
-    return _placement_model_cache[key]
+    base = _placement_model_cache[key]
+    if base is None or not cfg.tune:
+        return base
+    # Self-tuning control plane: swap in the measured re-pricing of this
+    # geometry when the tuner has derived one (the cache above keeps the
+    # static model; measured models are keyed by their own sketch-bearing
+    # name through every downstream search/synthesis cache).
+    from bluefog_tpu.utils import tuner
+    return tuner.maybe_measured(base)
 
 
 def _placement_search(model, scheds, n, *, iters, block, budget,
@@ -1448,12 +1456,18 @@ def _hier_topology(ctx, cfg=None):
         cfg = config.get()
     n = len(ctx.devices)
     n_slices = n // ctx.local_size if ctx.local_size else 1
+    # The outer cadence consults the tuner override table (empty with
+    # BLUEFOG_TPU_TUNE=0 — the configured value passes through bitwise);
+    # the adapted value rides the cache key, so a tuner epoch rebuilds.
+    from bluefog_tpu.utils import tuner
+    outer_every = tuner.override_int("hier_outer_every",
+                                     cfg.hier_outer_every)
     key = (n, n_slices, cfg.hier_inner, cfg.hier_outer,
-           cfg.hier_outer_every, cfg.hier_outer_self_weight)
+           outer_every, cfg.hier_outer_self_weight)
     if ctx._hier_key != key:
         ctx.hier_topology = topology_util.hierarchical_two_level(
             n, n_slices, inner=cfg.hier_inner, outer=cfg.hier_outer,
-            outer_every=cfg.hier_outer_every,
+            outer_every=outer_every,
             outer_self_weight=cfg.hier_outer_self_weight)
         ctx._hier_key = key
     return ctx.hier_topology

@@ -44,6 +44,7 @@ underneath moves — so results are bit-identical with placement on or off
 
 from __future__ import annotations
 
+import hashlib
 import math
 import threading
 import weakref
@@ -55,6 +56,7 @@ import numpy as np
 
 __all__ = [
     "TorusModel",
+    "MeasuredModel",
     "CostReport",
     "PlacementResult",
     "parse_torus_spec",
@@ -241,6 +243,81 @@ class TorusModel:
                 if len(r):
                     tab[a, b, :len(r)] = r
         return tab
+
+
+@dataclass(frozen=True)
+class MeasuredModel(TorusModel):
+    """A :class:`TorusModel` whose prices come from *measurement* instead of
+    the static ``dcn_link_cost`` constant (the self-tuning control plane,
+    ``utils/tuner.py``).
+
+    Two measured layers ride on the inherited geometry:
+
+    ``dcn_link_cost``  — replaced by the measured DCN/ICI relative cost, so
+                         every inherited consumer (``link_weights``,
+                         ``distance``, the route/evaluator stack,
+                         ``optimize_placement``, ``synthesize_schedule``)
+                         re-prices automatically through inheritance.
+    ``edge_cost``      — sorted ``(src_rank, dst_rank, relative_cost)``
+                         tuples per directed *transport* edge.  Rank ids,
+                         pre-permutation: the link observatory measures
+                         between ranks, not chips, and
+                         :func:`predicted_edge_cost` consults this map
+                         before falling back to routed distance — closing
+                         the divergence loop (once the measured model is
+                         active, ``bf_link_divergence_ratio`` prices
+                         measurement against measurement and settles).
+
+    ``sketch`` is a content hash of the canonical measured inputs and the
+    model's ``name`` is ``measured:<sketch>`` — the placement-search and
+    synthesis caches key on ``name``, so re-priced artifacts are cached
+    (and attributed in provenance) per measured matrix, never blended with
+    the static model's entries.  Built only via :meth:`from_measurements`,
+    which sorts and quantizes, so two SPMD ranks fed the same merged
+    matrix construct byte-identical models (:meth:`canonical_bytes`)."""
+    edge_cost: Tuple[Tuple[int, int, float], ...] = ()
+    sketch: str = ""
+
+    @cached_property
+    def edge_cost_map(self) -> Dict[Tuple[int, int], float]:
+        return {(int(s), int(d)): float(c) for s, d, c in self.edge_cost}
+
+    @staticmethod
+    def from_measurements(base: TorusModel,
+                          edge_cost: Sequence[Tuple[int, int, float]],
+                          dcn_link_cost: Optional[float] = None
+                          ) -> "MeasuredModel":
+        """Derive a measured model from ``base``'s geometry plus measured
+        relative edge costs.  Costs are quantized to 6 decimals and edges
+        sorted — the canonical form the sketch hashes, making the result
+        independent of measurement arrival order."""
+        edges = tuple(sorted((int(s), int(d), round(float(c), 6))
+                             for s, d, c in edge_cost))
+        dcn = float(base.dcn_link_cost if dcn_link_cost is None
+                    else round(float(dcn_link_cost), 6))
+        # Geometry + measured prices only — deliberately NOT base.name, so
+        # re-measuring from an already-measured model with the same matrix
+        # reproduces the same sketch (idempotent re-price).
+        canon = "|".join(
+            [repr(base.dims), repr(base.device_node),
+             str(base.n_slices), dcn.hex(), repr(base.wrap)]
+            + [f"{s}>{d}={c.hex()}" for s, d, c in edges])
+        sketch = hashlib.sha256(canon.encode()).hexdigest()[:12]
+        return MeasuredModel(
+            name=f"measured:{sketch}", dims=base.dims,
+            device_node=base.device_node, n_slices=base.n_slices,
+            dcn_link_cost=dcn, wrap=base.wrap,
+            edge_cost=edges, sketch=sketch)
+
+    def canonical_bytes(self) -> bytes:
+        """Byte-exact serialization (floats as ``float.hex()``, edges in
+        sorted order by construction) — what cross-rank determinism tests
+        compare to prove two ranks derived the identical model."""
+        parts = [self.name, repr(self.dims), repr(self.device_node),
+                 str(self.n_slices), float(self.dcn_link_cost).hex(),
+                 repr(self.wrap)]
+        parts += [f"{s}>{d}={float(c).hex()}" for s, d, c in self.edge_cost]
+        return "|".join(parts).encode()
 
 
 def parse_torus_spec(spec: str) -> Tuple[int, ...]:
@@ -725,6 +802,13 @@ def predicted_edge_cost(src: int, dst: int) -> float:
     if act is None:
         return 1.0
     model, perm = act
+    if isinstance(model, MeasuredModel):
+        # Measured per-rank edge prices take precedence over routed
+        # distance (rank ids, pre-permutation — the observatory measures
+        # transport edges, not chips).  Unmeasured edges fall through.
+        c = model.edge_cost_map.get((int(src), int(dst)))
+        if c is not None:
+            return max(float(c), 1.0)
     n = len(model.device_node)
     s, d = int(src), int(dst)
     if not (0 <= s < n and 0 <= d < n):

@@ -274,13 +274,26 @@ def stripe_for(name: str, src: int, op: int, n_stripes: int) -> int:
 
 def resolve_stripes() -> int:
     """The effective stripe count: an explicit ``BLUEFOG_TPU_WIN_STRIPES``
-    wins; ``auto`` derives it from the placement model's ``dcn_link_cost``
-    (a DCN crossing modeled k× an ICI hop gets ~k parallel streams,
-    capped at 8 — the HiCCL sizing argument), and flat hosts / no model
-    stay at 1, the bitwise single-stream wire behavior."""
+    wins; otherwise the static oracle (:func:`resolve_stripes_static`),
+    overridden by the self-tuning control plane's measured-goodput
+    derivation when ``BLUEFOG_TPU_TUNE`` has adapted it — the static
+    constant prices a DCN crossing the model *assumed*, the tuner prices
+    the streams the link *measured* (a measured-idle DCN collapses back to
+    one).  With TUNE off the override table is empty and the static value
+    passes through bitwise."""
     cfg = config.get()
     if cfg.win_stripes >= 1:
         return cfg.win_stripes
+    static = resolve_stripes_static()
+    from bluefog_tpu.utils import tuner
+    return max(1, min(8, tuner.override_int("stripes", static)))
+
+
+def resolve_stripes_static() -> int:
+    """The static ``auto`` oracle: the placement model's ``dcn_link_cost``
+    (a DCN crossing modeled k× an ICI hop gets ~k parallel streams,
+    capped at 8 — the HiCCL sizing argument), and flat hosts / no model
+    stay at 1, the bitwise single-stream wire behavior."""
     try:
         from bluefog_tpu import basics
         model = basics._ctx._placement_state[0]
@@ -919,6 +932,22 @@ class WindowTransport:
         if self._tx is not None:
             csv = ",".join(f"{h}:{p}" for h, p in sorted(self._partitioned))
             self._lib.bf_wintx_set_partition(self._tx, csv.encode())
+
+    def set_linger_ms(self, ms: float) -> None:
+        """Runtime adaptation of the coalesce linger (the tuner's
+        ``coalesce_linger_ms`` knob).  The Python sender workers read
+        ``self._linger`` per flush wait, so the change is live on the
+        Python hot path; the native tx loop bakes its linger at
+        ``bf_wintx_start`` — a running native transport keeps its value
+        (best-effort via ``bf_wintx_set_linger`` when the core grows one)
+        and the new value applies from the next transport construction."""
+        self._linger = max(0.0, float(ms)) / 1e3
+        if self._tx:
+            try:
+                self._lib.bf_wintx_set_linger(
+                    self._tx, int(self._linger * 1e6))
+            except AttributeError:
+                pass
 
     def set_send_delay(self, seconds: float) -> None:
         """Chaos link-delay fault: sleep ``seconds`` before every DATA

@@ -921,9 +921,14 @@ def _send_to_proc(proc: int, op: int, name: str, src: int, dst: int,
         # row would zero every unsent coordinate at the receiver and the
         # residual would re-ship stale sums as a "current value", so puts
         # (like GET replies and control ops) keep exact payloads.
+        # The fraction consults the tuner's override table: empty (the
+        # BLUEFOG_TPU_TUNE=0 default) passes the configured value through
+        # bitwise; an armed tuner may halve it on a measured-hot edge.
+        from bluefog_tpu.utils import tuner
         payload = _sparse_payload(
             name, src, dst, payload,
-            config.parse_sparse_frac(comp))
+            tuner.override_float("sparse_frac",
+                                 config.parse_sparse_frac(comp)))
         op |= OP_SPARSE_FLAG
     elif (payload.size and payload.dtype == np.float32
           and comp == "bf16"):

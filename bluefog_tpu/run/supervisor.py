@@ -205,6 +205,12 @@ class ChurnSupervisor:
         # set_async_step — harmless, breaches are latched).
         from bluefog_tpu.utils import linkobs
         linkobs.on_step(step)
+        # Self-tuning control plane (utils/tuner.py): divergence check +
+        # adaptation at this step boundary — same caller's-thread contract
+        # as recovery, since an epoch may swap topology and windows.  A
+        # no-op unless BLUEFOG_TPU_TUNE is armed.
+        from bluefog_tpu.utils import tuner
+        tuner.tick(step)
         view = self.ctrl.poll_change()
         if view is None:
             return None

@@ -498,6 +498,11 @@ def bench_trend(directory: str = ".",
             doc = None
         rows.append((rnd, name, doc))
     if not rows:
+        # A rounds directory can carry only multichip-probe records
+        # (CPU-only rigs never write BENCH_r*.json) — still tabulate.
+        multichip = _multichip_trend(directory)
+        if multichip:
+            return "\n".join(multichip)
         return (f"bench-trend: no files match "
                 f"{os.path.join(directory, pattern)}")
     lines = [f"{'round':>5}  {'rc':>3}  {'metric':<44} {'value':>12}  "
@@ -532,7 +537,48 @@ def bench_trend(directory: str = ".",
         lines.append(f"{rnd:>5}  {rc if rc is not None else '?':>3}  "
                      f"{metric:<44} {val_txt:>12}  {unit:<8} "
                      f"{prev_txt:>8}  {base_txt:>8}")
+    multichip = _multichip_trend(directory)
+    if multichip:
+        lines.append("")
+        lines.extend(multichip)
     return "\n".join(lines)
+
+
+def _multichip_trend(directory: str,
+                     pattern: str = "MULTICHIP_r*.json") -> List[str]:
+    """The multichip-probe trajectory next to the bench one.  These
+    records carry a different shape (``{"n_devices", "rc", "ok",
+    "skipped", "tail"}`` — no ``parsed`` metric: the probe reports
+    whether a >1-chip gang came up, not a number), so they get their own
+    pass/skip table rather than rows forced into the bench columns."""
+    import os
+    rows = []
+    for path in sorted(glob.glob(os.path.join(directory, pattern))):
+        name = os.path.basename(path)
+        m = re.search(r"r(\d+)", name)
+        rnd = int(m.group(1)) if m else -1
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = None
+        rows.append((rnd, name, doc))
+    if not rows:
+        return []
+    lines = [f"{'round':>5}  {'rc':>3}  {'devices':>8}  {'result':<10}"]
+    lines.append("-" * len(lines[0]))
+    for rnd, name, doc in sorted(rows):
+        if doc is None:
+            lines.append(f"{rnd:>5}  {'?':>3}  {'?':>8}  "
+                         f"<unreadable: {name}>")
+            continue
+        rc = doc.get("rc")
+        result = ("skip" if doc.get("skipped")
+                  else "ok" if doc.get("ok") else "FAIL")
+        nd = doc.get("n_devices")
+        lines.append(f"{rnd:>5}  {rc if rc is not None else '?':>3}  "
+                     f"{nd if nd is not None else '?':>8}  {result:<10}")
+    return lines
 
 
 def main(argv=None) -> int:

@@ -37,6 +37,18 @@ co-armed quiet rule stays silent, every rank computes the identical
 merged link matrix, and ``tools top`` renders one complete frame against
 the live gang's real ``/metrics`` endpoints.  ``make links-smoke``.
 
+Self-tuning control-plane scenario (``--tune-smoke`` / ``--tune``): the
+same async gang started on a DELIBERATELY wrong topology for the coming
+fault — a full mesh, so a ``linkdelay:`` fault (which sleeps the sender
+once per outbound DATA message) taxes the delayed rank once per peer per
+step.  Run TWICE: with ``BLUEFOG_TPU_TUNE=1`` the tuner must measure the
+hot edges, commit EXACTLY ONE numbered adaptation epoch that re-routes
+onto a cheap topology and recover >= 2x of the lost gossip throughput
+without a restart (``/healthz`` "tuner" block, ``tools top`` tune
+column); with ``BLUEFOG_TPU_TUNE=0`` pinned, the same fault must leave
+the schedule bitwise unchanged and register ZERO ``bf_tune_*`` series —
+the default-off contract.  ``make tune-smoke``.
+
 Launches a CPU multi-process gang under ``bfrun --chaos`` running a small
 decentralized-optimization workload over the one-sided window path (each
 rank descends toward its own target and neighbor-averages through
@@ -1317,6 +1329,381 @@ def run_links_demo(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Self-tuning control-plane scenario (linkdelay fault -> re-route epoch)
+# ---------------------------------------------------------------------------
+
+def tune_worker_main(args) -> int:
+    """One rank of the self-tuning control-plane gang: the async
+    push-sum workload started on a FULL MESH — the deliberately wrong
+    topology for the coming ``linkdelay`` fault, which sleeps the sender
+    once per outbound DATA message, so the delayed rank pays
+    ``(n-1) * ms`` per step until something re-routes it.  The tuner is
+    that something: at every exact-collect boundary the gang exchanges
+    ``bf_link_*`` snapshots over the coordinator KV and feeds the
+    IDENTICAL merged matrix, then ticks the tuner inside the quiesced
+    barrier window (no data in flight, so a topology swap's window
+    free/recreate never races a peer's ``win_accumulate``) — every rank
+    derives the same adaptation at the same step.  Per-step wall times
+    are segmented into pre-fault / fault-before-epoch / fault-after-
+    epoch so the driver can price the recovery."""
+    os.environ.setdefault("BLUEFOG_TPU_TELEMETRY", "1")
+    _init_rendezvous()
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import topology as topology_util
+    from bluefog_tpu.ops import window as W
+    from bluefog_tpu.run.supervisor import ChurnSupervisor
+    from bluefog_tpu.utils import config, telemetry, tuner
+    config.reload()
+    bf.init()
+    W.init_transport()
+    me = bf.rank()
+    nproc = jax.process_count()
+    my_proc = jax.process_index()
+    tuned = bool(config.get().tune)
+    bf.set_topology(topology_util.FullyConnectedGraph(bf.size()),
+                    is_weighted=True)
+    W.turn_on_win_ops_with_associated_p()
+    target = float(me)
+    x = np.zeros(args.dim, np.float32) + target
+    name = "tune_x"
+    W.win_create(np.zeros((1, args.dim), np.float32), name, zero_init=True)
+    win = W._store.get(name)
+    with win.lock:
+        win.main[me][:] = x
+    sup = ChurnSupervisor()
+    every = config.get().async_collect_every
+
+    from jax._src import distributed as _dist
+    client = _dist.global_state.client
+    port = telemetry.start_http_server(0)
+    client.key_value_set(f"bf/tune_port/{my_proc}", str(port))
+
+    def send_plan():
+        # Re-read EVERY step: a tuner epoch can have re-entered
+        # set_topology since the last one.
+        return topology_util.GetSendWeights(bf.load_topology(), me)
+
+    def sched_sig():
+        self_w, dst_w = send_plan()
+        return {"outs": sorted(int(d) for d in dst_w),
+                "self_weight": round(float(self_w), 9),
+                "dst_weights": {str(int(d)): round(float(w), 9)
+                                for d, w in sorted(dst_w.items())}}
+
+    def settle(tag, step):
+        W.win_flush()
+        _kv_barrier(tag, my_proc, nproc)
+        time.sleep(0.05)
+        _kv_barrier(tag + "b", my_proc, nproc)
+        W.win_fold_stale_residuals(name)
+        if step >= args.fault_step:
+            # Control-plane exchange at the quiesced boundary.  Both
+            # tuner calls are no-ops when BLUEFOG_TPU_TUNE=0.
+            snap = telemetry.snapshot()
+            rows = {k: v for k, v in snap.items()
+                    if k.startswith("bf_link_")}
+            client.key_value_set(f"bf/tune_snap/{step}/{my_proc}",
+                                 json.dumps(rows))
+            snaps = [rows if pp == my_proc else json.loads(
+                client.blocking_key_value_get(
+                    f"bf/tune_snap/{step}/{pp}", 120_000))
+                for pp in range(nproc)]
+            tuner.feed_snapshots(snaps)
+            tuner.tick(step)
+            _kv_barrier(tag + "t", my_proc, nproc)
+
+    def healthz():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:  # 503 when degraded
+            return json.loads(e.read().decode())
+
+    sig0 = sched_sig()
+    capture_step = args.steps - 5
+    hz_mid = None
+    top_ok = top_has_epoch = None
+    top_lines = 0
+    pre_dt = []
+    fault_dt = []  # (seconds, tuner epoch at step START)
+    view = None
+    steps_run = 0
+    for step in range(args.steps):
+        t0 = time.perf_counter()
+        epoch_at = int((tuner.health_summary() or {}).get("epoch", 0))
+        change = sup.step(step)
+        if change is not None:
+            view = change
+            if change.evicted:
+                break
+        W.set_async_step(step)
+        telemetry.set_gauge("bf_async_step_lag",
+                            float(W.async_step_lag()), rank=str(me))
+        p = max(W.win_associated_p(name, me), 1e-3)
+        z = x / p
+        x = x - args.lr * (z - target) * p
+        self_w, dst_w = send_plan()
+        W.win_accumulate(x[None], name, self_weight=self_w,
+                         dst_weights=dst_w)
+        if every and (step + 1) % every == 0:
+            settle(f"c{step}", step)
+        x = np.asarray(W.win_update_then_collect(name))[0]
+        steps_run += 1
+        dt = time.perf_counter() - t0
+        if step < args.fault_step:
+            pre_dt.append(dt)
+        else:
+            # The adaptation step itself is attributed to the PRE-epoch
+            # segment (epoch read at step start): its wall time is mixed.
+            fault_dt.append((dt, epoch_at))
+        if step == capture_step:
+            hz = healthz()
+            hz_mid = {"status": hz.get("status"),
+                      "tuner": hz.get("tuner")}
+            if my_proc == 0:
+                # The dashboard leg: one COMPLETE frame against every
+                # rank's live endpoint, post-adaptation.
+                from bluefog_tpu.tools import top as topmod
+                eps = []
+                for pp in range(nproc):
+                    pv = client.blocking_key_value_get(
+                        f"bf/tune_port/{pp}", 60_000)
+                    eps.append(f"127.0.0.1:{pv}")
+                polls = {ep: topmod.scrape(ep, timeout=10.0)
+                         for ep in eps}
+                frame = topmod.render_frame(polls)
+                up = sum(1 for mh in polls.values()
+                         if mh[0] is not None)
+                top_ok = bool(up == nproc and "tune" in frame
+                              and "DOWN" not in frame)
+                top_has_epoch = "1:topology" in frame
+                top_lines = len(frame.splitlines())
+        if args.pace_ms:
+            time.sleep(args.pace_ms / 1e3)
+
+    evicted = bool(view is not None and view.evicted)
+    info = sup.info()
+    if not evicted:
+        W.win_flush()
+        _kv_barrier("final", my_proc, nproc)
+    th = tuner.health_summary() or {}
+    snap = telemetry.snapshot()
+    fault_all = [d for d, _ in fault_dt]
+    fault_early = [d for d, ep in fault_dt if ep == 0]
+    fault_late = [d for d, ep in fault_dt if ep >= 1]
+    print(_RESULT_TAG + json.dumps({
+        "rank": me,
+        "proc": my_proc,
+        "mode": "tune",
+        "tuned": tuned,
+        "steps": steps_run,
+        "evicted": evicted,
+        "changes_total": info["changes_total"],
+        "pre_ms": _robust_window_ms(pre_dt),
+        "fault_ms": _robust_window_ms(fault_all),
+        "fault_early_ms": _median_ms(fault_early),
+        "fault_late_ms": _robust_window_ms(fault_late),
+        "n_fault_late": len(fault_late),
+        "epoch": int(th.get("epoch", 0)),
+        "reverts": int(th.get("reverts", 0)),
+        "last_knob": th.get("last_knob"),
+        "topology_tag": th.get("topology"),
+        "knobs": th.get("knobs"),
+        "hz_mid": hz_mid,
+        "tune_series": sorted(k for k in snap
+                              if k.startswith("bf_tune_")),
+        "sig_start": sig0,
+        "sig_end": sched_sig(),
+        "top_ok": top_ok,
+        "top_has_epoch": top_has_epoch,
+        "top_frame_lines": top_lines,
+    }), flush=True)
+    active_procs = set() if evicted else set(range(nproc))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    _done_barrier(active_procs, my_proc, args.grace)
+    os._exit(0)
+
+
+def run_tune_demo(args) -> int:
+    """Driver for ``make tune-smoke``: the same 4-proc gang and
+    ``linkdelay`` fault run TWICE —
+
+      * ``BLUEFOG_TPU_TUNE=1``: the tuner must commit EXACTLY ONE
+        numbered adaptation epoch (every rank agrees on it and on the
+        chosen topology), cut the delayed rank's out-degree, recover
+        >= ``--tune-ratio`` (default 2x) of the lost gossip throughput
+        without any restart, surface the epoch in the ``/healthz``
+        "tuner" block and the ``tools top`` tune column, and never
+        revert;
+      * ``BLUEFOG_TPU_TUNE=0`` pinned: the identical fault must change
+        NOTHING — zero ``bf_tune_*`` series registered, no "tuner"
+        health block, send schedule bitwise identical start-to-end,
+        full-mesh out-degree preserved.
+
+    The recovery lever is structural, not statistical: the fault sleeps
+    the sender per outbound DATA message, so full mesh costs the delayed
+    rank ``(n-1) * ms`` per step and the re-routed ring costs ``ms`` —
+    the throughput ratio is the out-degree ratio."""
+    n = args.np
+    delay_rank = (n - 1) if args.delay_rank is None else args.delay_rank
+    if delay_rank == 0:
+        raise SystemExit("chaos: rank 0 hosts the rendezvous coordinator; "
+                         "delay any other rank")
+    spec = (f"linkdelay:rank={delay_rank}:step={args.fault_step}"
+            f":steps={args.fault_steps}:ms={args.delay_ms}")
+    cmd = [sys.executable, "-m", "bluefog_tpu.run", "-np", str(n),
+           "--devices-per-proc", "1", "--chaos", spec, "--",
+           sys.executable, "-m", "bluefog_tpu.tools", "chaos",
+           "--worker", "--mode", "tune",
+           "--steps", str(args.steps), "--dim", str(args.dim),
+           "--lr", str(args.lr), "--pace-ms", str(args.pace_ms),
+           "--grace", str(args.grace),
+           "--fault-step", str(args.fault_step),
+           "--fault-steps", str(args.fault_steps)]
+    base_env = dict(os.environ)
+    base_env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BLUEFOG_TPU_CHURN": "1",
+        "BLUEFOG_TPU_CHURN_HEARTBEAT_MS": "80",
+        "BLUEFOG_TPU_CHURN_SUSPECT_MS": "1500",
+        "BLUEFOG_TPU_TELEMETRY": "1",
+        "BLUEFOG_TPU_TRACE_SAMPLE": "1",
+        "BLUEFOG_TPU_ASYNC": "1",
+        "BLUEFOG_TPU_ASYNC_STALENESS_STEPS": "64",
+        "BLUEFOG_TPU_ASYNC_COLLECT_EVERY": str(args.collect_every),
+        # Loopback delay EWMAs are scheduling noise (tens to hundreds
+        # of microseconds, easily 3x apart edge to edge); the injected
+        # fault is 100-1000x the floor.  A raised trigger is immune to
+        # the noise, still fires on the first post-fault feed, and
+        # keeps the "exactly one epoch per change" assertion honest.
+        "BLUEFOG_TPU_TUNE_DIVERGENCE": "10",
+        "BLUEFOG_TPU_TUNE_DWELL_STEPS": str(max(2, args.collect_every)),
+    })
+    legs = {}
+    walls = {}
+    for leg, flag in (("tuned", "1"), ("pinned", "0")):
+        env = dict(base_env)
+        env["BLUEFOG_TPU_TUNE"] = flag
+        print(f"chaos tune [{leg}]: launching {n}-process gang "
+              f"(BLUEFOG_TPU_TUNE={flag}), {spec} "
+              f"({args.steps} steps)...", flush=True)
+        t0 = time.perf_counter()
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=args.timeout)
+        walls[leg] = time.perf_counter() - t0
+        legs[leg] = (proc, _parse_results(proc.stdout))
+    failures = []
+    for leg, (proc, results) in legs.items():
+        if proc.returncode != 0:
+            _fail(failures, f"[{leg}] bfrun exited {proc.returncode}")
+        if sorted(results) != list(range(n)):
+            _fail(failures, f"[{leg}] expected reports from all {n} "
+                            f"ranks, got {sorted(results)}")
+        for rank, r in sorted(results.items()):
+            print(f"  {leg} rank {rank}: pre {r.get('pre_ms', 0):.1f}ms, "
+                  f"fault-early {r.get('fault_early_ms', 0):.1f}ms, "
+                  f"fault-late {r.get('fault_late_ms', 0):.1f}ms, "
+                  f"epoch {r.get('epoch')} ({r.get('last_knob')}), "
+                  f"reverts {r.get('reverts')}, "
+                  f"out-degree {len((r.get('sig_end') or {}).get('outs', []))}",
+                  flush=True)
+            if r.get("evicted") or r.get("changes_total"):
+                _fail(failures, f"[{leg}] rank {rank}: membership "
+                                "churned (a merely slow link was treated "
+                                "as a dead peer)")
+    tuned_res = legs["tuned"][1]
+    pinned_res = legs["pinned"][1]
+    # -- tuned leg: one epoch, cluster agreement, measured recovery -------
+    tags = set()
+    for rank, r in sorted(tuned_res.items()):
+        if r.get("epoch") != 1:
+            _fail(failures, f"[tuned] rank {rank}: {r.get('epoch')} "
+                            "adaptation epochs != exactly 1 for one "
+                            "persistent fault")
+        if r.get("reverts"):
+            _fail(failures, f"[tuned] rank {rank}: adaptation reverted "
+                            "(probation judged the re-route a regression)")
+        tags.add(r.get("topology_tag"))
+        if "bf_tune_epoch" not in (r.get("tune_series") or []):
+            _fail(failures, f"[tuned] rank {rank}: no bf_tune_* series "
+                            f"registered ({r.get('tune_series')})")
+        tb = (r.get("hz_mid") or {}).get("tuner") or {}
+        if int(tb.get("epoch", -1)) != 1:
+            _fail(failures, f"[tuned] rank {rank}: /healthz tuner block "
+                            f"missing or wrong epoch ({tb})")
+    if len(tags) != 1 or None in tags:
+        _fail(failures, f"[tuned] ranks disagree on the re-routed "
+                        f"topology: {tags} — the measured model is not "
+                        "cluster-consistent")
+    dr_t = tuned_res.get(delay_rank) or {}
+    dr_p = pinned_res.get(delay_rank) or {}
+    if dr_t and len((dr_t.get("sig_end") or {}).get("outs", [])) >= n - 1:
+        _fail(failures, "[tuned] delayed rank's out-degree was not "
+                        "reduced — the adaptation never re-routed it")
+    if dr_t and dr_t.get("n_fault_late", 0) < 6:
+        _fail(failures, "[tuned] too few post-adaptation steps "
+                        f"({dr_t.get('n_fault_late')}) to judge recovery")
+    un = float(dr_p.get("fault_ms") or 0.0)
+    tu = float(dr_t.get("fault_late_ms") or 0.0)
+    ratio = (un / tu) if tu > 0.0 else 0.0
+    if ratio < args.tune_ratio:
+        _fail(failures, f"delayed rank recovered only {ratio:.2f}x "
+                        f"(untuned fault median {un:.1f}ms vs tuned "
+                        f"post-adaptation {tu:.1f}ms; want >= "
+                        f"{args.tune_ratio}x)")
+    r0 = tuned_res.get(0) or {}
+    if r0 and (r0.get("top_ok") is not True
+               or r0.get("top_has_epoch") is not True):
+        _fail(failures, "[tuned] tools top did not render the tune "
+                        f"column's epoch (top_ok={r0.get('top_ok')}, "
+                        f"has_epoch={r0.get('top_has_epoch')}, "
+                        f"{r0.get('top_frame_lines', 0)} lines)")
+    # -- pinned leg: BLUEFOG_TPU_TUNE=0 is bitwise inert ------------------
+    for rank, r in sorted(pinned_res.items()):
+        if r.get("epoch") or r.get("reverts"):
+            _fail(failures, f"[pinned] rank {rank}: adapted with the "
+                            "tuner off")
+        if r.get("tune_series"):
+            _fail(failures, f"[pinned] rank {rank}: bf_tune_* series "
+                            "registered with BLUEFOG_TPU_TUNE=0: "
+                            f"{r.get('tune_series')}")
+        if (r.get("hz_mid") or {}).get("tuner") is not None:
+            _fail(failures, f"[pinned] rank {rank}: /healthz grew a "
+                            "tuner block with the tuner off")
+        if r.get("sig_start") != r.get("sig_end"):
+            _fail(failures, f"[pinned] rank {rank}: send schedule "
+                            "changed under the fault "
+                            f"({r.get('sig_start')} -> {r.get('sig_end')})")
+        if len((r.get("sig_end") or {}).get("outs", [])) != n - 1:
+            _fail(failures, f"[pinned] rank {rank}: full-mesh out-degree "
+                            "not preserved")
+    if failures:
+        print("\nchaos tune FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        for leg, (proc, _) in legs.items():
+            tail = "\n".join(proc.stderr.splitlines()[-40:])
+            print(f"\n[{leg}] gang stderr tail:\n{tail}", file=sys.stderr)
+        return 1
+    print(f"chaos tune OK: rank {delay_rank} held at +{args.delay_ms}ms "
+          f"on a full mesh — tuner committed exactly 1 epoch "
+          f"({sorted(tags)[0]}), recovered {ratio:.1f}x (>= "
+          f"{args.tune_ratio}x) of the lost throughput without restart, "
+          f"and BLUEFOG_TPU_TUNE=0 stayed bitwise inert "
+          f"(walls tuned {walls['tuned']:.1f}s / pinned "
+          f"{walls['pinned']:.1f}s)", flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
 
@@ -1492,11 +1879,12 @@ def main(argv=None) -> int:
                    help="internal: run as one gang rank (launched by the "
                         "driver through bfrun)")
     p.add_argument("--mode", default=None,
-                   choices=["sync", "async", "links"],
+                   choices=["sync", "async", "links", "tune"],
                    help="internal (with --worker): delay-scenario gossip "
                         "mode — sync steps behind a per-step barrier, "
                         "async is barrier-free push-sum, links is the "
-                        "link-observatory leg")
+                        "link-observatory leg, tune is the self-tuning "
+                        "control-plane leg")
     p.add_argument("--role", default=None, choices=["member", "joiner"],
                    help="internal (with --worker): elastic-leg role — "
                         "member = coordinator-free founding rank, joiner "
@@ -1537,6 +1925,19 @@ def main(argv=None) -> int:
     p.add_argument("--links-smoke", action="store_true",
                    help="CI smoke profile of the link-observatory "
                         "scenario")
+    p.add_argument("--tune", action="store_true",
+                   help="run the self-tuning control-plane scenario: "
+                        "linkdelay fault on a full-mesh gang, tuned "
+                        "(BLUEFOG_TPU_TUNE=1) and pinned (=0) legs — "
+                        "one adaptation epoch, >= 2x throughput "
+                        "recovery, bitwise-inert default")
+    p.add_argument("--tune-smoke", action="store_true",
+                   help="CI smoke profile of the self-tuning scenario")
+    p.add_argument("--tune-ratio", type=float, default=2.0,
+                   help="tuned leg's recovery floor: the delayed rank's "
+                        "untuned fault step-time median over its tuned "
+                        "post-adaptation median must meet this "
+                        "(default 2.0)")
     p.add_argument("--delay-rank", type=int, default=None,
                    help="rank the delay fault targets (default: the "
                         "last one)")
@@ -1599,6 +2000,8 @@ def main(argv=None) -> int:
             return elastic_worker_main(args)
         if args.role == "joiner":
             return join_worker_main(args)
+        if args.mode == "tune":
+            return tune_worker_main(args)
         if args.mode == "links":
             return links_worker_main(args)
         if args.mode is not None:
@@ -1625,6 +2028,19 @@ def main(argv=None) -> int:
             raise SystemExit("chaos --join-leg: use --kill0-leg for the "
                              "rank-0 scenario")
         return run_elastic_demo(args, kill_rank=kill_rank)
+    if args.tune or args.tune_smoke:
+        if args.tune_smoke:
+            args.dim = min(args.dim, 32)
+            args.pace_ms = min(args.pace_ms, 3.0)
+            args.fault_step = min(args.fault_step, 20)
+        # The fault runs to the END of the run, long enough past the
+        # adaptation epoch (first post-fault collect boundary + dwell)
+        # that the post-adaptation segment carries a stable median; the
+        # tight collect cadence is the control-plane exchange cadence.
+        args.fault_steps = max(args.fault_steps, 50)
+        args.collect_every = min(args.collect_every, 5)
+        args.steps = args.fault_step + args.fault_steps
+        return run_tune_demo(args)
     if args.links or args.links_smoke:
         if args.links_smoke:
             args.dim = min(args.dim, 32)

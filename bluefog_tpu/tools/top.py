@@ -124,7 +124,7 @@ def render_frame(polls: Dict[str, Tuple[Optional[Dict[str, float]],
     # -- per-rank table ----------------------------------------------------
     lines.append(f"{'endpoint':<22} {'status':<9} {'step':>7} "
                  f"{'lag':>5} {'queue':>6} {'straggler':>10} "
-                 f"{'ovlp':>7} {'slo':<20}")
+                 f"{'ovlp':>7} {'tune':<14} {'slo':<20}")
     lines.append("-" * width)
     for ep in sorted(polls):
         metrics, health = polls[ep]
@@ -152,6 +152,20 @@ def render_frame(polls: Dict[str, Tuple[Optional[Dict[str, float]],
         if odiv is not None and \
                 max(odiv, 1.0 / max(odiv, 1e-9)) > linkobs.DIVERGENCE_ALERT:
             ovlp_txt += "!"
+        # Self-tuning control plane: "<epoch>:<last knob>", "!"-flagged
+        # while a revert-on-regression probation window is open ("-" when
+        # the tuner is off: no block, no column content).
+        tb = (health or {}).get("tuner") or {}
+        if tb:
+            # Truncate BEFORE the probation flag: the "!" must survive a
+            # long knob name in the 14-char cell.
+            tune_txt = \
+                f"{tb.get('epoch', 0)}:{tb.get('last_knob') or '-'}"[:13]
+            if tb.get("probation"):
+                tune_txt += "!"
+        else:
+            te = _gauge(metrics, "bf_tune_epoch")
+            tune_txt = f"{te:g}" if te is not None else "-"
         slo = ((health or {}).get("links") or {}).get("slo", {})
         slo_txt = ("BREACH " + ",".join(slo["breached"])
                    if slo.get("breached")
@@ -163,6 +177,7 @@ def render_frame(polls: Dict[str, Tuple[Optional[Dict[str, float]],
             f"{f'{q:g}' if q is not None else '-':>6} "
             f"{f'{sc:.2f}' if sc is not None else '-':>10} "
             f"{ovlp_txt:>7} "
+            f"{tune_txt[:14]:<14} "
             f"{slo_txt[:20]:<20}")
     # -- link matrix (gauge-MAX merge: each edge lives on its receiver) ----
     merged = linkobs.merge_link_snapshots(

@@ -37,6 +37,9 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_FLIGHT_RECORDER_PATH | flightrec | dump path prefix (files are <prefix>.<rank>.bin) |
 | BLUEFOG_TPU_LINK_OBS          | 1     | 0: disable the link observatory (utils/linkobs.py) — no per-edge delay/jitter/goodput/divergence estimation, no SLO evaluation, bitwise inert |
 | BLUEFOG_TPU_SLO               | unset | declarative SLO rules, `<metric><op><value>` joined by `;` (e.g. `link_delay_us>50000;step_lag>128`); evaluated at step boundaries, breaches degrade /healthz + bump bf_slo_breaches_total + dump the flight recorder |
+| BLUEFOG_TPU_TUNE              | 0     | 1: arm the self-tuning comm control plane (utils/tuner.py) — measured link costs re-price placement/synthesis (MeasuredModel) and adapt transport knobs online; 0 pins every knob and every modeled cost bitwise |
+| BLUEFOG_TPU_TUNE_DIVERGENCE   | 3.0   | measured-vs-modeled divergence ratio that triggers a tuner adaptation epoch (same line as bf_link_divergence_ratio's x3 alert) |
+| BLUEFOG_TPU_TUNE_DWELL_STEPS  | 20    | hysteresis: minimum steps between tuner epochs, and the revert-on-regression probation window length |
 | BLUEFOG_TPU_CHURN             | 0     | 1: enable the elastic-gossip churn controller |
 | BLUEFOG_TPU_CHURN_HEARTBEAT_MS | 250  | membership heartbeat period |
 | BLUEFOG_TPU_CHURN_SUSPECT_MS  | 1500  | heartbeat silence before a peer is suspected |
@@ -365,6 +368,23 @@ class Config:
     # SLO rule spec ("<metric><op><value>;..."), validated at init by
     # linkobs.parse_slo_rules; None = no rules, the engine never runs.
     slo: Optional[str]
+    # Self-tuning comm control plane (utils/tuner.py): the link
+    # observatory's measured per-edge delay/goodput EWMAs re-price the
+    # placement/synthesis cost model (ops/placement.MeasuredModel) and
+    # drive bounded, hysteresis-guarded runtime adaptation of the
+    # transport knobs (stripes, coalesce linger, outer cadence, sparse
+    # fraction, staleness bound).  OFF by default — with tune=0 the tuner
+    # is never constructed, no override is ever installed and every knob
+    # and every modeled cost stays bitwise as configured.
+    tune: bool
+    # Divergence ratio (measured vs modeled, min-normalized — the same
+    # statistic as bf_link_divergence_ratio) at which the tuner opens an
+    # adaptation epoch.  Defaults to the observatory's x3 alert line.
+    tune_divergence: float
+    # Hysteresis: minimum steps the tuner dwells between epochs; also the
+    # probation window after each epoch before the change is committed or
+    # reverted on regression (bf_optimizer_step_seconds medians).
+    tune_dwell_steps: int
     # Elastic-gossip churn controller (ops/membership.py +
     # run/supervisor.py); OFF by default — with churn=0 no membership
     # state exists, no heartbeat is ever sent and every code path is
@@ -522,6 +542,11 @@ class Config:
                 "BLUEFOG_TPU_FLIGHT_RECORDER_PATH", "flightrec"),
             link_obs=_flag("BLUEFOG_TPU_LINK_OBS", default=True),
             slo=_validated_slo(os.environ.get("BLUEFOG_TPU_SLO")),
+            tune=_flag("BLUEFOG_TPU_TUNE"),
+            tune_divergence=float(os.environ.get(
+                "BLUEFOG_TPU_TUNE_DIVERGENCE", "3.0")),
+            tune_dwell_steps=int(os.environ.get(
+                "BLUEFOG_TPU_TUNE_DWELL_STEPS", "20")),
             churn=_flag("BLUEFOG_TPU_CHURN"),
             churn_heartbeat_ms=float(os.environ.get(
                 "BLUEFOG_TPU_CHURN_HEARTBEAT_MS", "250")),

@@ -236,6 +236,17 @@ def observe_since(t0: Optional[float], name: str,
     return dt
 
 
+def histogram_bucket_counts(name: str, **labels) -> Optional[List[float]]:
+    """Raw cumulative bucket counts of a recorded histogram (None when the
+    series has no observations).  What windowed statistics diff: snapshot
+    twice and the count deltas describe exactly the observations recorded
+    in between (the tuner's revert-on-regression medians)."""
+    key = _key(name, labels)
+    with _registry.lock:
+        h = _registry.hists.get(key)
+        return None if h is None else list(h[0])
+
+
 def histogram_percentiles(name: str, qs=(50.0, 95.0, 99.0),
                           **labels) -> Optional[Dict[float, float]]:
     """Approximate percentiles of a recorded histogram (``{q: seconds}``),
@@ -599,6 +610,17 @@ def health() -> dict:
         body["links"] = links
         if links.get("slo", {}).get("breached"):
             body["status"] = "degraded"
+    # Self-tuning control plane (utils/tuner.py): current epoch, last
+    # adapted knob, open probation window and the live knob values.
+    # Absent entirely when BLUEFOG_TPU_TUNE is off — no block, no key,
+    # nothing (the =0 bitwise contract).
+    try:
+        from bluefog_tpu.utils import tuner
+        tune = tuner.health_summary()
+    except Exception:  # noqa: BLE001 — health must render regardless
+        tune = None
+    if tune is not None:
+        body["tuner"] = tune
     probe = stall._peer_probe
     if probe is not None:
         try:
