@@ -8,8 +8,8 @@ PYTEST = python -m pytest -q
 .PHONY: test test-fast test-slow test-all test-onchip bench bench-comm \
         bench-comm-smoke native telemetry-smoke prof-smoke transport-smoke \
         stripe-smoke tracerec-smoke async-smoke ffi-smoke fused-smoke \
-        probe-smoke placement-smoke synth-smoke hier-smoke chaos-smoke \
-        chaos links-smoke tune-smoke metrics-lint
+        probe-smoke placement-smoke synth-smoke hier-smoke sharded-smoke \
+        chaos-smoke chaos links-smoke tune-smoke metrics-lint
 
 # Fast gate: ~3 min on the CPU mesh (in-process virtual-mesh tests only;
 # grew a few oracle tests in round 4); run on every change, plus the
@@ -20,8 +20,8 @@ PYTEST = python -m pytest -q
 # every native consumer has a Python fallback).
 test: native test-fast bench-comm-smoke prof-smoke transport-smoke \
       stripe-smoke tracerec-smoke async-smoke ffi-smoke fused-smoke \
-      probe-smoke placement-smoke synth-smoke hier-smoke chaos-smoke \
-      links-smoke tune-smoke metrics-lint
+      probe-smoke placement-smoke synth-smoke hier-smoke sharded-smoke \
+      chaos-smoke links-smoke tune-smoke metrics-lint
 test-fast:
 	$(PYTEST) tests/ -m "not slow"
 
@@ -94,6 +94,17 @@ synth-smoke:
 # bit-identity check, and the sparse:<frac> OP_BATCH round-trip.
 hier-smoke:
 	env JAX_PLATFORMS=cpu python bench_comm.py --hier-smoke
+
+# Sharded-gossip CI gate: the ShardPlan byte model must scale per-step
+# DCN bytes with the replicated fraction ONLY (25/50/75% MoE trees on a
+# simulated 16-rank, 4-group mesh; per-group schedules never emit a
+# cross-group edge), and the 8-device executor leg must match the dense
+# replicated oracle and the per-group sharded oracle <= 1e-6, bill
+# exactly rep_row_bytes x dcn_edges x steps to {level="dcn"} with NO
+# sharded byte on the DCN, and be BIT-identical to the no-spec path
+# under BLUEFOG_TPU_SHARDED_GOSSIP=0 or a fully replicated tree.
+sharded-smoke:
+	env JAX_PLATFORMS=cpu python bench_comm.py --sharded-smoke
 
 # CPU-runnable loopback two-transport exchange over the coalesced DCN
 # path, run twice: native hot path allowed (asserts the C++ batch/drain/

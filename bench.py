@@ -210,6 +210,60 @@ def _hierarchy_summary(devs, tree_bytes: float) -> "dict | None":
     return out
 
 
+def _sharding_summary(devs) -> "dict | None":
+    """Sharded-gossip evidence for BENCH json: the ``ShardPlan`` of a
+    labeled synthetic MoE tree (this bench's ResNet tree is fully
+    replicated, so a synthetic tree is what exercises the planner —
+    code-path evidence, same convention as detail.hierarchy's synthetic
+    slices): replicated fraction, planner decisions per leaf, and the
+    modeled per-level / per-shard wire bytes on THIS mesh.  ``enabled``
+    mirrors ``BLUEFOG_TPU_SHARDED_GOSSIP`` so the schema is stable."""
+    import numpy as np
+    from bluefog_tpu import topology
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu.ops import sharded as SH
+    from bluefog_tpu.utils import config
+    cfg = config.get()
+    n = len(devs)
+    out = {"enabled": bool(cfg.sharded_gossip)}
+    if n < 4 or n % 2:
+        return out
+    n_shards = 4 if n % 4 == 0 else 2
+    tree = {
+        "router": np.zeros((n, 256), np.float32),
+        "experts": np.zeros((n, n_shards, 512), np.float32),
+        # Indivisible model dim: the planner must fall back to
+        # replicated and say so in its decision string.
+        "head": np.zeros((n, 7, 16), np.float32),
+    }
+    specs = {"router": None, "experts": ("ep", None),
+             "head": ("ep", None)}
+    try:
+        plan = SH.build_plan(tree, specs, n=n, n_shards=n_shards)
+        sched = S.compile_static(topology.ExponentialTwoGraph(n))
+        gsched, _per = SH.compile_group_schedules(n, plan.groups)
+    except (ValueError, SystemExit):
+        return out
+    rep_ici, rep_dcn = SH.edge_level_counts(plan.coords, sched)
+    g_ici, g_dcn = SH.edge_level_counts(plan.coords, gsched)
+    rep_row = plan.rep_bytes / n
+    sh_row = (plan.sh_bytes / n / plan.n_shards
+              if plan.any_sharded else 0.0)
+    out.update(plan.summary())
+    out.update({
+        "synthetic_tree": True,
+        "bytes_per_step": {
+            "replicated_ici": round(rep_row * rep_ici, 1),
+            "replicated_dcn": round(rep_row * rep_dcn, 1),
+            "sharded_ici": round(sh_row * g_ici, 1),
+            # Always 0 by construction — in-group schedules cross no
+            # replica-group boundary; kept so regressions are visible.
+            "sharded_dcn": round(sh_row * g_dcn, 1),
+        },
+    })
+    return out
+
+
 def _churn_summary() -> "dict | None":
     """Churn-controller evidence for BENCH json: the live membership view
     (epoch, active ranks, change count, last change time) when
@@ -530,6 +584,7 @@ def main():
             "placement": _placement_summary(devs, dyn),
             "synthesis": _synthesis_summary(devs),
             "hierarchy": _hierarchy_summary(devs, tree_bytes),
+            "sharding": _sharding_summary(devs),
             "churn": _churn_summary(),
             "links": _links_summary(),
             "fused_step": _fused_step_summary(),

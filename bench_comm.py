@@ -199,6 +199,18 @@ def _parse_args():
     p.add_argument("--synth-smoke", action="store_true",
                    help="CI variant of --synth (same assertions — the "
                         "cost model is pure host math)")
+    p.add_argument("--sharded", action="store_true",
+                   help="run the sharded-gossip report: simulated MoE "
+                        "trees at 25/50/75%% replicated fraction assert "
+                        "per-step DCN bytes scale with the replicated "
+                        "fraction only (sharded slices never cross "
+                        "replica groups), plus an executor leg on the "
+                        "8-device CPU mesh checking the dense oracle, "
+                        "the per-shard telemetry split and the "
+                        "BLUEFOG_TPU_SHARDED_GOSSIP=0 bitwise hatch")
+    p.add_argument("--sharded-smoke", action="store_true",
+                   help="CI variant of --sharded (same assertions — "
+                        "`make sharded-smoke`)")
     return p.parse_args()
 
 
@@ -2565,6 +2577,221 @@ def synth_main(args) -> int:
     return 0
 
 
+def sharded_main(args) -> int:
+    """Sharded-gossip report (and the `make sharded-smoke` CI gate).
+
+    Part 1 is pure host math: on a simulated 16-rank MoE mesh (4 replica
+    groups of 4 — i.e. 4-way expert sharding) build trees whose
+    replicated byte fraction is 25/50/75% and assert, through the
+    ``ShardPlan`` planner and the per-group compiled schedules, that
+    per-step DCN bytes scale with the replicated fraction ONLY: the
+    sharded slices ride in-group edges exclusively, so a 50%-sharded
+    tree gossips <= ~50% of the all-replicated path's DCN bytes.
+
+    Part 2 drives the real executor on the 8-device virtual CPU mesh
+    (2 replica groups of 4): the replicated leaf must match the dense
+    ``W^T x`` oracle <= 1e-6, each rank's own shard slice must match the
+    per-group oracle with its ghost region bit-untouched, the
+    ``bf_comm_level_bytes_total{shard=...}`` split must bill exactly
+    rep_row_bytes x dcn_edges x steps to the DCN (and never a sharded
+    byte), and BLUEFOG_TPU_SHARDED_GOSSIP=0 — or a fully replicated
+    tree — must be BIT-identical to the no-spec path."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+
+    import numpy as np
+
+    from bluefog_tpu import topology as topo
+    from bluefog_tpu.ops import schedule as S
+    from bluefog_tpu.ops import sharded as SH
+
+    smoke = args.sharded_smoke
+
+    # ---- Part 1: planner byte model on a simulated 16-rank MoE mesh -----
+    n, n_shards = 16, 4
+    groups = SH.default_groups(n, n_shards)
+    sched = S.compile_static(topo.ExponentialTwoGraph(n))
+    total_cols = 4096  # floats per rank across the whole tree
+    detail = {}
+    baseline_dcn = None  # all-replicated DCN bytes per step
+    for frac in (1.0, 0.75, 0.5, 0.25):
+        rep_cols = int(total_cols * frac)
+        sh_cols = (total_cols - rep_cols) // n_shards
+        tree = {"router": np.zeros((n, rep_cols), np.float32)}
+        specs = {"router": None}
+        if sh_cols:
+            tree["experts"] = np.zeros((n, n_shards, sh_cols), np.float32)
+            specs["experts"] = ("ep", None)
+        plan = SH.build_plan(tree, specs, n=n, n_shards=n_shards,
+                             groups=groups)
+        assert abs(plan.replicated_fraction - frac) < 1e-9, (
+            frac, plan.replicated_fraction)
+        rep_ici, rep_dcn = SH.edge_level_counts(plan.coords, sched)
+        rep_row = plan.rep_bytes / n
+        sh_row = plan.sh_bytes / n / n_shards if plan.any_sharded else 0.0
+        dcn_bytes = rep_row * rep_dcn  # sharded slices: in-group only
+        gsched, per_group = SH.compile_group_schedules(n, groups)
+        g_ici, g_dcn = SH.edge_level_counts(plan.coords, gsched)
+        assert g_dcn == 0.0, (
+            "per-group schedules must never emit a cross-group (DCN) "
+            f"edge, got {g_dcn}")
+        if frac == 1.0:
+            baseline_dcn = dcn_bytes
+        else:
+            ratio = dcn_bytes / baseline_dcn
+            assert abs(ratio - frac) < 1e-9, (
+                f"DCN bytes must scale with the replicated fraction: "
+                f"frac={frac} ratio={ratio}")
+        detail[f"{int(frac * 100)}%"] = {
+            "replicated_fraction": frac,
+            "rep_row_bytes": rep_row,
+            "sharded_row_bytes": sh_row,
+            "dcn_bytes_per_step": dcn_bytes,
+            "dcn_vs_all_replicated": round(dcn_bytes / baseline_dcn, 4),
+            "ici_bytes_per_step": rep_row * rep_ici + sh_row * g_ici,
+            "group_rounds": [len(sub.rounds) for _g, sub in per_group],
+            "merged_rounds": len(gsched.rounds),
+        }
+
+    # ---- Part 2: executor leg on the 8-device CPU mesh ------------------
+    import jax
+    import jax.numpy as jnp
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from jax.sharding import PartitionSpec as P
+    import optax
+
+    import bluefog_tpu as bf
+    from bluefog_tpu.utils import config, telemetry
+
+    knobs = ("BLUEFOG_TPU_TELEMETRY", "BLUEFOG_TPU_SHARDED_GOSSIP")
+    saved = {k: os.environ.get(k) for k in knobs}
+    rng = np.random.default_rng(args.seed)
+    steps = 2 if smoke else 4
+    e2e = {}
+    try:
+        os.environ["BLUEFOG_TPU_TELEMETRY"] = "1"
+        os.environ.pop("BLUEFOG_TPU_SHARDED_GOSSIP", None)
+        config.reload()
+        bf.init()
+        n8 = bf.size()
+        params = {"a": jnp.asarray(rng.standard_normal((n8, 5)),
+                                   jnp.float32),
+                  "b": jnp.asarray(rng.standard_normal((n8, 4, 8)),
+                                   jnp.float32)}
+        specs = {"a": P(), "b": P(None, "tp")}
+        grads = jax.tree.map(jnp.zeros_like, params)
+
+        def drive(shard_specs, num_shards):
+            opt = bf.optim.DistributedNeighborAllreduceOptimizer(
+                optax.sgd(0.0), shard_specs=shard_specs,
+                num_shards=num_shards)
+            state = opt.init(params)
+            p = params
+            for _ in range(steps):
+                p, state = opt.step(p, grads, state)
+            return p
+
+        telemetry.reset()
+        out = drive(specs, 2)
+        snap = telemetry.snapshot()
+
+        # Dense oracle for the replicated leaf: one step is W^T x.
+        W = topo.weight_matrix(bf.load_topology())
+        exp_a = np.asarray(params["a"])
+        for _ in range(steps):
+            exp_a = W.T @ exp_a
+        rep_err = float(np.abs(np.asarray(out["a"]) - exp_a).max())
+        assert rep_err <= 1e-6, rep_err
+
+        # Per-group oracle for each rank's own slice; ghost untouched.
+        plan = SH.build_plan(params, specs, n=n8, n_shards=2)
+        _g, per = SH.compile_group_schedules(n8, plan.groups)
+        Wg = np.zeros((n8, n8))
+        for g, _sub in per:
+            sw = topo.weight_matrix(topo.ExponentialTwoGraph(len(g)))
+            for i, gi in enumerate(g):
+                for j, gj in enumerate(g):
+                    Wg[gi, gj] = sw[i, j]
+        b0, b1 = np.asarray(params["b"]), np.asarray(out["b"])
+        chunk = b0.shape[-1] // 2
+        sh_err = 0.0
+        for r in range(n8):
+            c = plan.coords[r]
+            own = b0[:, :, c * chunk:(c + 1) * chunk]
+            exp = own.copy()
+            for _ in range(steps):
+                exp = np.einsum("sr,s...->r...", Wg, exp)
+            got = b1[r, :, c * chunk:(c + 1) * chunk]
+            sh_err = max(sh_err, float(np.abs(got - exp[r]).max()))
+            ghost = b1[r, :, (1 - c) * chunk:(2 - c) * chunk]
+            assert np.array_equal(
+                ghost, b0[r, :, (1 - c) * chunk:(2 - c) * chunk]), (
+                f"rank {r}: ghost region must be bit-untouched")
+        assert sh_err <= 1e-6, sh_err
+
+        # Telemetry: DCN carries exactly the replicated rows, never a
+        # sharded byte.
+        plan8 = plan
+        sched8 = S.compile_static(bf.load_topology())
+        ici8, dcn8 = SH.edge_level_counts(plan8.coords, sched8)
+        rep_row8 = plan8.rep_bytes / n8
+        key_dcn = ('bf_comm_level_bytes_total'
+                   '{level="dcn",shard="replicated"}')
+        got_dcn = snap.get(key_dcn, 0.0)
+        want_dcn = rep_row8 * dcn8 * steps
+        assert abs(got_dcn - want_dcn) < 1e-6, (got_dcn, want_dcn)
+        assert not any('shard="sharded"' in k and '"dcn"' in k
+                       for k in snap), (
+            "sharded bytes must never be billed to the DCN")
+
+        # Bitwise hatches: knob off, and a fully replicated tree.
+        base = drive(None, None)
+        os.environ["BLUEFOG_TPU_SHARDED_GOSSIP"] = "0"
+        config.reload()
+        off = drive(specs, 2)
+        os.environ.pop("BLUEFOG_TPU_SHARDED_GOSSIP", None)
+        config.reload()
+        allrep = drive({"a": P(), "b": P()}, 2)
+        for k in base:
+            assert np.array_equal(np.asarray(off[k]),
+                                  np.asarray(base[k])), (
+                f"{k}: BLUEFOG_TPU_SHARDED_GOSSIP=0 must be BIT-identical "
+                "to the no-spec path")
+            assert np.array_equal(np.asarray(allrep[k]),
+                                  np.asarray(base[k])), (
+                f"{k}: a fully replicated tree must be BIT-identical to "
+                "the no-spec path")
+        e2e = {
+            "mesh": f"{n8}-device CPU, 2 replica groups of 4",
+            "steps": steps,
+            "replicated_oracle_max_err": rep_err,
+            "sharded_oracle_max_err": sh_err,
+            "dcn_bytes": got_dcn,
+            "dcn_bytes_expected": want_dcn,
+            "replicated_fraction": plan8.replicated_fraction,
+        }
+        bf.shutdown()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        config.reload()
+
+    half = detail["50%"]
+    print(json.dumps({
+        "metric": "sharded_gossip_dcn_bytes_fraction_at_50pct",
+        "value": half["dcn_vs_all_replicated"],
+        "unit": "x",
+        "detail": {"smoke": smoke, "fractions": detail, "e2e": e2e},
+    }))
+    return 0
+
+
 def main():
     args = _parse_args()
     if args.ffi or args.ffi_smoke:
@@ -2587,6 +2814,8 @@ def main():
         return synth_main(args)
     if args.hier or args.hier_smoke:
         return hier_main(args)
+    if args.sharded or args.sharded_smoke:
+        return sharded_main(args)
     if args.smoke:
         args.n = args.n or 8
         args.payload = min(args.payload, 1024)

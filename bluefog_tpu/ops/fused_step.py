@@ -108,7 +108,7 @@ class _Program:
     __slots__ = (
         "key", "step_fn", "finish_fn", "finish_host_drain", "names",
         "plans", "tx", "edges", "remote_procs", "sched", "stamps",
-        "n_put_calls", "accumulate", "probes",
+        "n_put_calls", "accumulate", "probes", "shard_name",
     )
 
 
@@ -200,6 +200,10 @@ class FusedStep:
         opt = self.opt
         if not opt.fuse:
             self._fallback("fuse=False (per-leaf windows) is not lowered")
+        if getattr(opt, "_shard_plan", None) is not None and not opt._buckets:
+            # Every leaf is sharded: there is no replicated bucket window
+            # to anchor the program's edge resolution or its put plans.
+            self._fallback("sharded plan with no replicated leaves")
         if opt._async_on:
             self._fallback("async mode (BLUEFOG_TPU_ASYNC) keeps the "
                            "eager barrier-free step")
@@ -226,8 +230,10 @@ class FusedStep:
         from bluefog_tpu.utils import config, telemetry
         view = getattr(self.opt, "membership_change", None)
         cfg = config.get()
+        plan_sh = getattr(self.opt, "_shard_plan", None)
         return (
             family, treedef, avals, tuple(self.opt._names),
+            (None if plan_sh is None else plan_sh.signature),
             basics._ctx.topology_version,
             (view.epoch if view is not None else -1),
             _edge_token(dst_weights), _self_weight_token(self_weight),
@@ -272,19 +278,28 @@ class FusedStep:
 
         prog = _Program()
         prog.key = key
-        prog.names = list(opt._names)
+        # Under a shard plan the last window is the sharded slices'
+        # in-group window: the compiled program covers the replicated
+        # bucket windows only (its put-plan builder skips the sharded
+        # slices at plan-compile time), and the sharded window rides the
+        # host drain with its in-group weight overrides.
+        plan_sh = getattr(opt, "_shard_plan", None)
+        prog.names = list(opt._names[:-1] if plan_sh is not None
+                          else opt._names)
+        prog.shard_name = (opt._sharded_name if plan_sh is not None
+                           else None)
         prog.edges = owned_edges
         prog.remote_procs = remote_procs
         prog.sched = sched
         prog.tx = getattr(d.transport, "_tx", None) if d is not None else None
         prog.accumulate = accumulate
-        prog.stamps = [None] * len(opt._names)
+        prog.stamps = [None] * len(prog.names)
         prog.plans = []
         op = W.OP_ACCUMULATE if accumulate else W.OP_PUT
         remote_edges = tuple(
             ((s, t), w) for (s, t), w in owned_edges.items()
             if not W._owns(t))
-        for name in opt._names:
+        for name in prog.names:
             if d is None or not remote_edges:
                 prog.plans.append(None)
                 continue
@@ -334,7 +349,7 @@ class FusedStep:
                         and all(p_pre) and all(p_post))
         prog.probes = probe_on
 
-        stamp_fns: List[Optional[object]] = [None] * len(opt._names)
+        stamp_fns: List[Optional[object]] = [None] * len(prog.names)
         if telemetry.enabled() and any(put_fns) and not probe_on:
             try:
                 from jax.experimental import io_callback as _iocb
@@ -351,10 +366,12 @@ class FusedStep:
                                      jax.ShapeDtypeStruct((), jnp.int32),
                                      status, ordered=False)
                     return _emit
-                stamp_fns = [_mk_stamp(i) for i in range(len(opt._names))]
+                stamp_fns = [_mk_stamp(i) for i in range(len(prog.names))]
 
         base = opt.base
         buckets = opt._buckets
+        sh_idx = (tuple(opt._shard_leaf_idx) if plan_sh is not None
+                  else ())
 
         def _step(params_t, grads_t, state_t):
             if probe_on:
@@ -389,7 +406,12 @@ class FusedStep:
                 statuses.append(st_all)
             if probe_on and flats:
                 flats[-1] = p_end(flats[-1])  # program tail
-            return flats, statuses, new_state
+            # Sharded leaves leave the program as whole adapted arrays:
+            # their slicing, in-group put and scatter all run host-side
+            # (identical math to the eager path, so the bitwise
+            # fused-vs-eager oracle holds for them too).
+            sh_leaves = [leaves[i] for i in sh_idx]
+            return flats, statuses, new_state, sh_leaves
 
         # Finish: the host drain — win_update (or the push-sum collect)
         # per bucket window with ``commit=False`` — then ONE jitted
@@ -402,13 +424,20 @@ class FusedStep:
         # wraps).  Ordering needs no program token — the step blocks on
         # the put statuses before the drain runs.
         def _drain_host():
-            return tuple(
+            out = [
                 W.win_update_then_collect(
                     name, require_mutex=require_mutex, commit=False)
                 if accumulate else
                 W.win_update(name, require_mutex=require_mutex,
                              commit=False)
-                for name in prog.names)
+                for name in prog.names]
+            if prog.shard_name is not None:
+                # Explicit partial weights: out-of-group staging stays
+                # pending and never leaks into the sharded average.
+                out.append(W.win_update(
+                    prog.shard_name, require_mutex=require_mutex,
+                    commit=False, **opt._shard_update_kwargs))
+            return tuple(out)
 
         prog.finish_host_drain = _drain_host
 
@@ -422,15 +451,17 @@ class FusedStep:
         bucket_splits = opt._bucket_splits
         treedef = jax.tree_util.tree_structure(params)
 
-        def _rebuild_merge(params_t, combined):
-            leaves_out = []
+        def _rebuild_merge(params_t, sh_scattered, combined):
+            leaves_out = [None] * len(shapes)
             for bi, idxs in enumerate(buckets):
                 splits = bucket_splits[bi]
                 parts = (jnp.split(combined[bi], list(splits[:-1]), axis=1)
                          if len(idxs) > 1 else [combined[bi]])
-                leaves_out.extend(
-                    jnp.reshape(p, shapes[i]).astype(dtypes[i])
-                    for p, i in zip(parts, idxs))
+                for p, i in zip(parts, idxs):
+                    leaves_out[i] = jnp.reshape(p, shapes[i]).astype(
+                        dtypes[i])
+            for i, leaf in zip(sh_idx, sh_scattered):
+                leaves_out[i] = jnp.asarray(leaf).astype(dtypes[i])
             new_t = jax.tree_util.tree_unflatten(treedef, leaves_out)
             if mask is None:
                 return new_t
@@ -444,8 +475,8 @@ class FusedStep:
         # ``combined`` is consumed as inputs only (the caller keeps the
         # drain views for the consensus sampler) — returning it would
         # force XLA to materialize an output copy of every bucket flat.
-        def _finish(params_t, *combined):
-            return _rebuild_merge(params_t, combined)
+        def _finish(params_t, sh_scattered, *combined):
+            return _rebuild_merge(params_t, sh_scattered, combined)
 
         t0 = time.monotonic()
         step_fn = jax.jit(_step)
@@ -545,7 +576,7 @@ class FusedStep:
                         stack.enter_context(
                             W._remote_mutex(prog.names[0], dst, src))
 
-            flats, statuses, new_base = prog.step_fn(
+            flats, statuses, new_base, sh_leaves = prog.step_fn(
                 params, grads, state.base)
             sts = [np.asarray(s) for s in statuses]  # waits for the puts
         t_done = time.monotonic()
@@ -583,6 +614,26 @@ class FusedStep:
                 remote_procs=prog.remote_procs, since=tok, flush=False)
         if prog.remote_procs:
             W._flush_transport(prog.remote_procs, since=tok)
+
+        # Sharded half of the step, host-side: the in-group put of each
+        # rank's own slice rows.  Same math and same wire primitive as
+        # the eager path — only the replicated windows went through the
+        # compiled program.
+        sh_payload = sh_np = plan_sh = None
+        if prog.shard_name is not None:
+            from bluefog_tpu.ops import sharded as SHD
+            plan_sh = opt._shard_plan
+            sh_np = [np.asarray(x) for x in sh_leaves]
+            sh_payload = np.concatenate(
+                [SHD.own_shard_rows(x, sd, plan_sh.coords,
+                                    plan_sh.n_shards)
+                 for x, sd in zip(sh_np, opt._shard_dims)], axis=1)
+            h = W.win_put_nonblocking(
+                sh_payload, prog.shard_name,
+                dst_weights=opt._shard_edges,
+                require_mutex=require_mutex)
+            W.win_wait(h)
+
         if pre_drain is not None:  # push-sum fence / stale-residual fold
             pre_drain()
 
@@ -591,14 +642,32 @@ class FusedStep:
         combined = prog.finish_host_drain()
         if prog.probes:
             _probes.note(_probes.DRAIN_COMMIT)
-        merged = prog.finish_fn(params, *combined)
+        if prog.shard_name is not None:
+            # Scatter the in-group combined rows back into each rank's
+            # own slice of the adapted leaves (ghost regions untouched),
+            # then let the jitted finish slot them into the tree.
+            from bluefog_tpu.ops import sharded as SHD
+            sh_rows = np.asarray(combined[-1])
+            scattered, off = [], 0
+            for x, sd, sz in zip(sh_np, opt._shard_dims,
+                                 opt._shard_sizes):
+                scattered.append(SHD.scatter_shard_rows(
+                    x, sh_rows[:, off:off + sz], sd, plan_sh.coords,
+                    plan_sh.n_shards))
+                off += sz
+            merged = prog.finish_fn(params, tuple(scattered),
+                                    *combined[:-1])
+        else:
+            merged = prog.finish_fn(params, (), *combined)
         if prog.probes:
             _probes.note(_probes.FINISH_DONE)
 
         t = int(state.step)
         # Device arrays go in as-is (the eager step does the same): the
         # sampler gates on its cadence before touching a single element.
-        opt._maybe_sample_consensus(t, list(flats), list(combined))
+        pre = list(flats) + ([sh_payload] if sh_payload is not None
+                             else [])
+        opt._maybe_sample_consensus(t, pre, list(combined))
 
         # Reconcile the step's probe events into measured overlap, the
         # per-bucket issue histograms, timeline lanes and — when a

@@ -42,6 +42,7 @@ __all__ = [
     "CommunicationType",
     "DistOptState",
     "make_combiner",
+    "make_shard_combiner",
     "compress_combiner",
     "awc_step",
     "atc_step",
@@ -166,6 +167,48 @@ def make_combiner(
     raise ValueError(f"unknown communication type {comm}")
 
 
+def make_shard_combiner(plan, group_combine, *, axis_name: str):
+    """Per-replica-group combiner for the sharded leaves of a plan.
+
+    ``plan`` is an :class:`ops.sharded.ShardPlan`; ``group_combine`` is a
+    regular combiner (``make_combiner`` output, optionally wrapped by
+    ``compress_combiner``) built over the plan's *merged group schedule*
+    — its in-group-only edges are what keeps sharded bytes off the DCN.
+
+    The returned callable runs inside ``shard_map`` on the sharded
+    sub-list of leaves (flatten order): each rank slices its *own* shard
+    chunk along the leaf's sharded model dim, ravels the slices into one
+    buffer, gossips it over the group schedule, and writes the combined
+    slice back — the other coordinates' ghost values stay untouched, so
+    ranks never average slices they don't own."""
+    from jax.flatten_util import ravel_pytree
+    coords = jnp.asarray(plan.coords, jnp.int32)
+    sh_dims = tuple(d for m, d in zip(plan.mask, plan.dims) if m)
+
+    def shard_combine(leaves, step=None):
+        # Runs on the per-rank block (rank-major leading dim already
+        # stripped by shard_map), so the sharded model dim d IS array
+        # axis d here — the host-side +1 offset applies only to the
+        # rank-major tree the plan was built from.
+        if not leaves:
+            return leaves
+        coord = coords[lax.axis_index(axis_name)]
+        slices = []
+        for leaf, d in zip(leaves, sh_dims):
+            chunk = leaf.shape[d] // plan.n_shards
+            slices.append(lax.dynamic_slice_in_dim(
+                leaf, coord * chunk, chunk, axis=d))
+        flat, unravel = ravel_pytree(slices)
+        combined = unravel(group_combine(flat, step=step, weights=None))
+        out = []
+        for leaf, d, sl in zip(leaves, sh_dims, combined):
+            chunk = leaf.shape[d] // plan.n_shards
+            out.append(lax.dynamic_update_slice_in_dim(
+                leaf, sl.astype(leaf.dtype), coord * chunk, axis=d))
+        return out
+    return shard_combine
+
+
 def _bucket_groups(leaves, fusion_buckets: Optional[int]):
     """Partition flatten-order leaf indices into contiguous fusion buckets.
 
@@ -235,7 +278,8 @@ def _fused_apply(fn, tree, fusion_buckets: Optional[int]):
 
 
 def _tree_combine(params, combine, step, weights, steps_per_comm: int,
-                  fuse: bool = True, fusion_buckets: Optional[int] = None):
+                  fuse: bool = True, fusion_buckets: Optional[int] = None,
+                  shard_plan=None, shard_combine=None):
     """Apply ``combine`` to a pytree, skipping steps where
     ``step % steps_per_comm != 0`` (local aggregation).
 
@@ -249,16 +293,55 @@ def _tree_combine(params, combine, step, weights, steps_per_comm: int,
     ``BLUEFOG_TPU_FUSION_BUCKET_MB`` cap) splits the buffer so per-bucket
     communication pipelines against the other buckets' optimizer math —
     see :func:`_fused_apply`.
+
+    With an active ``shard_plan`` (a plan whose mask marks some leaves
+    sharded) the tree is split by the mask: replicated leaves ride the
+    legacy fused path over the full topology, sharded leaves go through
+    ``shard_combine`` (:func:`make_shard_combiner`) — per-replica-group
+    gossip of each rank's own shard slice.  Without an active plan this
+    function is byte-for-byte the legacy replicated-only path, which is
+    what keeps fully replicated trees bit-identical under the knob.
     """
-    if getattr(combine, "is_identity", False):
-        return params  # empty communication: no fusion copies, no cond
+    sharded_on = (shard_plan is not None and shard_combine is not None
+                  and shard_plan.any_sharded)
+    if not sharded_on:
+        if getattr(combine, "is_identity", False):
+            return params  # empty communication: no fusion copies, no cond
+
+        def comm_all(p):
+            if fuse:
+                return _fused_apply(
+                    lambda flat: combine(flat, step=step, weights=weights),
+                    p, fusion_buckets)
+            return jax.tree.map(
+                lambda x: combine(x, step=step, weights=weights), p)
+        if steps_per_comm == 1:
+            return comm_all(params)
+        # lax.cond keeps one compiled program; both branches cheap to trace.
+        return lax.cond(step % steps_per_comm == 0, comm_all,
+                        lambda p: p, params)
+
+    rep_idx = [i for i, m in enumerate(shard_plan.mask) if not m]
+    sh_idx = [i for i, m in enumerate(shard_plan.mask) if m]
 
     def comm_all(p):
-        if fuse:
-            return _fused_apply(
-                lambda flat: combine(flat, step=step, weights=weights),
-                p, fusion_buckets)
-        return jax.tree.map(lambda x: combine(x, step=step, weights=weights), p)
+        leaves, treedef = jax.tree_util.tree_flatten(p)
+        out = list(leaves)
+        if rep_idx and not getattr(combine, "is_identity", False):
+            rep = [leaves[i] for i in rep_idx]
+            if fuse:
+                rep_out = _fused_apply(
+                    lambda flat: combine(flat, step=step, weights=weights),
+                    rep, fusion_buckets)
+            else:
+                rep_out = [combine(x, step=step, weights=weights)
+                           for x in rep]
+            for i, leaf in zip(rep_idx, rep_out):
+                out[i] = leaf
+        sh_out = shard_combine([leaves[i] for i in sh_idx], step=step)
+        for i, leaf in zip(sh_idx, sh_out):
+            out[i] = leaf
+        return jax.tree_util.tree_unflatten(treedef, out)
     if steps_per_comm == 1:
         return comm_all(params)
     # lax.cond keeps one compiled program; both branches are cheap to trace.
@@ -268,7 +351,8 @@ def _tree_combine(params, combine, step, weights, steps_per_comm: int,
 def awc_step(base: optax.GradientTransformation, combine: Combiner,
              params, grads, state: DistOptState, *,
              weights=None, steps_per_comm: int = 1, fuse: bool = True,
-             fusion_buckets: Optional[int] = None):
+             fusion_buckets: Optional[int] = None,
+             shard_plan=None, shard_combine=None):
     """Adapt-with-combine: communicate params, then apply the base update.
 
     Matches ``_DistributedReduceOptimizer`` (reference
@@ -279,7 +363,8 @@ def awc_step(base: optax.GradientTransformation, combine: Combiner,
     bucket's update depends only on its own combine).
     """
     combined = _tree_combine(params, combine, state.step, weights,
-                             steps_per_comm, fuse, fusion_buckets)
+                             steps_per_comm, fuse, fusion_buckets,
+                             shard_plan, shard_combine)
     updates, base_state = base.update(grads, state.base, combined)
     new_params = optax.apply_updates(combined, updates)
     return new_params, DistOptState(base_state, state.step + 1)
@@ -288,7 +373,8 @@ def awc_step(base: optax.GradientTransformation, combine: Combiner,
 def atc_step(base: optax.GradientTransformation, combine: Combiner,
              params, grads, state: DistOptState, *,
              weights=None, steps_per_comm: int = 1, fuse: bool = True,
-             fusion_buckets: Optional[int] = None):
+             fusion_buckets: Optional[int] = None,
+             shard_plan=None, shard_combine=None):
     """Adapt-then-combine: local base update first, then communicate.
 
     Matches ``_DistributedAdaptThenCombineOptimizer`` (reference
@@ -301,7 +387,8 @@ def atc_step(base: optax.GradientTransformation, combine: Combiner,
     updates, base_state = base.update(grads, state.base, params)
     half = optax.apply_updates(params, updates)
     new_params = _tree_combine(half, combine, state.step, weights,
-                               steps_per_comm, fuse, fusion_buckets)
+                               steps_per_comm, fuse, fusion_buckets,
+                               shard_plan, shard_combine)
     return new_params, DistOptState(base_state, state.step + 1)
 
 
@@ -485,7 +572,8 @@ def step_fn(order: str, base: optax.GradientTransformation,
             steps_per_comm: int = 1, fuse: bool = True,
             fusion_buckets: Optional[int] = None,
             compression: str = "none",
-            residual: Optional[bool] = None) -> Callable:
+            residual: Optional[bool] = None,
+            shard_plan=None, shard_combine=None) -> Callable:
     """Bind an execution order to a ``(params, grads, state[, weights])`` fn.
 
     ``fusion_buckets`` splits the fused communication buffer into that many
@@ -509,12 +597,19 @@ def step_fn(order: str, base: optax.GradientTransformation,
     if order == "awc":
         return partial(awc_step, base, combine,
                        steps_per_comm=steps_per_comm, fuse=fuse,
-                       fusion_buckets=fusion_buckets)
+                       fusion_buckets=fusion_buckets,
+                       shard_plan=shard_plan, shard_combine=shard_combine)
     if order == "atc":
         return partial(atc_step, base, combine,
                        steps_per_comm=steps_per_comm, fuse=fuse,
-                       fusion_buckets=fusion_buckets)
+                       fusion_buckets=fusion_buckets,
+                       shard_plan=shard_plan, shard_combine=shard_combine)
     if order == "gradient_allreduce":
+        if shard_plan is not None and shard_plan.any_sharded:
+            raise ValueError(
+                "sharded gossip applies to the parameter-consensus orders "
+                "(awc/atc); gradient allreduce averages gradients globally "
+                "and cannot restrict sharded leaves to replica groups")
         return partial(gradient_allreduce_step, base, axis_name=axis_name,
                        steps_per_comm=steps_per_comm,
                        compression=compression, fuse=fuse,

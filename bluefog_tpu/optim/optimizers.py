@@ -90,6 +90,22 @@ class DistributedOptimizer:
         after ``step`` returns (the usual ``params, state =
         opt.step(params, grads, state)`` rebinding pattern is safe; the
         params argument itself is not donated).
+    shard_specs : tree of *model*-dimension ``PartitionSpec``s matching
+        the params structure (``parallel.tensor_parallel.tp_param_specs``
+        output) that arms sharded-aware gossip (``ops/sharded.py``,
+        ``BLUEFOG_TPU_SHARDED_GOSSIP``): leaves whose spec names a mesh
+        axis gossip their per-rank shard slice inside the replica group
+        holding the same shard coordinate, while replicated leaves ride
+        the full topology — per-step DCN bytes drop to the replicated
+        fraction of the tree.  Requires ``neighbor_allreduce`` with an
+        awc/atc order.  ``None`` (default): today's replicated-only path,
+        bit for bit.
+    shard_groups : explicit replica groups (iterable of rank iterables
+        partitioning ``range(n)``); default: ``num_shards`` contiguous
+        blocks.
+    num_shards : shard count along each sharded model dim (groups =
+        contiguous rank blocks).  Required when ``shard_specs`` marks any
+        leaf sharded and ``shard_groups`` is not given.
     profile_every : every N steps, block until the step's device work
         completes, record the TRUE step wall time into the step-profiler
         histograms and gather every rank's duration into a straggler
@@ -110,7 +126,9 @@ class DistributedOptimizer:
                  phases=None, fusion: bool = True,
                  fusion_buckets: Optional[int] = None,
                  compression: str = "none", donate: bool = False,
-                 profile_every: Optional[int] = None):
+                 profile_every: Optional[int] = None,
+                 shard_specs=None, shard_groups=None,
+                 num_shards: Optional[int] = None):
         if isinstance(communication_type, str):
             communication_type = CommunicationType(communication_type)
         if compression not in ("none", "bf16") and not (
@@ -140,10 +158,27 @@ class DistributedOptimizer:
                 f"profile_every must be >= 0, got {profile_every}")
         self.profile_every = (None if profile_every is None
                               else int(profile_every))
+        if shard_specs is not None:
+            if communication_type != CommunicationType.neighbor_allreduce:
+                raise ValueError(
+                    "shard_specs requires CommunicationType."
+                    "neighbor_allreduce (sharded leaves gossip per replica "
+                    f"group over the compiled schedule), got "
+                    f"{communication_type}")
+            if order not in ("awc", "atc"):
+                raise ValueError(
+                    "shard_specs requires a parameter-consensus order "
+                    f"(awc/atc), got {order!r}")
+        self.shard_specs = shard_specs
+        self.shard_groups = shard_groups
+        self.num_shards = None if num_shards is None else int(num_shards)
         self._jitted = {}
         self._steps_seen = 0  # host-side counter for telemetry sampling
         self._hier_meta = None   # set by _hier_gossip_bundle
         self._hier_step0 = None  # state.step of the first hier step seen
+        self._shard_plan_cache = {}  # (treedef, shapes) -> ShardPlan
+        self._shard_meta_cache = {}  # telemetry edge counts per plan/topo
+        self._shard_step0 = None  # state.step of the first sharded step
 
     # -- schedule resolution ------------------------------------------------
     def _schedules(self):
@@ -171,7 +206,61 @@ class DistributedOptimizer:
         return ctx.static_schedule(
             key, lambda: S.compile_static(topo, use_topo_weights=weighted)), None
 
-    def _build_step(self, with_weights: bool):
+    # -- sharded-gossip plan resolution ------------------------------------
+    def _shard_plan(self, params):
+        """Resolve (and cache) the sharded-gossip plan for this tree.
+
+        Returns ``None`` — the verbatim legacy path — unless shard specs
+        were supplied AND ``BLUEFOG_TPU_SHARDED_GOSSIP`` is on.  The plan
+        is cached by (treedef, shapes, dtypes): the mask depends on leaf
+        shapes (indivisible dims fall back to replicated)."""
+        from bluefog_tpu.utils import config
+        if self.shard_specs is None or not config.get().sharded_gossip:
+            return None
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        key = (treedef,
+               tuple((tuple(l.shape), str(np.dtype(l.dtype)))
+                     for l in leaves))
+        plan = self._shard_plan_cache.get(key)
+        if plan is None:
+            from bluefog_tpu.ops import sharded as SH
+            plan = SH.build_plan(
+                params, self.shard_specs, n=basics.size(),
+                n_shards=self.num_shards, groups=self.shard_groups)
+            self._shard_plan_cache[key] = plan
+        return plan
+
+    def _group_schedule(self, ctx, plan):
+        """Merged per-replica-group schedule for ``plan`` (cached on the
+        context like every other compiled schedule; the key carries the
+        sharding signature so re-sharding re-prices)."""
+        from bluefog_tpu.ops import sharded as SH
+        return ctx.static_schedule(
+            ("opt_sharded", ctx.topology_version, plan.signature),
+            lambda: SH.compile_group_schedules(plan.n, plan.groups))
+
+    def _shard_telemetry_meta(self, plan):
+        """(replicated-ici, replicated-dcn, in-group) edge counts for the
+        per-shard byte accounting, memoized per (topology, plan)."""
+        from bluefog_tpu.ops import sharded as SH
+        ctx = basics._require_init()
+        key = (ctx.topology_version, plan.signature,
+               self.use_dynamic_topology)
+        meta = self._shard_meta_cache.get(key)
+        if meta is None:
+            sched, dyn = self._schedules()
+            rep_ici, rep_dcn = SH.edge_level_counts(
+                plan.coords, sched if sched is not None else dyn)
+            grp_edges = 0.0
+            if plan.any_sharded:
+                gsched, _per_group = self._group_schedule(ctx, plan)
+                grp_edges = float(
+                    sum(len(r.pairs) for r in gsched.rounds))
+            meta = (rep_ici, rep_dcn, grp_edges)
+            self._shard_meta_cache[key] = meta
+        return meta
+
+    def _build_step(self, with_weights: bool, plan=None):
         ctx = basics._require_init()
         hier = (self.communication_type in (
                 CommunicationType.hierarchical_neighbor_allreduce,
@@ -191,6 +280,20 @@ class DistributedOptimizer:
             local_axis=LOCAL_AXIS if hier else None,
             machine_axis=MACHINE_AXIS if hier else None,
             hier=hier_bundle)
+        shard_combine = None
+        if plan is not None and plan.any_sharded:
+            # The sharded leaves' combiner gossips each rank's own shard
+            # slice over the merged per-group schedule; compression
+            # composes exactly as on the replicated combiner.
+            gsched, _per_group = self._group_schedule(ctx, plan)
+            gc = F.make_combiner(
+                CommunicationType.neighbor_allreduce,
+                axis_name=RANK_AXIS, sched=gsched)
+            gc = F.compress_combiner(
+                gc, self.compression, residual=True,
+                steps_per_comm=self.num_steps_per_communication)
+            shard_combine = F.make_shard_combiner(
+                plan, gc, axis_name=RANK_AXIS)
         inner = F.step_fn(
             self.order, self.base, combine,
             axis_name=RANK_AXIS,
@@ -200,7 +303,8 @@ class DistributedOptimizer:
             # Explicit residual policy: a global-consensus allreduce must
             # stay replica-bit-identical under compression.
             residual=(self.communication_type
-                      != CommunicationType.allreduce))
+                      != CommunicationType.allreduce),
+            shard_plan=plan, shard_combine=shard_combine)
         mesh = ctx.hier_mesh if hier else ctx.mesh
         spec = P((MACHINE_AXIS, LOCAL_AXIS)) if hier else P(RANK_AXIS)
 
@@ -250,12 +354,13 @@ class DistributedOptimizer:
                 "outer_every": ht.outer_every, "outer_compression": comp,
                 "outer_frac": frac}
 
-    def _step_callable(self, with_weights: bool):
+    def _step_callable(self, with_weights: bool, plan=None):
         ctx = basics._require_init()
         key = (ctx.topology_version, ctx.machine_topology_version,
-               with_weights)
+               with_weights,
+               None if plan is None else plan.signature)
         if key not in self._jitted:
-            self._jitted[key] = self._build_step(with_weights)
+            self._jitted[key] = self._build_step(with_weights, plan)
         return self._jitted[key]
 
     # -- public surface -----------------------------------------------------
@@ -289,9 +394,10 @@ class DistributedOptimizer:
         from bluefog_tpu.utils import profiler, telemetry
         t0 = telemetry.start_timer()
         w = basics._weight_override_matrix(self_weight, src_weights, dst_weights)
+        plan = self._shard_plan(params)
         placed = jax.tree.map(basics._place, (params, grads))
         params, grads = placed
-        fn = self._step_callable(with_weights=w is not None)
+        fn = self._step_callable(with_weights=w is not None, plan=plan)
         if w is None:
             out = basics._throttle(fn(params, grads, state))
         else:
@@ -318,6 +424,21 @@ class DistributedOptimizer:
                     x.nbytes for x in jax.tree_util.tree_leaves(params)))
                 basics._record_hier_levels(ht, t, tree_bytes,
                                            inner_edges, comp)
+        if plan is not None and telemetry.enabled():
+            # Per-shard wire accounting, same cadence machinery as the
+            # hier path above (the fused program never crosses Python, so
+            # the comm-step condition is reconstructed host-side).
+            from bluefog_tpu.ops import sharded as SH
+            if self._shard_step0 is None:
+                self._shard_step0 = int(
+                    np.asarray(state.step).reshape(-1)[0])
+            t = self._shard_step0 + self._steps_seen
+            if t % self.num_steps_per_communication == 0:
+                rep_ici, rep_dcn, grp_edges = \
+                    self._shard_telemetry_meta(plan)
+                SH.record_level_bytes(
+                    plan, rep_ici_edges=rep_ici, rep_dcn_edges=rep_dcn,
+                    grp_edges=grp_edges, compression=self.compression)
         self._steps_seen += 1
         # DISPATCH wall time (async — device work keeps running); the
         # synced profile below measures true step latency.

@@ -127,6 +127,21 @@ class _WindowOptimizerBase:
         self._splits = None   # np.cumsum of per-leaf flat sizes, fused mode
         self._buckets = None        # per-window leaf-index lists, fused mode
         self._bucket_splits = None  # per-window np.cumsum of leaf sizes
+        # Sharded-aware gossip (ops/sharded.py): subclasses that support
+        # it set shard_specs/shard_groups/num_shards; init() resolves the
+        # plan.  With an active plan the fused buckets cover REPLICATED
+        # leaves only and one extra "<prefix>.sharded" window carries each
+        # rank's own-shard slices, put/updated over in-group edges only.
+        self.shard_specs = None
+        self.shard_groups = None
+        self.num_shards = None
+        self._shard_plan = None       # active ops.sharded.ShardPlan
+        self._sharded_name = None     # the per-group window's name
+        self._shard_edges = None      # {(src, dst): w} in-group put edges
+        self._shard_update_kwargs = None  # win_update weight overrides
+        self._shard_leaf_idx = None   # flatten indices of sharded leaves
+        self._shard_dims = None       # per sharded leaf: model dim
+        self._shard_sizes = None      # per sharded leaf: slice row cols
 
     # -- payload layout ----------------------------------------------------
     def _payloads(self, tree) -> List:
@@ -158,15 +173,34 @@ class _WindowOptimizerBase:
         leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
         if not self.fuse:
             return leaves
-        return [np.concatenate(
+        out = [np.concatenate(
             [leaves[i].reshape(self._rows, -1) for i in idxs], axis=1)
             for idxs in buckets]
+        if self._shard_plan is not None:
+            out.append(self._shard_payload(leaves))
+        return out
+
+    def _shard_payload(self, leaves) -> np.ndarray:
+        """The sharded window's rows: per rank, its OWN shard slice of
+        every sharded leaf, raveled and concatenated (same column order
+        as ``_rebuild``'s inverse scatter)."""
+        from bluefog_tpu.ops import sharded as SHD
+        plan = self._shard_plan
+        return np.concatenate(
+            [SHD.own_shard_rows(leaves[i], d, plan.coords, plan.n_shards)
+             for i, d in zip(self._shard_leaf_idx, self._shard_dims)],
+            axis=1)
 
     def _device_payloads_ok(self, tree) -> bool:
         """Can this tree ship as device payloads through the XLA put
         path?  All-f32 ``jax.Array`` leaves only — the fused device
         concatenate must not change the wire dtype a mixed tree would
         get from numpy's promotion rules."""
+        if self._shard_plan is not None:
+            # The sharded window's payload is a host-side per-coordinate
+            # slice gather; keep every payload on the one (host) path so
+            # rep/sharded rows stay a single consistent snapshot.
+            return False
         if W._store.distrib is None:
             return False
         from bluefog_tpu.ops import xlaffi
@@ -176,7 +210,13 @@ class _WindowOptimizerBase:
                    for x in jax.tree_util.tree_leaves(tree))
 
     def _rebuild(self, arrays: List, like):
-        """Inverse of :meth:`_payloads` — back to the pytree structure."""
+        """Inverse of :meth:`_payloads` — back to the pytree structure.
+
+        With an active shard plan, ``like`` must be the ADAPTED tree:
+        sharded leaves take their combined own-shard slice from the
+        sharded window's rows and keep ``like``'s values everywhere else
+        (the other coordinates' ghost regions).  Without a plan ``like``
+        supplies the tree structure only, as before."""
         treedef = jax.tree_util.tree_structure(like)
         if self.fuse:
             leaves = [None] * len(self._shapes)
@@ -190,6 +230,19 @@ class _WindowOptimizerBase:
                 for p, i in zip(parts, idxs):
                     leaves[i] = p.reshape(self._shapes[i]).astype(
                         self._dtypes[i])
+            if self._shard_plan is not None:
+                from bluefog_tpu.ops import sharded as SHD
+                plan = self._shard_plan
+                like_leaves = jax.tree_util.tree_leaves(like)
+                rows = np.asarray(arrays[-1])
+                off = 0
+                for i, d, sz in zip(self._shard_leaf_idx,
+                                    self._shard_dims, self._shard_sizes):
+                    seg = rows[:, off:off + sz]
+                    off += sz
+                    leaves[i] = SHD.scatter_shard_rows(
+                        np.asarray(like_leaves[i]), seg, d, plan.coords,
+                        plan.n_shards).astype(self._dtypes[i])
         else:
             leaves = arrays
         return jax.tree_util.tree_unflatten(
@@ -285,18 +338,23 @@ class _WindowOptimizerBase:
                     f"{type(self).__name__}.init: layout={self._layout!r} "
                     f"expects leading dim {want}, got {rows}")
         self._rows = rows
+        self._resolve_shard_plan(params, leaves)
+        plan = self._shard_plan
         if self.fuse:
             self._shapes = [x.shape for x in leaves]
             self._dtypes = [x.dtype for x in leaves]
             sizes = [int(np.prod(s[1:])) for s in self._shapes]
             self._splits = np.cumsum(sizes)
+            rep_idx = (list(range(len(leaves))) if plan is None else
+                       [i for i, m in enumerate(plan.mask) if not m])
             if self.fusion_buckets is not None \
-                    and int(self.fusion_buckets) > 1:
+                    and int(self.fusion_buckets) > 1 and rep_idx:
                 from bluefog_tpu.optim.functional import _bucket_groups
-                self._buckets = _bucket_groups(leaves,
-                                               int(self.fusion_buckets))
+                rel = _bucket_groups([leaves[i] for i in rep_idx],
+                                     int(self.fusion_buckets))
+                self._buckets = [[rep_idx[j] for j in grp] for grp in rel]
             else:
-                self._buckets = [list(range(len(leaves)))]
+                self._buckets = [rep_idx] if rep_idx else []
             self._bucket_splits = [
                 np.cumsum([sizes[i] for i in idxs])
                 for idxs in self._buckets]
@@ -305,6 +363,9 @@ class _WindowOptimizerBase:
             else:
                 self._names = [f"{self.window_prefix}.fusedb{i}"
                                for i in range(len(self._buckets))]
+            if plan is not None:
+                self._sharded_name = f"{self.window_prefix}.sharded"
+                self._names.append(self._sharded_name)
         else:
             self._names = _leaf_names(params, self.window_prefix)
         # Owned-layout creation tensors carry no neighbor rows, so the
@@ -329,6 +390,44 @@ class _WindowOptimizerBase:
         self._update_fn = jax.jit(jax.vmap(
             lambda g, s, p: base.update(g, s, p)))
         return DistOptState(st, jnp.asarray(0, jnp.int32))
+
+    def _resolve_shard_plan(self, params, leaves) -> None:
+        """Arm sharded-aware gossip when shard specs were supplied, the
+        knob is on, and some leaf is actually sharded; otherwise leave
+        every structure ``None`` — the verbatim legacy layout."""
+        self._shard_plan = None
+        self._sharded_name = None
+        if self.shard_specs is None:
+            return
+        from bluefog_tpu.utils import config as _config
+        if not _config.get().sharded_gossip:
+            return
+        from bluefog_tpu.ops import sharded as SHD
+        plan = SHD.build_plan(params, self.shard_specs, n=self._n,
+                              n_shards=self.num_shards,
+                              groups=self.shard_groups)
+        if not plan.any_sharded:
+            return
+        if self._layout != "rank":
+            raise ValueError(
+                f"{type(self).__name__}: shard_specs requires the "
+                "rank-major layout (the sharded window's per-coordinate "
+                "rows are rank-indexed); owned layout is not supported")
+        if not self.fuse:
+            raise ValueError(
+                f"{type(self).__name__}: shard_specs requires fuse=True "
+                "(the sharded slices ride one dedicated fused window)")
+        self._shard_plan = plan
+        self._shard_leaf_idx = [i for i, m in enumerate(plan.mask) if m]
+        self._shard_dims = [plan.dims[i] for i in self._shard_leaf_idx]
+        self._shard_sizes = [
+            int(np.prod(leaves[i].shape[1:])) // plan.n_shards
+            for i in self._shard_leaf_idx]
+        put_edges, self_w, nbr_w = SHD.induced_window_weights(
+            plan, basics.load_topology())
+        self._shard_edges = put_edges
+        self._shard_update_kwargs = {
+            "self_weight": self_w, "neighbor_weights": nbr_w}
 
     def _local_adapt(self, params, grads, state: DistOptState):
         updates, base_state = self._update_fn(grads, state.base, params)
@@ -590,12 +689,20 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
     def __init__(self, base, *, window_prefix: str = "winput",
                  num_steps_per_communication: int = 1, fuse: bool = True,
                  overlap: bool = False, layout: str = "auto",
-                 fused=None, fusion_buckets=None):
+                 fused=None, fusion_buckets=None,
+                 shard_specs=None, shard_groups=None, num_shards=None):
         super().__init__(base, window_prefix=window_prefix,
                          num_steps_per_communication=num_steps_per_communication,
                          fuse=fuse, layout=layout, fused=fused,
                          fusion_buckets=fusion_buckets)
         self.overlap = bool(overlap)
+        # Sharded-aware gossip (ops/sharded.py, same contract as the
+        # collective family's DistributedOptimizer kwargs): sharded
+        # leaves ride a dedicated window whose puts and update weights
+        # are restricted to in-replica-group edges.
+        self.shard_specs = shard_specs
+        self.shard_groups = shard_groups
+        self.num_shards = None if num_shards is None else int(num_shards)
         self._pending: List[int] = []
 
     def step(self, params, grads, state: DistOptState, *,
@@ -619,9 +726,15 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
             self._drain_pending()
             payloads = self._payloads(new_params)
             handles = [
-                W.win_put_nonblocking(payload, name,
-                                      dst_weights=dst_weights,
-                                      require_mutex=require_mutex)
+                W.win_put_nonblocking(
+                    payload, name,
+                    # The sharded window's puts cross in-group edges
+                    # only — its slices must never leave the replica
+                    # group that shares their coordinate.
+                    dst_weights=(self._shard_edges
+                                 if name == self._sharded_name
+                                 else dst_weights),
+                    require_mutex=require_mutex)
                 for name, payload in zip(self._names, payloads)]
             # Async mode implies overlap: the put must not block the
             # step on a slow peer's wire — the next step's win_update
@@ -640,10 +753,16 @@ class DistributedWinPutOptimizer(_WindowOptimizerBase):
             else:
                 for h in handles:
                     W.win_wait(h)
-            combined = [W.win_update(name, require_mutex=require_mutex)
-                        for name in self._names]
+            combined = [
+                W.win_update(name, require_mutex=require_mutex,
+                             # Explicit partial weights: out-of-group
+                             # staging (if any ever landed) stays pending
+                             # and never leaks into the sharded average.
+                             **(self._shard_update_kwargs
+                                if name == self._sharded_name else {}))
+                for name in self._names]
             self._maybe_sample_consensus(t, payloads, combined)
-            new_params = self._rebuild(combined, params)
+            new_params = self._rebuild(combined, new_params)
         out = (self._merge_owned(params, new_params),
                DistOptState(base_state, state.step + 1))
         self._record_step_time(t0, t)

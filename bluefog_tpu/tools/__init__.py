@@ -291,7 +291,9 @@ def schedule_dump(topology: str, n: int, torus: str, *, slices: int = 1,
                   hier_outer_every: int = 1,
                   hier_compression: str = "none",
                   lowering: str = "ppermute", fusion_buckets: int = 4,
-                  payload_mb: float = 64.0) -> str:
+                  payload_mb: float = 64.0, sharded: bool = False,
+                  replicated_frac: float = 0.5,
+                  num_shards: int = 4) -> str:
     """Text report of the schedule pipeline for one topology x torus.
 
     The artifact refactor makes this nearly free: every stage returns a
@@ -405,6 +407,10 @@ def schedule_dump(topology: str, n: int, torus: str, *, slices: int = 1,
         lines.append("")
         lines.extend(_hier_dump_lines(
             model, n, slices, hier_outer_every, hier_compression))
+    if sharded:
+        lines.append("")
+        lines.extend(_sharded_dump_lines(
+            model, chosen, n, num_shards, replicated_frac, perm))
     return "\n".join(lines)
 
 
@@ -470,6 +476,85 @@ def _hier_dump_lines(model, n: int, slices: int, outer_every: int,
             f"{edges * byte_f * cadence_f:>10.1f} "
             f"{ici * cadence_f:>10.1f} "
             f"{dcn * byte_f * cadence_f:>10.1f}")
+    return out
+
+
+def _sharded_dump_lines(model, full_sched, n: int, num_shards: int,
+                        replicated_frac: float, perm) -> List[str]:
+    """Per-replica-group table for ``schedule-dump --sharded``: the
+    replicated fraction of the tree rides the full topology while each
+    sharded slice gossips inside its replica group only — one row per
+    group with its round count, per-step wire rows and modeled serial
+    cost, plus the merged in-group artifact all groups dispatch as."""
+    from types import SimpleNamespace
+
+    from bluefog_tpu.ops import placement as PL
+    from bluefog_tpu.ops import sharded as SH
+
+    if n % num_shards:
+        raise SystemExit(
+            f"schedule-dump --sharded: --num-shards {num_shards} must "
+            f"divide --n {n}")
+    if not 0.0 <= replicated_frac <= 1.0:
+        raise SystemExit("schedule-dump --sharded: --replicated-frac "
+                         "must be in [0, 1]")
+    groups = SH.default_groups(n, num_shards)
+    merged, per_group = SH.compile_group_schedules(n, groups)
+    coords = tuple(next(c for c, g in enumerate(groups) if r in g)
+                   for r in range(n))
+    rep_rows = replicated_frac          # rows per unit payload row
+    sh_rows = (1.0 - replicated_frac) / num_shards
+    full_edges = sum(len(r.pairs) for r in full_sched.rounds)
+    c_full = PL.schedule_cost(model, full_sched, perm)
+    out = [
+        f"sharded gossip: {num_shards} replica group(s) of "
+        f"{n // num_shards}, replicated fraction "
+        f"{replicated_frac:.2f} (sharded slices never leave their "
+        "group — DCN bytes scale with the replicated fraction only)",
+        "",
+        f"{'component':<26} {'ranks':<12} {'rounds':>6} "
+        f"{'rows/step':>10} {'max_link_load':>13} "
+        f"{'serial_link_time':>16}",
+    ]
+    out.append("-" * len(out[-1]))
+    out.append(
+        f"{'replicated (full topo)':<26} {'0-' + str(n - 1):<12} "
+        f"{len(full_sched.rounds):>6} {full_edges * rep_rows:>10.2f} "
+        f"{c_full.max_link_load * rep_rows:>13.2f} "
+        f"{c_full.serial_link_time * rep_rows:>16.2f}")
+    for gi, (ranks, sub) in enumerate(per_group):
+        # Price this group's slice of the merged artifact in isolation:
+        # its pairs on the real torus routes, other groups silent.
+        gset = set(ranks)
+        rounds = [SimpleNamespace(
+            pairs=[(s, d) for (s, d) in rnd.pairs if s in gset])
+            for rnd in merged.rounds]
+        gsched = SimpleNamespace(rounds=rounds)
+        cg = PL.schedule_cost(model, gsched, perm)
+        edges = sum(len(r.pairs) for r in rounds)
+        span = f"{min(ranks)}-{max(ranks)}" if len(ranks) > 1 \
+            else str(ranks[0])
+        out.append(
+            f"{'group %d (in-group)' % gi:<26} {span:<12} "
+            f"{len(sub.rounds):>6} {edges * sh_rows:>10.2f} "
+            f"{cg.max_link_load * sh_rows:>13.2f} "
+            f"{cg.serial_link_time * sh_rows:>16.2f}")
+    ici, dcn = SH.edge_level_counts(coords, merged)
+    cm = PL.schedule_cost(model, merged, perm)
+    out.append(
+        f"{'merged in-group artifact':<26} {'0-' + str(n - 1):<12} "
+        f"{len(merged.rounds):>6} "
+        f"{(ici + dcn) * sh_rows:>10.2f} "
+        f"{cm.max_link_load * sh_rows:>13.2f} "
+        f"{cm.serial_link_time * sh_rows:>16.2f}")
+    _, full_dcn = SH.edge_level_counts(coords, full_sched)
+    out += [
+        "",
+        f"per-step DCN rows: replicated {full_dcn * rep_rows:.2f} "
+        f"(= {replicated_frac:.0%} of the all-replicated "
+        f"{full_dcn:.0f}), sharded {dcn * sh_rows:.2f} (in-group "
+        "schedules cross no group boundary)",
+    ]
     return out
 
 
@@ -691,6 +776,18 @@ def main(argv=None) -> int:
     pd.add_argument("--payload-mb", type=float, default=64.0,
                     help="--lowering fused: modeled per-step payload in "
                          "MB split across the buckets (default 64)")
+    pd.add_argument("--sharded", action="store_true",
+                    help="append the sharding-aware gossip table "
+                         "(BLUEFOG_TPU_SHARDED_GOSSIP): per-replica-"
+                         "group rounds, per-step wire rows and modeled "
+                         "serial cost, with the DCN rows scaling by "
+                         "--replicated-frac")
+    pd.add_argument("--replicated-frac", type=float, default=0.5,
+                    help="--sharded: replicated byte fraction of the "
+                         "tree (default 0.5)")
+    pd.add_argument("--num-shards", type=int, default=4,
+                    help="--sharded: replica group count; must divide "
+                         "--n (default 4)")
     args = parser.parse_args(argv)
     if args.cmd == "schedule-dump":
         print(schedule_dump(
@@ -701,7 +798,9 @@ def main(argv=None) -> int:
             hier_outer_every=args.hier_outer_every,
             hier_compression=args.hier_compression,
             lowering=args.lowering, fusion_buckets=args.fusion_buckets,
-            payload_mb=args.payload_mb))
+            payload_mb=args.payload_mb, sharded=args.sharded,
+            replicated_frac=args.replicated_frac,
+            num_shards=args.num_shards))
         return 0
     if args.cmd == "bench-trend":
         print(bench_trend(args.directory, args.pattern))

@@ -20,6 +20,7 @@ for the TPU rebuild.  Values are read lazily on first access and cached; call
 | BLUEFOG_TPU_WIN_NATIVE        | 1     | 0: keep the transport hot loop (batch/drain/fold) in Python; 1 auto-falls back when the native core is missing/stale |
 | BLUEFOG_TPU_WIN_XLA           | 1     | 0: pin the host-staged put path (the bitwise oracle); 1 auto-disarms (one warning) without jax.ffi, the bf_xla native symbols, or host-addressable device buffers |
 | BLUEFOG_TPU_FUSED_STEP        | 0     | whole-step compilation (ops/fused_step.py): optimizer math + per-bucket window puts lower into one jitted XLA program; 0 pins the eager step (the bitwise oracle); 1 auto-falls back to eager (one warning) when the XLA put path is disarmed |
+| BLUEFOG_TPU_SHARDED_GOSSIP    | 1     | sharding-aware gossip (ops/sharded.py): with explicit shard specs, replicated leaves gossip over the full topology while sharded leaves gossip per replica group only — DCN bytes scale with the replicated fraction; 0 forces replicated-only gossip; fully replicated trees are bitwise identical either way |
 | BLUEFOG_TPU_WIN_COALESCE_LINGER_MS | 1.0 | sender-worker linger before flushing a partial batch |
 | BLUEFOG_TPU_WIN_COALESCE_BYTES | 1 MiB | queued bytes that force an immediate batch flush |
 | BLUEFOG_TPU_WIN_TX_QUEUE      | 1024  | per-peer outbound queue bound (messages); full blocks the producer |
@@ -311,6 +312,17 @@ class Config:
     # to eager (one logged warning) whenever the XLA put path is
     # disarmed (no jax.ffi / native symbols / non-CPU backend).
     fused_step: bool
+    # Sharded-aware gossip (ops/sharded.py): optimizers given per-leaf
+    # PartitionSpecs neighbor-average only the replicated (data-parallel)
+    # leaves over the full topology, while sharded (expert/stage/tensor)
+    # leaves gossip their per-rank own-shard slice inside the replica
+    # group that holds the same shard coordinate — per-step DCN bytes
+    # drop to the replicated fraction of the tree.  ON by default, but a
+    # plan only activates when explicit shard specs are passed AND some
+    # leaf is actually sharded; every existing call site (no specs, or a
+    # fully replicated tree) stays bitwise identical.  0 forces today's
+    # replicated-only behavior even when specs are supplied.
+    sharded_gossip: bool
     # Transient-send retry policy of the DCN transport (ops/transport.py):
     # how many times a failed native send is retried with jittered
     # exponential backoff (base win_retry_backoff_ms, doubling per
@@ -521,6 +533,8 @@ class Config:
             win_native=_flag("BLUEFOG_TPU_WIN_NATIVE", default=True),
             win_xla=_flag("BLUEFOG_TPU_WIN_XLA", default=True),
             fused_step=_flag("BLUEFOG_TPU_FUSED_STEP"),
+            sharded_gossip=_flag("BLUEFOG_TPU_SHARDED_GOSSIP",
+                                 default=True),
             win_retries=int(os.environ.get(
                 "BLUEFOG_TPU_WIN_RETRIES", "1")),
             win_retry_backoff_ms=float(os.environ.get(
